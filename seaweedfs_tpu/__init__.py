@@ -1,0 +1,9 @@
+"""seaweedfs_tpu — a TPU-native distributed blob store (SeaweedFS-class).
+
+Master / volume-server / filer architecture with needle-log volumes and
+RS(10,4) erasure coding, where the GF(2^8) codec is a JAX/XLA program on TPU
+instead of CPU SIMD assembly.  See SURVEY.md for the reference analysis this
+framework is built against.
+"""
+
+__version__ = "0.1.0"

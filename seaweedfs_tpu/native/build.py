@@ -1,0 +1,36 @@
+"""On-demand g++ build of the native library.
+
+No pip/apt dependencies: a single translation unit compiled straight to a
+shared object next to this file.  Callers treat failure as 'native
+unavailable' and fall back to numpy.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+_SRC = os.path.join(os.path.dirname(__file__), "seaweed_native.cc")
+_OUT = os.path.join(os.path.dirname(__file__), "libseaweed_native.so")
+
+
+def build(force: bool = False) -> str:
+    if not force and os.path.exists(_OUT) and (
+        os.path.getmtime(_OUT) >= os.path.getmtime(_SRC)
+    ):
+        return _OUT
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-march=native",
+        _SRC, "-o", _OUT,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired):
+        # retry without -march=native (portable baseline)
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _OUT]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    return _OUT
+
+
+if __name__ == "__main__":
+    print(build(force=True))

@@ -1,0 +1,92 @@
+"""ctypes loader for the C++ native library (CRC32C, GF(2^8) SIMD codec).
+
+The native byte-path mirrors the reference's use of SIMD for CRC32C and GF
+arithmetic (klauspost/crc32, klauspost/reedsolomon).  Built on demand by
+``build.py``; every caller must tolerate ``available() == False`` and fall
+back to numpy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _so_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "libseaweed_native.so")
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        path = _so_path()
+        if not os.path.exists(path):
+            try:
+                from . import build
+
+                build.build()
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.sw_crc32c_update.restype = ctypes.c_uint32
+        lib.sw_crc32c_update.argtypes = [
+            ctypes.c_uint32,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        lib.sw_gf_apply.restype = None
+        lib.sw_gf_apply.argtypes = [
+            ctypes.c_char_p,  # matrix rows (R*S bytes)
+            ctypes.c_int,  # R
+            ctypes.c_int,  # S
+            ctypes.POINTER(ctypes.c_char_p),  # inputs
+            ctypes.POINTER(ctypes.c_char_p),  # outputs
+            ctypes.c_size_t,  # block len
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def crc32c_update(crc: int, data: bytes) -> int:
+    lib = _load()
+    assert lib is not None
+    return int(lib.sw_crc32c_update(crc, data, len(data)))
+
+
+def gf_apply(matrix_rows, inputs: list[bytes], out_count: int) -> list[bytearray]:
+    """Apply (R,S) GF matrix to S equal-length buffers -> R buffers."""
+    lib = _load()
+    assert lib is not None
+    import numpy as np
+
+    m = np.ascontiguousarray(matrix_rows, dtype=np.uint8)
+    r, s = m.shape
+    n = len(inputs[0])
+    outs = [bytearray(n) for _ in range(r)]
+    in_ptrs = (ctypes.c_char_p * s)(*[
+        ctypes.cast(
+            (ctypes.c_char * n).from_buffer_copy(b), ctypes.c_char_p
+        )
+        for b in inputs
+    ])
+    out_bufs = [(ctypes.c_char * n).from_buffer(o) for o in outs]
+    out_ptrs = (ctypes.c_char_p * r)(
+        *[ctypes.cast(ob, ctypes.c_char_p) for ob in out_bufs]
+    )
+    lib.sw_gf_apply(m.tobytes(), r, s, in_ptrs, out_ptrs, n)
+    return outs

@@ -1,0 +1,155 @@
+// Native byte-path for seaweedfs_tpu: hardware CRC32C and a SIMD GF(2^8)
+// codec.  This plays the role the reference delegates to SIMD assembly
+// (klauspost/crc32 for needle checksums, klauspost/reedsolomon for the
+// RS(10,4) hot loop): the host-side fast path for per-needle work where a TPU
+// dispatch would dominate the latency.  Bulk encode/rebuild runs on TPU.
+//
+// Build: g++ -O3 -shared -fPIC (see build.py).  x86 SIMD paths are guarded so
+// the file also compiles on other architectures.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#if defined(__SSE4_2__)
+#include <nmmintrin.h>
+#endif
+#if defined(__SSSE3__)
+#include <tmmintrin.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli).  Unmasked; callers apply the LevelDB-style mask.
+// ---------------------------------------------------------------------------
+
+static uint32_t crc32c_table[8][256];
+static bool crc32c_init_done = false;
+
+static void crc32c_init() {
+  if (crc32c_init_done) return;
+  const uint32_t poly = 0x82F63B78u;
+  for (int i = 0; i < 256; i++) {
+    uint32_t crc = (uint32_t)i;
+    for (int j = 0; j < 8; j++) crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+    crc32c_table[0][i] = crc;
+  }
+  for (int k = 1; k < 8; k++)
+    for (int i = 0; i < 256; i++)
+      crc32c_table[k][i] =
+          (crc32c_table[k - 1][i] >> 8) ^ crc32c_table[0][crc32c_table[k - 1][i] & 0xFF];
+  crc32c_init_done = true;
+}
+
+uint32_t sw_crc32c_update(uint32_t crc, const uint8_t* data, size_t n) {
+  crc = ~crc;
+#if defined(__SSE4_2__)
+  while (n >= 8) {
+    uint64_t chunk;
+    memcpy(&chunk, data, 8);
+    crc = (uint32_t)_mm_crc32_u64(crc, chunk);
+    data += 8;
+    n -= 8;
+  }
+  while (n--) crc = _mm_crc32_u8(crc, *data++);
+#else
+  crc32c_init();
+  while (n >= 8) {
+    uint32_t low = crc ^ ((uint32_t)data[0] | (uint32_t)data[1] << 8 |
+                          (uint32_t)data[2] << 16 | (uint32_t)data[3] << 24);
+    crc = crc32c_table[7][low & 0xFF] ^ crc32c_table[6][(low >> 8) & 0xFF] ^
+          crc32c_table[5][(low >> 16) & 0xFF] ^ crc32c_table[4][(low >> 24) & 0xFF] ^
+          crc32c_table[3][data[4]] ^ crc32c_table[2][data[5]] ^
+          crc32c_table[1][data[6]] ^ crc32c_table[0][data[7]];
+    data += 8;
+    n -= 8;
+  }
+  while (n--) crc = (crc >> 8) ^ crc32c_table[0][(crc ^ *data++) & 0xFF];
+#endif
+  return ~crc;
+}
+
+// ---------------------------------------------------------------------------
+// GF(2^8) codec, field polynomial 0x11D.  outputs[r] = XOR_s M[r][s]*in[s].
+// Per-constant low/high-nibble tables; SSSE3 pshufb path processes 16 bytes
+// per step (the same trick the reference's SIMD assembly uses).
+// ---------------------------------------------------------------------------
+
+static uint8_t gf_mul_table[256][256];
+static bool gf_init_done = false;
+
+static void gf_init() {
+  if (gf_init_done) return;
+  uint8_t exp_t[512];
+  int log_t[256];
+  int x = 1;
+  for (int i = 0; i < 255; i++) {
+    exp_t[i] = (uint8_t)x;
+    log_t[x] = i;
+    x <<= 1;
+    if (x & 0x100) x ^= 0x11D;
+  }
+  for (int i = 255; i < 512; i++) exp_t[i] = exp_t[i - 255];
+  for (int a = 0; a < 256; a++)
+    for (int b = 0; b < 256; b++)
+      gf_mul_table[a][b] =
+          (a == 0 || b == 0) ? 0 : exp_t[log_t[a] + log_t[b]];
+  gf_init_done = true;
+}
+
+static void gf_mul_acc_scalar(uint8_t c, const uint8_t* in, uint8_t* out,
+                              size_t n, bool first) {
+  const uint8_t* row = gf_mul_table[c];
+  if (first) {
+    for (size_t i = 0; i < n; i++) out[i] = row[in[i]];
+  } else {
+    for (size_t i = 0; i < n; i++) out[i] ^= row[in[i]];
+  }
+}
+
+#if defined(__SSSE3__)
+static void gf_mul_acc_ssse3(uint8_t c, const uint8_t* in, uint8_t* out,
+                             size_t n, bool first) {
+  // Build 16-entry nibble tables for constant c.
+  alignas(16) uint8_t lo_tbl[16], hi_tbl[16];
+  for (int i = 0; i < 16; i++) {
+    lo_tbl[i] = gf_mul_table[c][i];
+    hi_tbl[i] = gf_mul_table[c][i << 4];
+  }
+  __m128i lo = _mm_load_si128((const __m128i*)lo_tbl);
+  __m128i hi = _mm_load_si128((const __m128i*)hi_tbl);
+  __m128i mask = _mm_set1_epi8(0x0F);
+  size_t i = 0;
+  for (; i + 16 <= n; i += 16) {
+    __m128i v = _mm_loadu_si128((const __m128i*)(in + i));
+    __m128i vl = _mm_and_si128(v, mask);
+    __m128i vh = _mm_and_si128(_mm_srli_epi64(v, 4), mask);
+    __m128i r = _mm_xor_si128(_mm_shuffle_epi8(lo, vl), _mm_shuffle_epi8(hi, vh));
+    if (!first) r = _mm_xor_si128(r, _mm_loadu_si128((const __m128i*)(out + i)));
+    _mm_storeu_si128((__m128i*)(out + i), r);
+  }
+  if (i < n) gf_mul_acc_scalar(c, in + i, out + i, n - i, first);
+}
+#endif
+
+void sw_gf_apply(const uint8_t* matrix, int r, int s, const uint8_t** inputs,
+                 uint8_t** outputs, size_t n) {
+  gf_init();
+  for (int i = 0; i < r; i++) {
+    bool first = true;
+    for (int j = 0; j < s; j++) {
+      uint8_t c = matrix[i * s + j];
+      if (c == 0) continue;
+#if defined(__SSSE3__)
+      gf_mul_acc_ssse3(c, inputs[j], outputs[i], n, first);
+#else
+      gf_mul_acc_scalar(c, inputs[j], outputs[i], n, first);
+#endif
+      first = false;
+    }
+    if (first) memset(outputs[i], 0, n);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,3 @@
+from . import crc32c, gf256  # noqa: F401
+from .codec import DATA_SHARDS, PARITY_SHARDS, TOTAL_SHARDS, get_codec  # noqa: F401
+from .rs_cpu import ReedSolomon  # noqa: F401

@@ -1,0 +1,27 @@
+"""Codec registry — the `-ec.codec={cpu|tpu|tpu_mxu}` switch.
+
+The reference hardwires klauspost/reedsolomon; here every consumer (file
+encoder, degraded reads, gRPC handlers, shell commands) goes through
+``get_codec`` so the backend is a deployment choice.
+"""
+
+from __future__ import annotations
+
+from .rs_cpu import ReedSolomon
+from .rs_jax import ReedSolomonTPU
+
+DATA_SHARDS = 10
+PARITY_SHARDS = 4
+TOTAL_SHARDS = DATA_SHARDS + PARITY_SHARDS
+
+
+def get_codec(name: str = "cpu", data_shards: int = DATA_SHARDS,
+              parity_shards: int = PARITY_SHARDS):
+    """Return a codec with encode/reconstruct/reconstruct_data/verify."""
+    if name in ("cpu", "go", "numpy"):
+        return ReedSolomon(data_shards, parity_shards)
+    if name in ("tpu", "jax", "tpu_xor"):
+        return ReedSolomonTPU(data_shards, parity_shards, impl="xor")
+    if name in ("tpu_mxu", "mxu"):
+        return ReedSolomonTPU(data_shards, parity_shards, impl="mxu")
+    raise ValueError(f"unknown ec codec {name!r}")

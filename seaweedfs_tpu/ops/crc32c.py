@@ -1,0 +1,86 @@
+"""CRC32-C (Castagnoli) with the reference's masked finalisation.
+
+The reference computes needle checksums with SIMD CRC32C
+(weed/storage/needle/crc.go, klauspost/crc32) and stores a *masked* value:
+``Value() = rotr(crc, 15) + 0xa282ead8`` (crc.go:25) — the LevelDB-style
+masking.  We must write the identical 4 bytes into the needle body.
+
+The hot path uses the C++ native library (hardware CRC32C via SSE4.2) when
+available; this module is the always-present fallback: a numpy slicing-by-8
+table implementation, plus the masking helpers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+_CASTAGNOLI = 0x82F63B78  # reflected polynomial
+
+
+@functools.cache
+def _tables() -> np.ndarray:
+    """Slicing-by-8 tables, shape (8, 256) uint32."""
+    t = np.zeros((8, 256), dtype=np.uint32)
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ (_CASTAGNOLI if crc & 1 else 0)
+        t[0, i] = crc
+    for k in range(1, 8):
+        for i in range(256):
+            t[k, i] = (int(t[k - 1, i]) >> 8) ^ int(t[0, int(t[k - 1, i]) & 0xFF])
+    return t
+
+
+def update(crc: int, data: bytes | np.ndarray) -> int:
+    """crc32c update (unmasked), matching crc32.Update over the Castagnoli table."""
+    try:
+        from ..native import lib as _native
+
+        if _native.available():
+            return _native.crc32c_update(crc, bytes(data))
+    except Exception:
+        pass
+    t = _tables()
+    buf = np.frombuffer(bytes(data), dtype=np.uint8)
+    crc = crc ^ 0xFFFFFFFF
+    n = len(buf) - (len(buf) % 8)
+    i = 0
+    t0, t1, t2, t3, t4, t5, t6, t7 = (t[k] for k in range(8))
+    while i < n:
+        b = buf[i : i + 8]
+        low = crc ^ (int(b[0]) | int(b[1]) << 8 | int(b[2]) << 16 | int(b[3]) << 24)
+        crc = (
+            int(t7[low & 0xFF])
+            ^ int(t6[(low >> 8) & 0xFF])
+            ^ int(t5[(low >> 16) & 0xFF])
+            ^ int(t4[(low >> 24) & 0xFF])
+            ^ int(t3[int(b[4])])
+            ^ int(t2[int(b[5])])
+            ^ int(t1[int(b[6])])
+            ^ int(t0[int(b[7])])
+        )
+        i += 8
+    t0_ = t[0]
+    while i < len(buf):
+        crc = (crc >> 8) ^ int(t0_[(crc ^ int(buf[i])) & 0xFF])
+        i += 1
+    return (crc ^ 0xFFFFFFFF) & 0xFFFFFFFF
+
+
+def checksum(data: bytes | np.ndarray) -> int:
+    """Unmasked crc32c of a buffer (NewCRC(b) in the reference)."""
+    return update(0, data)
+
+
+def mask(crc: int) -> int:
+    """The stored on-disk value: rotr(crc, 15) + 0xa282ead8 (mod 2^32)."""
+    rot = ((crc >> 15) | (crc << 17)) & 0xFFFFFFFF
+    return (rot + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def value(data: bytes | np.ndarray) -> int:
+    """Masked checksum as written into needle records."""
+    return mask(checksum(data))

@@ -1,0 +1,216 @@
+"""GF(2^8) arithmetic and Reed-Solomon generator-matrix construction.
+
+The field is GF(2^8) with the reduction polynomial x^8+x^4+x^3+x^2+1 (0x11D)
+and generator element 2 — the same field used by the Backblaze/klauspost
+Reed-Solomon lineage that the reference depends on
+(reference: go.mod:52 `github.com/klauspost/reedsolomon v1.9.2`, called from
+weed/storage/erasure_coding/ec_encoder.go:198).  The generator matrix here is
+constructed with the identical algorithm (Vandermonde rows `r^c`, then
+normalised so the top square is the identity) so that parity output is
+byte-identical to the reference codec.
+
+Everything in this module is plain numpy on the host: matrix construction and
+inversion involve at most 14x10 elements and are never on the hot path.  The
+hot paths live in rs_cpu.py (numpy/C++ bulk codec) and rs_jax.py (TPU codec).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+FIELD_SIZE = 256
+POLY = 0x11D  # x^8 + x^4 + x^3 + x^2 + 1
+
+
+def _generate_tables() -> tuple[np.ndarray, np.ndarray]:
+    """Build exp/log tables for GF(2^8) with generator 2."""
+    exp = np.zeros(255, dtype=np.uint8)
+    log = np.zeros(256, dtype=np.int32)
+    x = 1
+    for i in range(255):
+        exp[i] = x
+        log[x] = i
+        x <<= 1
+        if x & 0x100:
+            x ^= POLY
+    log[0] = -1  # undefined; never read for 0
+    return exp, log
+
+
+EXP_TABLE, LOG_TABLE = _generate_tables()
+
+
+@functools.cache
+def mul_table() -> np.ndarray:
+    """Full 256x256 GF multiplication table (64KB), uint8."""
+    a = np.arange(256, dtype=np.int32)
+    la = LOG_TABLE[a]
+    t = np.zeros((256, 256), dtype=np.uint8)
+    # t[a, b] = exp[(log a + log b) % 255], 0 if either is 0
+    s = (la[:, None] + la[None, :]) % 255
+    t = EXP_TABLE[s]
+    t[0, :] = 0
+    t[:, 0] = 0
+    return t
+
+
+def gf_mul(a: int, b: int) -> int:
+    if a == 0 or b == 0:
+        return 0
+    return int(EXP_TABLE[(int(LOG_TABLE[a]) + int(LOG_TABLE[b])) % 255])
+
+
+def gf_div(a: int, b: int) -> int:
+    if b == 0:
+        raise ZeroDivisionError("GF(2^8) division by zero")
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(int(LOG_TABLE[a]) - int(LOG_TABLE[b])) % 255])
+
+
+def gf_inv(a: int) -> int:
+    return gf_div(1, a)
+
+
+def gf_exp(a: int, n: int) -> int:
+    """a**n in GF(2^8), matching the reference codec's galExp semantics:
+    n==0 -> 1 (even for a==0); a==0 -> 0 otherwise."""
+    if n == 0:
+        return 1
+    if a == 0:
+        return 0
+    return int(EXP_TABLE[(int(LOG_TABLE[a]) * n) % 255])
+
+
+# ---------------------------------------------------------------------------
+# Matrix algebra over GF(2^8).  Matrices are small numpy uint8 2-D arrays.
+# ---------------------------------------------------------------------------
+
+
+def mat_mul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """GF matrix product (small matrices, host side)."""
+    assert a.shape[1] == b.shape[0]
+    t = mul_table()
+    out = np.zeros((a.shape[0], b.shape[1]), dtype=np.uint8)
+    for i in range(a.shape[0]):
+        # XOR-accumulate products of row i with every column
+        prods = t[a[i][:, None], b]  # (k, n)
+        out[i] = np.bitwise_xor.reduce(prods, axis=0)
+    return out
+
+
+def mat_identity(n: int) -> np.ndarray:
+    m = np.zeros((n, n), dtype=np.uint8)
+    np.fill_diagonal(m, 1)
+    return m
+
+
+def mat_inv(m: np.ndarray) -> np.ndarray:
+    """Invert a square GF(2^8) matrix by Gauss-Jordan elimination."""
+    n = m.shape[0]
+    assert m.shape == (n, n)
+    work = np.concatenate([m.astype(np.uint8), mat_identity(n)], axis=1)
+    t = mul_table()
+    for col in range(n):
+        # pivot
+        if work[col, col] == 0:
+            for r in range(col + 1, n):
+                if work[r, col] != 0:
+                    work[[col, r]] = work[[r, col]]
+                    break
+            else:
+                raise np.linalg.LinAlgError("singular GF(2^8) matrix")
+        pivot = int(work[col, col])
+        if pivot != 1:
+            inv_p = gf_inv(pivot)
+            work[col] = t[inv_p, work[col]]
+        for r in range(n):
+            if r != col and work[r, col] != 0:
+                factor = int(work[r, col])
+                work[r] ^= t[factor, work[col]]
+    return work[:, n:].copy()
+
+
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    """vm[r, c] = r**c in GF(2^8) — the reference codec's starting matrix."""
+    vm = np.zeros((rows, cols), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            vm[r, c] = gf_exp(r, c)
+    return vm
+
+
+@functools.cache
+def rs_matrix(data_shards: int, total_shards: int) -> np.ndarray:
+    """The (total x data) encoding matrix whose top square is the identity.
+
+    This reproduces the reference codec's default matrix (Vandermonde
+    normalised by the inverse of its top square), so parity shards are
+    byte-identical to the klauspost/reedsolomon output consumed by
+    weed/storage/erasure_coding.
+    """
+    vm = vandermonde(total_shards, data_shards)
+    top_inv = mat_inv(vm[:data_shards])
+    m = mat_mul(vm, top_inv)
+    m.setflags(write=False)
+    return m
+
+
+@functools.cache
+def rs_parity_matrix(data_shards: int, parity_shards: int) -> np.ndarray:
+    """Just the parity rows: (parity x data)."""
+    m = rs_matrix(data_shards, data_shards + parity_shards)
+    p = m[data_shards:].copy()
+    p.setflags(write=False)
+    return p
+
+
+@functools.cache
+def cauchy_matrix(data_shards: int, total_shards: int) -> np.ndarray:
+    """Cauchy-style alternative (the reference codec's WithCauchyMatrix option)."""
+    m = np.zeros((total_shards, data_shards), dtype=np.uint8)
+    m[:data_shards] = mat_identity(data_shards)
+    for r in range(data_shards, total_shards):
+        for c in range(data_shards):
+            m[r, c] = gf_inv(r ^ c)
+    m.setflags(write=False)
+    return m
+
+
+def decode_matrix_for(
+    matrix: np.ndarray, data_shards: int, present: list[int]
+) -> np.ndarray:
+    """Given >=data_shards present shard row indices, return the (data x data)
+    matrix that maps the first `data_shards` present shards back to the data
+    shards.  Rows of `matrix` correspond to shard ids."""
+    if len(present) < data_shards:
+        raise ValueError(
+            f"need {data_shards} shards to decode, have {len(present)}"
+        )
+    rows = matrix[np.asarray(present[:data_shards], dtype=np.int64)]
+    return mat_inv(rows)
+
+
+def bit_matrix(matrix: np.ndarray) -> np.ndarray:
+    """Expand a GF(2^8) matrix (R, C) into its GF(2) bit form (8R, 8C).
+
+    Output bit k of output byte i is the XOR over input bytes j and input bits
+    l of  A[8i+k, 8j+l] & input_bit[j, l],  where
+    A[8i+k, 8j+l] = bit k of (matrix[i, j] * 2^l).
+
+    This is what turns the GF matmul into a plain integer matmul (+ parity) on
+    the TPU MXU: unpack bytes to bits, int8 matmul with A, take &1, repack.
+    """
+    r, c = matrix.shape
+    t = mul_table()
+    a = np.zeros((8 * r, 8 * c), dtype=np.uint8)
+    for i in range(r):
+        for j in range(c):
+            g = int(matrix[i, j])
+            for l in range(8):
+                prod = int(t[g, (1 << l)])
+                for k in range(8):
+                    a[8 * i + k, 8 * j + l] = (prod >> k) & 1
+    return a

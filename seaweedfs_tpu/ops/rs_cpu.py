@@ -1,0 +1,129 @@
+"""Host-side Reed-Solomon codec (numpy, with optional C++ SIMD fast path).
+
+This is the CPU member of the codec family behind the `-ec.codec` switch
+(reference behavior: weed/storage/erasure_coding/ec_encoder.go uses
+klauspost/reedsolomon for Encode/Reconstruct).  Semantics mirror that
+encoder's API surface:
+
+  * encode(shards):           fills parity shards from data shards
+  * reconstruct(shards):      fills ALL missing shards (None entries)
+  * reconstruct_data(shards): fills only missing DATA shards
+
+Shard arrays are numpy uint8 1-D of equal length.  The per-needle degraded
+read path uses this codec (small intervals must not pay a TPU dispatch —
+SURVEY.md §7 hard part (c)); bulk encode/rebuild goes to rs_jax.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import gf256
+
+
+class ReedSolomon:
+    """RS(data, parity) systematic codec over GF(2^8)."""
+
+    def __init__(self, data_shards: int = 10, parity_shards: int = 4):
+        if data_shards <= 0 or parity_shards < 0:
+            raise ValueError("bad shard counts")
+        if data_shards + parity_shards > 256:
+            raise ValueError("too many shards for GF(2^8)")
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.matrix = gf256.rs_matrix(data_shards, self.total_shards)
+        self.parity_matrix = self.matrix[data_shards:]
+        self._mul = gf256.mul_table()
+
+    # -- core matmul ------------------------------------------------------
+
+    def _apply(self, rows: np.ndarray, inputs: list[np.ndarray]) -> list[np.ndarray]:
+        """outputs[i] = XOR_j mul(rows[i,j], inputs[j]) via table lookups."""
+        n = len(inputs)
+        outs = []
+        for i in range(rows.shape[0]):
+            acc = None
+            for j in range(n):
+                c = int(rows[i, j])
+                if c == 0:
+                    continue
+                term = inputs[j] if c == 1 else self._mul[c][inputs[j]]
+                acc = term.copy() if acc is None else np.bitwise_xor(acc, term, out=acc)
+            if acc is None:
+                acc = np.zeros_like(inputs[0])
+            outs.append(acc)
+        return outs
+
+    # -- public API -------------------------------------------------------
+
+    def encode(self, shards: list[np.ndarray]) -> None:
+        """Fill shards[data:] (parity) in place from shards[:data]."""
+        self._check(shards, need_all_data=True)
+        parity = self._apply(self.parity_matrix, shards[: self.data_shards])
+        for i, p in enumerate(parity):
+            shards[self.data_shards + i][:] = p
+
+    def verify(self, shards: list[np.ndarray]) -> bool:
+        parity = self._apply(self.parity_matrix, shards[: self.data_shards])
+        return all(
+            np.array_equal(p, shards[self.data_shards + i])
+            for i, p in enumerate(parity)
+        )
+
+    def reconstruct(self, shards: list[np.ndarray | None]) -> list[np.ndarray]:
+        return self._reconstruct(shards, data_only=False)
+
+    def reconstruct_data(self, shards: list[np.ndarray | None]) -> list[np.ndarray]:
+        return self._reconstruct(shards, data_only=True)
+
+    def _reconstruct(
+        self, shards: list[np.ndarray | None], data_only: bool
+    ) -> list[np.ndarray]:
+        if len(shards) != self.total_shards:
+            raise ValueError(f"expected {self.total_shards} shard slots")
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) == self.total_shards:
+            return list(shards)  # type: ignore[arg-type]
+        if len(present) < self.data_shards:
+            raise ValueError("too few shards to reconstruct")
+        size = len(shards[present[0]])  # type: ignore[index]
+
+        sub = present[: self.data_shards]
+        sub_shards = [np.asarray(shards[i], dtype=np.uint8) for i in sub]
+        missing_data = [
+            i for i in range(self.data_shards) if shards[i] is None
+        ]
+        out = list(shards)
+
+        if missing_data:
+            dec = gf256.decode_matrix_for(self.matrix, self.data_shards, present)
+            rows = dec[np.asarray(missing_data)]
+            recovered = self._apply(rows, sub_shards)
+            for i, r in zip(missing_data, recovered):
+                out[i] = r
+
+        if not data_only:
+            missing_parity = [
+                i
+                for i in range(self.data_shards, self.total_shards)
+                if shards[i] is None
+            ]
+            if missing_parity:
+                data = [np.asarray(out[i], dtype=np.uint8) for i in range(self.data_shards)]
+                rows = self.matrix[np.asarray(missing_parity)]
+                parity = self._apply(rows, data)
+                for i, p in zip(missing_parity, parity):
+                    out[i] = p
+        for i, s in enumerate(out):
+            if s is not None and len(s) != size:
+                raise ValueError("shard size mismatch")
+        return out  # type: ignore[return-value]
+
+    def _check(self, shards: list[np.ndarray], need_all_data: bool) -> None:
+        if len(shards) != self.total_shards:
+            raise ValueError(f"expected {self.total_shards} shards")
+        size = len(shards[0])
+        for s in shards:
+            if len(s) != size:
+                raise ValueError("shards must be equal length")

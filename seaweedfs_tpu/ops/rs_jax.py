@@ -1,0 +1,207 @@
+"""TPU-native Reed-Solomon codec: GF(2^8) matmul as JAX/XLA programs.
+
+This replaces the reference's SIMD-assembly GF kernel (klauspost/reedsolomon,
+the hot loop at weed/storage/erasure_coding/ec_encoder.go:179
+`enc.Encode(buffers)`) with two TPU formulations:
+
+1. ``xor`` (VPU): GF multiply distributes over the bit decomposition of the
+   constant:  c*x = XOR_{k: bit k of c} (2^k * x).  We compute the eight
+   doubling multiples 2^k*x once per input shard (a fused chain of shifts and
+   conditional reductions by 0x1D) and XOR together the multiples selected by
+   the generator matrix.  With the matrix baked in at trace time XLA constant-
+   folds the selection into a static XOR network and fuses the whole encode
+   into one elementwise kernel: 10 streams in, 4 streams out, no
+   intermediates in HBM.
+
+2. ``mxu`` (systolic array): over GF(2) the codec is linear in *bits*, so
+   unpack bytes to bit-planes, multiply by the 8Rx8C 0/1 matrix of
+   ``gf256.bit_matrix`` as an int8 matmul (int32 accumulation), take parity
+   (&1), and repack.  256 MACs/byte keeps the MXU busy and the op
+   HBM-bandwidth-bound.
+
+Both are shape-polymorphic in the block length B and are reused by the
+multi-volume sharded encoder in seaweedfs_tpu.parallel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import gf256
+
+_REDUCE = 0x1D  # low byte of the field polynomial 0x11D
+
+
+def _multiples(data: jax.Array) -> list[jax.Array]:
+    """[data * 2^k for k in 0..7] — the doubling chain in GF(2^8).
+
+    data: uint8 (..., B).  Each step: x*2 = (x << 1) ^ (0x1D if x & 0x80).
+    """
+    ms = [data]
+    x = data
+    for _ in range(7):
+        hi = x >> 7  # 0 or 1
+        x = ((x << 1) ^ (hi * jnp.uint8(_REDUCE))).astype(jnp.uint8)
+        ms.append(x)
+    return ms
+
+
+def _xor_network(rows: tuple[tuple[int, ...], ...], data: jax.Array) -> jax.Array:
+    """Apply a constant GF matrix to (S, B) data via the XOR network."""
+    ms = _multiples(data)
+    outs = []
+    for row in rows:
+        acc = None
+        for j, c in enumerate(row):
+            for k in range(8):
+                if (c >> k) & 1:
+                    term = ms[k][j]
+                    acc = term if acc is None else acc ^ term
+        outs.append(acc if acc is not None else jnp.zeros_like(data[0]))
+    return jnp.stack(outs)
+
+
+@functools.lru_cache(maxsize=None)
+def make_apply_xor(rows: tuple[tuple[int, ...], ...]):
+    """Jitted (S, B) uint8 -> (R, B) uint8 GF matmul with baked constants."""
+
+    @jax.jit
+    def apply(data: jax.Array) -> jax.Array:
+        return _xor_network(rows, data)
+
+    return apply
+
+
+@functools.lru_cache(maxsize=None)
+def make_apply_mxu(rows: tuple[tuple[int, ...], ...]):
+    """Jitted GF matmul on the MXU via the bit-plane int8 matmul."""
+    m = np.array(rows, dtype=np.uint8)
+    a = gf256.bit_matrix(m).astype(np.int8)  # (8R, 8S)
+
+    @jax.jit
+    def apply(data: jax.Array) -> jax.Array:
+        s, b = data.shape
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        bits = ((data[:, None, :] >> shifts[None, :, None]) & 1).astype(jnp.int8)
+        bits = bits.reshape(s * 8, b)
+        acc = jax.lax.dot_general(
+            jnp.asarray(a),
+            bits,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # (8R, B)
+        pbits = (acc & 1).astype(jnp.uint8).reshape(-1, 8, b)
+        out = pbits[:, 0, :]
+        for k in range(1, 8):
+            out = out | (pbits[:, k, :] << k)
+        return out
+
+    return apply
+
+
+def _rows_of(matrix: np.ndarray) -> tuple[tuple[int, ...], ...]:
+    return tuple(tuple(int(c) for c in row) for row in np.asarray(matrix))
+
+
+def apply_matrix(
+    matrix: np.ndarray, data: jax.Array, impl: str = "xor"
+) -> jax.Array:
+    """GF matmul: (R, S) constant matrix x (S, B) device data -> (R, B)."""
+    rows = _rows_of(matrix)
+    fn = make_apply_xor(rows) if impl == "xor" else make_apply_mxu(rows)
+    return fn(data)
+
+
+class ReedSolomonTPU:
+    """RS(data, parity) codec running the GF matmul on the accelerator.
+
+    API mirrors ops.rs_cpu.ReedSolomon (encode / reconstruct /
+    reconstruct_data over lists of equal-length uint8 numpy arrays), plus
+    device-resident entry points (encode_device) used by the streaming file
+    encoder and the multi-volume mesh pipeline.
+    """
+
+    def __init__(
+        self,
+        data_shards: int = 10,
+        parity_shards: int = 4,
+        impl: str = "xor",
+    ):
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self.impl = impl
+        self.matrix = gf256.rs_matrix(data_shards, self.total_shards)
+        self._parity_rows = _rows_of(self.matrix[data_shards:])
+
+    # -- device-resident --------------------------------------------------
+
+    def encode_device(self, data: jax.Array) -> jax.Array:
+        """(data_shards, B) uint8 on device -> (parity_shards, B) parity."""
+        fn = (
+            make_apply_xor(self._parity_rows)
+            if self.impl == "xor"
+            else make_apply_mxu(self._parity_rows)
+        )
+        return fn(data)
+
+    def apply_rows_device(self, rows: np.ndarray, inputs: jax.Array) -> jax.Array:
+        """Arbitrary GF matrix application (used for decode/rebuild)."""
+        return apply_matrix(rows, inputs, self.impl)
+
+    # -- numpy convenience (same shapes as rs_cpu) ------------------------
+
+    def encode(self, shards: list[np.ndarray]) -> None:
+        data = np.stack(shards[: self.data_shards])
+        parity = np.asarray(self.encode_device(jnp.asarray(data)))
+        for i in range(self.parity_shards):
+            shards[self.data_shards + i][:] = parity[i]
+
+    def _reconstruct(self, shards, data_only: bool):
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) == self.total_shards:
+            return list(shards)
+        if len(present) < self.data_shards:
+            raise ValueError("too few shards to reconstruct")
+        sub = present[: self.data_shards]
+        inputs = jnp.asarray(np.stack([shards[i] for i in sub]))
+        out = list(shards)
+        missing_data = [i for i in range(self.data_shards) if shards[i] is None]
+        if missing_data:
+            dec = gf256.decode_matrix_for(self.matrix, self.data_shards, present)
+            rows = dec[np.asarray(missing_data)]
+            rec = np.asarray(self.apply_rows_device(rows, inputs))
+            for i, r in zip(missing_data, rec):
+                out[i] = r
+        if not data_only:
+            missing_parity = [
+                i for i in range(self.data_shards, self.total_shards)
+                if shards[i] is None
+            ]
+            if missing_parity:
+                data = jnp.asarray(
+                    np.stack([np.asarray(out[i]) for i in range(self.data_shards)])
+                )
+                rows = self.matrix[np.asarray(missing_parity)]
+                par = np.asarray(self.apply_rows_device(rows, data))
+                for i, p in zip(missing_parity, par):
+                    out[i] = p
+        return out
+
+    def reconstruct(self, shards):
+        return self._reconstruct(shards, data_only=False)
+
+    def reconstruct_data(self, shards):
+        return self._reconstruct(shards, data_only=True)
+
+    def verify(self, shards: list[np.ndarray]) -> bool:
+        data = np.stack(shards[: self.data_shards])
+        parity = np.asarray(self.encode_device(jnp.asarray(data)))
+        return all(
+            np.array_equal(parity[i], shards[self.data_shards + i])
+            for i in range(self.parity_shards)
+        )

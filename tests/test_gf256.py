@@ -1,0 +1,111 @@
+"""Field algebra and generator-matrix construction tests.
+
+The matrix checks pin the klauspost/reedsolomon-compatible construction
+(Vandermonde normalised to systematic form) that byte-identical parity
+depends on (reference: weed/storage/erasure_coding/ec_encoder.go:198).
+"""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf256
+
+
+def test_field_axioms():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert gf256.gf_mul(a, b) == gf256.gf_mul(b, a)
+        assert gf256.gf_mul(a, gf256.gf_mul(b, c)) == gf256.gf_mul(
+            gf256.gf_mul(a, b), c
+        )
+        # distributive over XOR
+        assert gf256.gf_mul(a, b ^ c) == gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+    for a in range(1, 256):
+        assert gf256.gf_mul(a, gf256.gf_inv(a)) == 1
+        assert gf256.gf_div(gf256.gf_mul(a, 7), 7) == a
+
+
+def test_known_products():
+    # 2*0x80 wraps through the polynomial 0x11D -> 0x1D
+    assert gf256.gf_mul(2, 0x80) == 0x1D
+    assert gf256.gf_mul(3, 4) == 12
+    assert gf256.gf_mul(7, 7) == 21
+    assert gf256.gf_mul(23, 45) == 41  # klauspost galois test vector
+
+
+def test_exp_table_is_standard():
+    # First powers of the generator 2 with poly 0x11D
+    assert list(gf256.EXP_TABLE[:10]) == [1, 2, 4, 8, 16, 32, 64, 128, 0x1D, 0x3A]
+    assert gf256.gf_exp(2, 254) == gf256.gf_inv(2)
+    assert gf256.gf_exp(0, 0) == 1
+    assert gf256.gf_exp(0, 5) == 0
+
+
+def test_matrix_inverse_roundtrip():
+    rng = np.random.default_rng(1)
+    for n in (1, 2, 5, 10):
+        while True:
+            m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                inv = gf256.mat_inv(m)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        assert np.array_equal(gf256.mat_mul(m, inv), gf256.mat_identity(n))
+
+
+def test_rs_matrix_systematic():
+    m = gf256.rs_matrix(10, 14)
+    assert m.shape == (14, 10)
+    assert np.array_equal(m[:10], gf256.mat_identity(10))
+    # Any 10 rows must be invertible (MDS property of the construction)
+    rng = np.random.default_rng(2)
+    for _ in range(20):
+        rows = sorted(rng.choice(14, 10, replace=False).tolist())
+        gf256.mat_inv(m[np.array(rows)])  # raises if singular
+
+
+def test_rs_matrix_known_values():
+    # For RS(2,2): vandermonde(4,2) = [[1,0],[1,1],[1,2],[1,3]]; the top
+    # square [[1,0],[1,1]] is its own inverse, so the parity rows come out as
+    # [1,2]*inv = [3,2] and [1,3]*inv = [2,3].
+    m = gf256.rs_matrix(2, 4)
+    assert np.array_equal(
+        m, np.array([[1, 0], [0, 1], [3, 2], [2, 3]], dtype=np.uint8)
+    )
+
+
+def test_decode_matrix():
+    m = gf256.rs_matrix(10, 14)
+    present = [0, 2, 3, 4, 5, 6, 7, 8, 9, 10, 12]  # shard 1 missing
+    dec = gf256.decode_matrix_for(m, 10, present)
+    # dec * rows(present[:10]) == I, so dec recovers data from those shards
+    assert np.array_equal(
+        gf256.mat_mul(dec, m[np.array(present[:10])]), gf256.mat_identity(10)
+    )
+    with pytest.raises(ValueError):
+        gf256.decode_matrix_for(m, 10, list(range(9)))
+
+
+def test_bit_matrix_linearization():
+    m = gf256.rs_parity_matrix(10, 4)
+    a = gf256.bit_matrix(m)
+    assert a.shape == (32, 80)
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, (10, 64)).astype(np.uint8)
+    # reference: table-lookup GF matmul
+    t = gf256.mul_table()
+    expect = np.zeros((4, 64), dtype=np.uint8)
+    for i in range(4):
+        acc = np.zeros(64, dtype=np.uint8)
+        for j in range(10):
+            acc ^= t[m[i, j]][data[j]]
+        expect[i] = acc
+    # bit-plane integer matmul + parity
+    bits = ((data[:, None, :] >> np.arange(8)[None, :, None]) & 1).reshape(80, 64)
+    pbits = (a.astype(np.int32) @ bits.astype(np.int32)) & 1
+    got = np.zeros((4, 64), dtype=np.uint8)
+    for k in range(8):
+        got |= (pbits.reshape(4, 8, 64)[:, k, :] << k).astype(np.uint8)
+    assert np.array_equal(got, expect)
