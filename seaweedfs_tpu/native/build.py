@@ -19,16 +19,20 @@ def build(force: bool = False) -> str:
         os.path.getmtime(_OUT) >= os.path.getmtime(_SRC)
     ):
         return _OUT
+    # compile to a process-unique temp path, then atomically rename: a
+    # concurrent process never dlopens a half-written .so
+    tmp = f"{_OUT}.{os.getpid()}.tmp"
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-march=native",
-        _SRC, "-o", _OUT,
+        _SRC, "-o", tmp,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
     except (subprocess.CalledProcessError, FileNotFoundError, subprocess.TimeoutExpired):
         # retry without -march=native (portable baseline)
-        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _OUT]
+        cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    os.replace(tmp, _OUT)
     return _OUT
 
 

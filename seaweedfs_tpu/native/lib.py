@@ -69,21 +69,24 @@ def crc32c_update(crc: int, data: bytes) -> int:
 
 
 def gf_apply(matrix_rows, inputs: list[bytes], out_count: int) -> list[bytearray]:
-    """Apply (R,S) GF matrix to S equal-length buffers -> R buffers."""
+    """Apply (R,S) GF matrix to S equal-length buffers -> R buffers.
+
+    ``inputs`` entries must be bytes objects; they are passed by pointer
+    (ctypes does not copy bytes for c_char_p), so this is zero-copy in.
+    """
     lib = _load()
     assert lib is not None
     import numpy as np
 
     m = np.ascontiguousarray(matrix_rows, dtype=np.uint8)
     r, s = m.shape
+    if r != out_count:
+        raise ValueError(f"matrix has {r} rows, caller expected {out_count}")
+    if len(inputs) != s:
+        raise ValueError(f"matrix has {s} cols, got {len(inputs)} inputs")
     n = len(inputs[0])
     outs = [bytearray(n) for _ in range(r)]
-    in_ptrs = (ctypes.c_char_p * s)(*[
-        ctypes.cast(
-            (ctypes.c_char * n).from_buffer_copy(b), ctypes.c_char_p
-        )
-        for b in inputs
-    ])
+    in_ptrs = (ctypes.c_char_p * s)(*inputs)
     out_bufs = [(ctypes.c_char * n).from_buffer(o) for o in outs]
     out_ptrs = (ctypes.c_char_p * r)(
         *[ctypes.cast(ob, ctypes.c_char_p) for ob in out_bufs]

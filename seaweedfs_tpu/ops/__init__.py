@@ -1,3 +1,6 @@
 from . import crc32c, gf256  # noqa: F401
 from .codec import DATA_SHARDS, PARITY_SHARDS, TOTAL_SHARDS, get_codec  # noqa: F401
 from .rs_cpu import ReedSolomon  # noqa: F401
+
+# NOTE: rs_jax (and thus jax) is intentionally NOT imported here — the
+# CPU-only needle path must stay importable and cheap without jax.

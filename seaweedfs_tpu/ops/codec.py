@@ -3,12 +3,15 @@
 The reference hardwires klauspost/reedsolomon; here every consumer (file
 encoder, degraded reads, gRPC handlers, shell commands) goes through
 ``get_codec`` so the backend is a deployment choice.
+
+The TPU codec is imported lazily: the CPU-only per-needle path (storage
+servers doing small degraded reads) must not pay a jax import, and must work
+on hosts without jax at all.
 """
 
 from __future__ import annotations
 
 from .rs_cpu import ReedSolomon
-from .rs_jax import ReedSolomonTPU
 
 DATA_SHARDS = 10
 PARITY_SHARDS = 4
@@ -21,7 +24,11 @@ def get_codec(name: str = "cpu", data_shards: int = DATA_SHARDS,
     if name in ("cpu", "go", "numpy"):
         return ReedSolomon(data_shards, parity_shards)
     if name in ("tpu", "jax", "tpu_xor"):
+        from .rs_jax import ReedSolomonTPU
+
         return ReedSolomonTPU(data_shards, parity_shards, impl="xor")
     if name in ("tpu_mxu", "mxu"):
+        from .rs_jax import ReedSolomonTPU
+
         return ReedSolomonTPU(data_shards, parity_shards, impl="mxu")
     raise ValueError(f"unknown ec codec {name!r}")

@@ -57,6 +57,20 @@ class ReedSolomon:
 
     # -- public API -------------------------------------------------------
 
+    def parity_of(self, data: np.ndarray) -> np.ndarray:
+        """(data_shards, B) -> (parity_shards, B), the bulk-pipeline entry."""
+        assert data.shape[0] == self.data_shards
+        from ..native import lib as native
+
+        if native.available():
+            outs = native.gf_apply(
+                self.parity_matrix,
+                [np.ascontiguousarray(row).tobytes() for row in data],
+                self.parity_shards,
+            )
+            return np.stack([np.frombuffer(o, dtype=np.uint8) for o in outs])
+        return np.stack(self._apply(self.parity_matrix, list(data)))
+
     def encode(self, shards: list[np.ndarray]) -> None:
         """Fill shards[data:] (parity) in place from shards[:data]."""
         self._check(shards, need_all_data=True)

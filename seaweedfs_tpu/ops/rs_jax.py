@@ -153,6 +153,11 @@ class ReedSolomonTPU:
         """Arbitrary GF matrix application (used for decode/rebuild)."""
         return apply_matrix(rows, inputs, self.impl)
 
+    def parity_of(self, data: np.ndarray) -> np.ndarray:
+        """(data_shards, B) -> (parity_shards, B), the bulk-pipeline entry."""
+        assert data.shape[0] == self.data_shards
+        return np.asarray(self.encode_device(jnp.asarray(data)))
+
     # -- numpy convenience (same shapes as rs_cpu) ------------------------
 
     def encode(self, shards: list[np.ndarray]) -> None:
@@ -162,6 +167,8 @@ class ReedSolomonTPU:
             shards[self.data_shards + i][:] = parity[i]
 
     def _reconstruct(self, shards, data_only: bool):
+        if len(shards) != self.total_shards:
+            raise ValueError(f"expected {self.total_shards} shard slots")
         present = [i for i, s in enumerate(shards) if s is not None]
         if len(present) == self.total_shards:
             return list(shards)

@@ -1,0 +1,12 @@
+from . import types  # noqa: F401
+from .needle import Needle  # noqa: F401
+from .needle_map import NeedleMap, NeedleValue  # noqa: F401
+from .replica_placement import ReplicaPlacement  # noqa: F401
+from .super_block import (  # noqa: F401
+    CURRENT_VERSION,
+    VERSION1,
+    VERSION2,
+    VERSION3,
+    SuperBlock,
+)
+from .ttl import TTL  # noqa: F401

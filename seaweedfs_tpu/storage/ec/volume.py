@@ -1,0 +1,219 @@
+"""EC volume runtime: open shards + sorted index, needle reads with
+on-the-fly reconstruction, deletes via the `.ecj` journal.
+
+Reference: ec_volume.go (search/locate), ec_shard.go (shard ReadAt),
+ec_volume_delete.go (tombstone + journal), store_ec.go (degraded read).
+The remote-shard fetch hook lets the volume server plug in gRPC reads; a
+standalone EcVolume reconstructs from whatever local shards exist.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+from ...ops.codec import get_codec
+from .. import types as t
+from ..needle import Needle, actual_size
+from ..super_block import VERSION3
+from .constants import (
+    DATA_SHARDS,
+    LARGE_BLOCK_SIZE,
+    SMALL_BLOCK_SIZE,
+    TOTAL_SHARDS,
+    to_ext,
+)
+from .locate import Interval, locate_data
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+@dataclass
+class EcVolumeShard:
+    volume_id: int
+    shard_id: int
+    path: str
+
+    def __post_init__(self):
+        self._f = open(self.path, "rb")
+        self.size = os.path.getsize(self.path)
+
+    def read_at(self, offset: int, length: int) -> bytes:
+        self._f.seek(offset)
+        return self._f.read(length)
+
+    def close(self) -> None:
+        self._f.close()
+
+
+# fetch_fn(shard_id, offset, length) -> bytes | None  (e.g. a gRPC client)
+FetchFn = Callable[[int, int, int], "bytes | None"]
+
+
+class EcVolume:
+    """An erasure-coded volume: local shards + .ecx index + .ecj journal."""
+
+    def __init__(
+        self,
+        base_name: str,
+        volume_id: int = 0,
+        version: int = VERSION3,
+        codec_name: str = "cpu",
+        large_block_size: int = LARGE_BLOCK_SIZE,
+        small_block_size: int = SMALL_BLOCK_SIZE,
+    ):
+        self.base_name = base_name
+        self.volume_id = volume_id
+        self.version = version
+        self.codec = get_codec(codec_name)
+        self.large_block_size = large_block_size
+        self.small_block_size = small_block_size
+        self.shards: dict[int, EcVolumeShard] = {}
+        self._ecx = open(base_name + ".ecx", "r+b")
+        self.ecx_size = os.path.getsize(base_name + ".ecx")
+        self._ecj_lock = threading.Lock()
+        self.remote_fetch: FetchFn | None = None
+        for sid in range(TOTAL_SHARDS):
+            p = base_name + to_ext(sid)
+            if os.path.exists(p):
+                self.shards[sid] = EcVolumeShard(volume_id, sid, p)
+
+    # -- shard management -------------------------------------------------
+
+    def add_shard(self, shard_id: int) -> bool:
+        if shard_id in self.shards:
+            return False
+        p = self.base_name + to_ext(shard_id)
+        self.shards[shard_id] = EcVolumeShard(self.volume_id, shard_id, p)
+        return True
+
+    def delete_shard(self, shard_id: int) -> None:
+        sh = self.shards.pop(shard_id, None)
+        if sh:
+            sh.close()
+
+    @property
+    def shard_size(self) -> int:
+        return next(iter(self.shards.values())).size if self.shards else 0
+
+    def shard_ids(self) -> list[int]:
+        return sorted(self.shards)
+
+    def close(self) -> None:
+        for sh in self.shards.values():
+            sh.close()
+        self._ecx.close()
+
+    # -- index search (binary search over the sorted .ecx) ----------------
+
+    def find_needle_from_ecx(self, needle_id: int) -> tuple[int, int]:
+        """-> (actual_offset, size); raises NotFoundError."""
+        entry = self._search_ecx(needle_id)
+        if entry is None:
+            raise NotFoundError(f"needle {needle_id:x}")
+        _pos, offset, size = entry
+        return offset, size
+
+    def _search_ecx(self, needle_id: int) -> tuple[int, int, int] | None:
+        """-> (entry_file_pos, actual_offset, size) | None."""
+        lo, hi = 0, self.ecx_size // t.NEEDLE_MAP_ENTRY_SIZE
+        while lo < hi:
+            mid = (lo + hi) // 2
+            self._ecx.seek(mid * t.NEEDLE_MAP_ENTRY_SIZE)
+            buf = self._ecx.read(t.NEEDLE_MAP_ENTRY_SIZE)
+            key, offset, size = t.unpack_index_entry(buf)
+            if key == needle_id:
+                return mid * t.NEEDLE_MAP_ENTRY_SIZE, offset, size
+            if key < needle_id:
+                lo = mid + 1
+            else:
+                hi = mid
+        return None
+
+    # -- delete path ------------------------------------------------------
+
+    def delete_needle(self, needle_id: int) -> None:
+        """Tombstone the .ecx entry in place and append to the .ecj journal."""
+        entry = self._search_ecx(needle_id)
+        if entry is None:
+            return
+        pos, _offset, _size = entry
+        self._ecx.seek(pos + t.NEEDLE_ID_SIZE + t.OFFSET_SIZE)
+        self._ecx.write(t.size_to_bytes(t.TOMBSTONE_FILE_SIZE))
+        self._ecx.flush()
+        with self._ecj_lock:
+            with open(self.base_name + ".ecj", "ab") as j:
+                j.write(t.needle_id_to_bytes(needle_id))
+
+    # -- read path --------------------------------------------------------
+
+    def locate(self, needle_id: int) -> tuple[int, int, list[Interval]]:
+        offset, size = self.find_needle_from_ecx(needle_id)
+        dat_size = DATA_SHARDS * self.shard_size
+        intervals = locate_data(
+            self.large_block_size,
+            self.small_block_size,
+            dat_size,
+            offset,
+            actual_size(size, self.version),
+        )
+        return offset, size, intervals
+
+    def read_needle(self, needle_id: int) -> Needle:
+        offset, size, intervals = self.locate(needle_id)
+        if t.size_is_deleted(size):
+            raise NotFoundError(f"needle {needle_id:x} deleted")
+        blob = b"".join(self._read_interval(iv) for iv in intervals)
+        n = Needle.from_bytes(blob, self.version)
+        if n.id != needle_id:
+            raise NotFoundError(
+                f"needle id mismatch: want {needle_id:x} got {n.id:x}"
+            )
+        return n
+
+    def _read_interval(self, iv: Interval) -> bytes:
+        shard_id, off = iv.to_shard_id_and_offset(
+            self.large_block_size, self.small_block_size
+        )
+        return self.read_shard_interval(shard_id, off, iv.size)
+
+    def read_shard_interval(self, shard_id: int, offset: int, length: int) -> bytes:
+        # 1. local shard
+        sh = self.shards.get(shard_id)
+        if sh is not None:
+            return sh.read_at(offset, length)
+        # 2. remote shard via injected fetcher
+        if self.remote_fetch is not None:
+            data = self.remote_fetch(shard_id, offset, length)
+            if data is not None:
+                return data
+        # 3. degraded: reconstruct from any DATA_SHARDS other shards
+        return self._reconstruct_interval(shard_id, offset, length)
+
+    def _reconstruct_interval(self, shard_id: int, offset: int, length: int) -> bytes:
+        shards: list[np.ndarray | None] = [None] * TOTAL_SHARDS
+        have = 0
+        for sid in range(TOTAL_SHARDS):
+            if sid == shard_id or have >= DATA_SHARDS:
+                continue
+            sh = self.shards.get(sid)
+            buf = None
+            if sh is not None:
+                buf = sh.read_at(offset, length)
+            elif self.remote_fetch is not None:
+                buf = self.remote_fetch(sid, offset, length)
+            if buf is not None and len(buf) == length:
+                shards[sid] = np.frombuffer(buf, dtype=np.uint8)
+                have += 1
+        if have < DATA_SHARDS:
+            raise IOError(
+                f"shard {shard_id} interval unreadable: only {have} shards available"
+            )
+        rebuilt = self.codec.reconstruct(shards)
+        return np.asarray(rebuilt[shard_id], dtype=np.uint8).tobytes()

@@ -1,0 +1,59 @@
+"""`.idx` index files: a flat log of 16-byte (key, offset, size) entries.
+
+Reference: weed/storage/idx/walk.go.  Offsets are stored /8; size -1 (or the
+0xFFFFFFFF tombstone) marks deletion; a (0, 0) offset entry also deletes.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+from typing import Callable, Iterator
+
+from . import types as t
+
+
+def walk_index_blob(blob: bytes) -> Iterator[tuple[int, int, int]]:
+    """Yield (key, actual_offset, size) for every 16-byte entry."""
+    n = len(blob) - (len(blob) % t.NEEDLE_MAP_ENTRY_SIZE)
+    for i in range(0, n, t.NEEDLE_MAP_ENTRY_SIZE):
+        yield t.unpack_index_entry(blob[i : i + t.NEEDLE_MAP_ENTRY_SIZE])
+
+
+def walk_index_file(
+    path: str | os.PathLike,
+    fn: Callable[[int, int, int], None] | None = None,
+) -> list[tuple[int, int, int]]:
+    """Walk a .idx file; returns entries (and calls fn per entry if given)."""
+    out = []
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(t.NEEDLE_MAP_ENTRY_SIZE * 1024)
+            if not chunk:
+                break
+            for e in walk_index_blob(chunk):
+                if fn is not None:
+                    fn(*e)
+                out.append(e)
+    return out
+
+
+class IndexWriter:
+    """Append-only .idx writer."""
+
+    def __init__(self, path: str | os.PathLike):
+        self._f: io.BufferedWriter = open(path, "ab")
+
+    def put(self, key: int, actual_offset: int, size: int) -> None:
+        self._f.write(t.pack_index_entry(key, actual_offset, size))
+
+    def delete(self, key: int, actual_offset: int) -> None:
+        """Tombstone entry: offset of the delete marker, size -1."""
+        self._f.write(t.pack_index_entry(key, actual_offset, t.TOMBSTONE_FILE_SIZE))
+
+    def flush(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        self._f.close()

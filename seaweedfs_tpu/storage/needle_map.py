@@ -1,0 +1,121 @@
+"""In-memory needle maps: needle id -> (offset, size).
+
+The reference offers several kinds (compact two-level map, leveldb, sorted
+file — weed/storage/needle_map.go:13-19).  Here the in-memory kind is a dict
+plus sorted-key cache — idiomatic Python with the same observable behavior
+(live needles only; deletes drop entries; ascending visit for .ecx
+generation); the compact-section memory layout is a Go-ism we don't copy.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+from dataclasses import dataclass
+from typing import Callable, Iterator
+
+from . import idx as idx_mod
+from . import types as t
+
+
+@dataclass(frozen=True)
+class NeedleValue:
+    key: int
+    offset: int  # actual byte offset
+    size: int
+
+    def to_index_bytes(self) -> bytes:
+        return t.pack_index_entry(self.key, self.offset, self.size)
+
+
+class NeedleMap:
+    """Live-needle map with deleted-byte accounting, loadable from .idx."""
+
+    def __init__(self) -> None:
+        self._m: dict[int, NeedleValue] = {}
+        self._sorted_keys: list[int] | None = None
+        self.file_count = 0
+        self.deleted_count = 0
+        self.deleted_bytes = 0
+        self.maximum_key = 0
+
+    # -- mutation ---------------------------------------------------------
+
+    def put(self, key: int, offset: int, size: int) -> None:
+        old = self._m.get(key)
+        if old is not None and old.size > 0:
+            self.deleted_count += 1
+            self.deleted_bytes += old.size
+        self._m[key] = NeedleValue(key, offset, size)
+        self.file_count += 1
+        self.maximum_key = max(self.maximum_key, key)
+        self._sorted_keys = None
+
+    def delete(self, key: int) -> int:
+        old = self._m.pop(key, None)
+        if old is None:
+            return 0
+        self.deleted_count += 1
+        self.deleted_bytes += max(old.size, 0)
+        self._sorted_keys = None
+        return max(old.size, 0)
+
+    # -- lookup -----------------------------------------------------------
+
+    def get(self, key: int) -> NeedleValue | None:
+        return self._m.get(key)
+
+    def __contains__(self, key: int) -> bool:
+        return key in self._m
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+    @property
+    def content_size(self) -> int:
+        return sum(v.size for v in self._m.values() if v.size > 0)
+
+    # -- iteration --------------------------------------------------------
+
+    def ascending_visit(self, fn: Callable[[NeedleValue], None]) -> None:
+        for key in self.sorted_keys():
+            fn(self._m[key])
+
+    def sorted_keys(self) -> list[int]:
+        if self._sorted_keys is None:
+            self._sorted_keys = sorted(self._m)
+        return self._sorted_keys
+
+    def items_ascending(self) -> Iterator[NeedleValue]:
+        for k in self.sorted_keys():
+            yield self._m[k]
+
+    def next_key_after(self, key: int) -> int | None:
+        ks = self.sorted_keys()
+        i = bisect.bisect_right(ks, key)
+        return ks[i] if i < len(ks) else None
+
+    # -- persistence ------------------------------------------------------
+
+    @classmethod
+    def load_from_idx(cls, path: str | os.PathLike) -> "NeedleMap":
+        """Replay a .idx file: tombstones/zero offsets delete, else insert.
+
+        Mirrors readNeedleMap in the reference ec_encoder.go:289-306.
+        """
+        nm = cls()
+
+        def visit(key: int, offset: int, size: int) -> None:
+            if offset != 0 and not t.size_is_deleted(size):
+                nm.put(key, offset, size)
+            else:
+                nm.delete(key)
+
+        idx_mod.walk_index_file(path, visit)
+        return nm
+
+    def write_sorted_index(self, path: str | os.PathLike) -> None:
+        """Write entries in ascending key order (the .ecx format)."""
+        with open(path, "wb") as f:
+            for v in self.items_ascending():
+                f.write(v.to_index_bytes())

@@ -1,0 +1,170 @@
+"""Volume: one `.dat` needle log + `.idx` index + in-memory needle map.
+
+Reference behavior (weed/storage/volume.go, volume_write.go, volume_read.go,
+volume_checking.go): append-only writes under a lock, tombstone deletes (an
+empty needle marks deletion in the log, the index records size -1), CRC
+verification on read, and load-time integrity checking that truncates torn
+tail appends.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import types as t
+from .idx import IndexWriter, walk_index_file
+from .needle import Needle, actual_size, body_length
+from .needle_map import NeedleMap
+from .super_block import CURRENT_VERSION, SUPER_BLOCK_SIZE, SuperBlock
+
+
+class Volume:
+    def __init__(self, directory: str, collection: str, volume_id: int,
+                 super_block: SuperBlock | None = None):
+        self.directory = directory
+        self.collection = collection
+        self.volume_id = volume_id
+        self.read_only = False
+        self._lock = threading.RLock()
+        base = self.file_name()
+        is_new = not os.path.exists(base + ".dat")
+        self.super_block = super_block or SuperBlock()
+        self._dat = open(base + ".dat", "a+b")
+        if is_new:
+            self._dat.write(self.super_block.to_bytes())
+            self._dat.flush()
+        else:
+            self._dat.seek(0)
+            self.super_block = SuperBlock.from_bytes(self._dat.read(64))
+        self.version = self.super_block.version
+        self.needle_map = (
+            NeedleMap.load_from_idx(base + ".idx")
+            if os.path.exists(base + ".idx")
+            else NeedleMap()
+        )
+        self.check_and_fix_integrity()
+        self._idx = IndexWriter(base + ".idx")
+
+    # -- naming -----------------------------------------------------------
+
+    def file_name(self) -> str:
+        name = f"{self.volume_id}"
+        if self.collection:
+            name = f"{self.collection}_{name}"
+        return os.path.join(self.directory, name)
+
+    # -- write path -------------------------------------------------------
+
+    def append_needle(self, n: Needle) -> tuple[int, int]:
+        """Append; returns (actual_offset, stored_size)."""
+        with self._lock:
+            if self.read_only:
+                raise PermissionError(f"volume {self.volume_id} is read-only")
+            self._dat.seek(0, os.SEEK_END)
+            offset = self._dat.tell()
+            if offset % t.NEEDLE_PADDING_SIZE:  # heal torn tail
+                pad = t.NEEDLE_PADDING_SIZE - offset % t.NEEDLE_PADDING_SIZE
+                self._dat.write(b"\0" * pad)
+                offset += pad
+            if offset >= t.MAX_POSSIBLE_VOLUME_SIZE:
+                raise IOError("volume size limit exceeded")
+            if not n.append_at_ns:
+                n.append_at_ns = time.time_ns()
+            blob = n.to_bytes(self.version)
+            self._dat.write(blob)
+            self._dat.flush()
+            old = self.needle_map.get(n.id)
+            if old is None or old.offset < offset:
+                self.needle_map.put(n.id, offset, n.size)
+                self._idx.put(n.id, offset, n.size)
+            return offset, n.size
+
+    def delete_needle(self, needle_id: int) -> int:
+        """Append a tombstone marker needle; returns freed byte count."""
+        with self._lock:
+            existing = self.needle_map.get(needle_id)
+            if existing is None:
+                return 0
+            marker = Needle(id=needle_id, cookie=0, data=b"")
+            self._dat.seek(0, os.SEEK_END)
+            offset = self._dat.tell()
+            marker.append_at_ns = time.time_ns()
+            self._dat.write(marker.to_bytes(self.version))
+            self._dat.flush()
+            self.needle_map.delete(needle_id)
+            self._idx.delete(needle_id, offset)
+            return max(existing.size, 0)
+
+    # -- read path --------------------------------------------------------
+
+    def read_needle(self, needle_id: int, expected_cookie: int | None = None) -> Needle:
+        with self._lock:
+            nv = self.needle_map.get(needle_id)
+            if nv is None or t.size_is_deleted(nv.size):
+                raise KeyError(f"needle {needle_id:x} not found")
+            self._dat.seek(nv.offset)
+            blob = self._dat.read(actual_size(nv.size, self.version))
+        n = Needle.from_bytes(blob, self.version)
+        if n.size != nv.size:
+            raise IOError("size mismatch reading needle")
+        if expected_cookie is not None and n.cookie != expected_cookie:
+            raise PermissionError("cookie mismatch")
+        return n
+
+    # -- stats / lifecycle ------------------------------------------------
+
+    @property
+    def content_size(self) -> int:
+        self._dat.seek(0, os.SEEK_END)
+        return self._dat.tell()
+
+    def garbage_level(self) -> float:
+        size = self.content_size
+        return self.needle_map.deleted_bytes / size if size else 0.0
+
+    def file_count(self) -> int:
+        return len(self.needle_map)
+
+    def sync(self) -> None:
+        with self._lock:
+            self._dat.flush()
+            os.fsync(self._dat.fileno())
+            self._idx.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._dat.flush()
+            self._dat.close()
+            self._idx.close()
+
+    # -- integrity --------------------------------------------------------
+
+    def check_and_fix_integrity(self) -> None:
+        """Verify the last index entry matches the .dat; truncate torn tails.
+
+        Reference: CheckAndFixVolumeDataIntegrity (volume_checking.go:17) —
+        the last entry's record must lie fully inside the file and carry the
+        expected needle id; otherwise the torn tail is truncated away.
+        """
+        self._dat.seek(0, os.SEEK_END)
+        file_size = self._dat.tell()
+        last = None
+        for v in self.needle_map._m.values():
+            if last is None or v.offset > last.offset:
+                last = v
+        if last is None:
+            return
+        end = last.offset + actual_size(max(last.size, 0), self.version)
+        if end > file_size:
+            # torn append: drop the entry and truncate to the previous record
+            self.needle_map.delete(last.key)
+            self._dat.truncate(last.offset)
+            return
+        self._dat.seek(last.offset)
+        hdr = self._dat.read(t.NEEDLE_HEADER_SIZE)
+        if len(hdr) == t.NEEDLE_HEADER_SIZE:
+            n = Needle.parse_header(hdr)
+            if n.id != last.key:
+                self.needle_map.delete(last.key)

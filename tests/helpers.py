@@ -1,0 +1,37 @@
+"""Shared test fixtures: synthetic needle-log volumes."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from seaweedfs_tpu.storage import Needle, SuperBlock
+from seaweedfs_tpu.storage.needle import FLAG_HAS_MIME, FLAG_HAS_NAME
+from seaweedfs_tpu.storage.volume import Volume
+
+
+def make_volume(
+    directory: str,
+    volume_id: int = 1,
+    n_needles: int = 50,
+    seed: int = 0,
+    max_size: int = 2000,
+    collection: str = "",
+) -> Volume:
+    """Create a volume with random needles; returns the open Volume."""
+    rng = np.random.default_rng(seed)
+    vol = Volume(directory, collection, volume_id, super_block=SuperBlock())
+    for i in range(1, n_needles + 1):
+        size = int(rng.integers(1, max_size))
+        n = Needle(
+            cookie=int(rng.integers(0, 2**32)),
+            id=i,
+            data=rng.integers(0, 256, size).astype(np.uint8).tobytes(),
+        )
+        if i % 3 == 0:
+            n.set(FLAG_HAS_NAME)
+            n.name = f"file-{i}.bin".encode()
+        if i % 5 == 0:
+            n.set(FLAG_HAS_MIME)
+            n.mime = b"application/octet-stream"
+        vol.append_needle(n)
+    return vol

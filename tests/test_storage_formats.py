@@ -1,0 +1,183 @@
+"""On-disk format tests: needles, idx entries, superblock, ttl, replica
+placement — including parsing the reference's checked-in binary fixture
+(1.dat/1.idx) to pin byte compatibility with files written by the original
+implementation."""
+
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage import (
+    TTL,
+    Needle,
+    NeedleMap,
+    ReplicaPlacement,
+    SuperBlock,
+    types as t,
+)
+from seaweedfs_tpu.storage.needle import (
+    FLAG_HAS_LAST_MODIFIED,
+    FLAG_HAS_MIME,
+    FLAG_HAS_NAME,
+    FLAG_HAS_PAIRS,
+    FLAG_HAS_TTL,
+    actual_size,
+    padding_length,
+)
+from seaweedfs_tpu.storage.super_block import VERSION1, VERSION2, VERSION3
+from seaweedfs_tpu.storage.volume import Volume
+
+from helpers import make_volume
+
+REF_EC_DIR = "/root/reference/weed/storage/erasure_coding"
+
+
+def test_index_entry_roundtrip():
+    b = t.pack_index_entry(0xDEADBEEF12345678, 8 * 12345, 6789)
+    assert len(b) == 16
+    key, off, size = t.unpack_index_entry(b)
+    assert (key, off, size) == (0xDEADBEEF12345678, 8 * 12345, 6789)
+    # tombstone size round-trips as -1
+    b = t.pack_index_entry(1, 0, t.TOMBSTONE_FILE_SIZE)
+    assert t.unpack_index_entry(b)[2] == -1
+
+
+def test_offset_alignment_required():
+    with pytest.raises(ValueError):
+        t.offset_to_bytes(13)
+
+
+def test_padding_always_1_to_8():
+    for version in (VERSION1, VERSION2, VERSION3):
+        for size in range(0, 64):
+            p = padding_length(size, version)
+            assert 1 <= p <= 8
+            assert actual_size(size, version) % 8 == 0
+
+
+@pytest.mark.parametrize("version", [VERSION1, VERSION2, VERSION3])
+def test_needle_roundtrip(version):
+    n = Needle(cookie=0x12345678, id=0xABCDEF, data=b"hello world needle")
+    blob = n.to_bytes(version)
+    assert len(blob) % 8 == 0
+    m = Needle.from_bytes(blob, version)
+    assert m.id == n.id and m.cookie == n.cookie and m.data == n.data
+
+
+def test_needle_full_fields_v3():
+    n = Needle(cookie=7, id=99, data=b"x" * 100)
+    n.set(FLAG_HAS_NAME)
+    n.name = b"a.txt"
+    n.set(FLAG_HAS_MIME)
+    n.mime = b"text/plain"
+    n.set(FLAG_HAS_LAST_MODIFIED)
+    n.last_modified = 1234567890
+    n.set(FLAG_HAS_TTL)
+    n.ttl = TTL.parse("3d")
+    n.set(FLAG_HAS_PAIRS)
+    n.pairs = b'{"k":"v"}'
+    n.append_at_ns = 42
+    blob = n.to_bytes(VERSION3)
+    m = Needle.from_bytes(blob, VERSION3)
+    assert m.name == b"a.txt"
+    assert m.mime == b"text/plain"
+    assert m.last_modified == 1234567890
+    assert m.ttl == TTL.parse("3d")
+    assert m.pairs == b'{"k":"v"}'
+    assert m.append_at_ns == 42
+
+
+def test_needle_crc_detects_corruption():
+    n = Needle(cookie=1, id=2, data=b"payload")
+    blob = bytearray(n.to_bytes(VERSION3))
+    blob[t.NEEDLE_HEADER_SIZE + 5] ^= 0xFF  # flip a data byte
+    with pytest.raises(ValueError, match="CRC"):
+        Needle.from_bytes(bytes(blob), VERSION3)
+
+
+def test_ttl():
+    for s in ("3m", "4h", "5d", "6w", "7M", "8y"):
+        assert str(TTL.parse(s)) == s
+    assert TTL.parse("") == TTL()
+    assert TTL.parse("90") == TTL.parse("90m")
+    assert TTL.from_uint32(TTL.parse("4h").to_uint32()) == TTL.parse("4h")
+    assert TTL.parse("2h").minutes() == 120
+    assert TTL.from_bytes(TTL.parse("1d").to_bytes()) == TTL.parse("1d")
+
+
+def test_replica_placement():
+    rp = ReplicaPlacement.parse("012")
+    assert (rp.diff_dc, rp.diff_rack, rp.same_rack) == (0, 1, 2)
+    assert rp.copy_count() == 4
+    assert ReplicaPlacement.from_byte(rp.to_byte()) == rp
+    assert str(rp) == "012"
+    with pytest.raises(ValueError):
+        ReplicaPlacement.parse("091")
+
+
+def test_super_block_roundtrip():
+    sb = SuperBlock(
+        version=VERSION3,
+        replica_placement=ReplicaPlacement.parse("001"),
+        ttl=TTL.parse("3w"),
+        compaction_revision=7,
+    )
+    b = sb.to_bytes()
+    assert len(b) == 8
+    sb2 = SuperBlock.from_bytes(b)
+    assert sb2 == sb
+
+
+def test_volume_write_read_delete(tmp_path):
+    vol = make_volume(str(tmp_path), n_needles=30)
+    n = vol.read_needle(7)
+    assert n.id == 7
+    freed = vol.delete_needle(7)
+    assert freed > 0
+    with pytest.raises(KeyError):
+        vol.read_needle(7)
+    vol.close()
+    # reload from disk: deletes persist, live needles still readable
+    vol2 = Volume(str(tmp_path), "", 1)
+    with pytest.raises(KeyError):
+        vol2.read_needle(7)
+    assert vol2.read_needle(8).id == 8
+    vol2.close()
+
+
+def test_volume_torn_tail_truncated(tmp_path):
+    vol = make_volume(str(tmp_path), n_needles=5)
+    base = vol.file_name()
+    vol.close()
+    # tear the last record: chop bytes off the .dat tail
+    size = os.path.getsize(base + ".dat")
+    with open(base + ".dat", "r+b") as f:
+        f.truncate(size - 3)
+    vol2 = Volume(str(tmp_path), "", 1)
+    with pytest.raises(KeyError):
+        vol2.read_needle(5)  # torn needle dropped
+    assert vol2.read_needle(4).id == 4
+    vol2.close()
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_EC_DIR), reason="reference fixture absent")
+def test_parse_reference_fixture():
+    """Parse the reference's real 1.dat/1.idx: our reader must accept files
+    written by the original implementation (including CRC verification)."""
+    nm = NeedleMap.load_from_idx(os.path.join(REF_EC_DIR, "1.idx"))
+    assert len(nm) > 0
+    with open(os.path.join(REF_EC_DIR, "1.dat"), "rb") as f:
+        sb = SuperBlock.from_bytes(f.read(64))
+        assert sb.version in (VERSION2, VERSION3)
+        checked = 0
+        for v in nm.items_ascending():
+            if v.size <= 0:
+                continue
+            f.seek(v.offset)
+            blob = f.read(actual_size(v.size, sb.version))
+            n = Needle.from_bytes(blob, sb.version)  # verifies CRC
+            assert n.id == v.key
+            assert n.size == v.size
+            checked += 1
+        assert checked > 10
