@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Headline benchmark: EC encode GB/s per chip (RS 10+4, GF(2^8) on TPU).
+
+Prints ONE JSON line:
+  {"metric": "ec_encode_GBps", "value": <TPU pallas-kernel encode rate>,
+   "unit": "GB/s", "vs_baseline": <ratio vs the CPU SIMD codec on this host>,
+   ...details}
+
+Methodology notes (this platform needs care):
+  * `block_until_ready` does not reliably fence on the axon tunnel, and
+    repeated dispatch of the same computation invites CSE.  So the timed
+    workload is ONE device-side pallas_call with a (K, G) grid whose input
+    index_map shifts by the sweep index k — K full encode sweeps over
+    distinct HBM windows in a single dispatch, ended by a host readback.
+  * Rate convention matches the reference workload accounting (BASELINE.md):
+    encode throughput = volume bytes consumed per second.
+  * The CPU baseline is our C++ SSSE3 nibble-table codec — the same
+    algorithm class as the reference's SIMD assembly — on this host.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import numpy as np
+
+
+def _tpu_pallas_rate(sweep_mb_per_shard: int = 64, k: int = 16,
+                     tile: int = 256) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from seaweedfs_tpu.ops import gf256
+    from seaweedfs_tpu.ops.rs_pallas import LANES, _kernel_body
+
+    rows = tuple(tuple(int(c) for c in r) for r in gf256.rs_parity_matrix(10, 4))
+    kernel = functools.partial(_kernel_body, rows)
+    g = (sweep_mb_per_shard << 20) // (tile * LANES * 4)
+    words_per_sweep = g * tile * LANES
+    rng = np.random.default_rng(0)
+    buf = jax.device_put(
+        rng.integers(0, 2**32, (10, (g + k) * tile * LANES), dtype=np.uint32)
+        .reshape(10, (g + k) * tile, LANES)
+    )
+    fn = jax.jit(
+        pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((4, g * tile, LANES), jnp.uint32),
+            grid=(k, g),
+            in_specs=[
+                pl.BlockSpec(
+                    (10, tile, LANES), lambda kk, gg: (0, gg + kk, 0),
+                    memory_space=pltpu.VMEM,
+                )
+            ],
+            out_specs=pl.BlockSpec(
+                (4, tile, LANES), lambda kk, gg: (0, gg, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        )
+    )
+    out = fn(buf)
+    np.asarray(out[0, 0, :2])  # compile + warm
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(buf)
+        np.asarray(out[0, 0, :2])  # fence via readback
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    bytes_encoded = 10 * words_per_sweep * 4 * k
+    return {
+        "rate": bytes_encoded / dt / 1e9,
+        "sweeps": k,
+        "bytes": bytes_encoded,
+        "seconds": dt,
+    }
+
+
+def _cpu_rate(shard_bytes: int = 16 << 20, iters: int = 3) -> float:
+    from seaweedfs_tpu.ops.rs_cpu import ReedSolomon
+
+    rs = ReedSolomon()
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, (10, shard_bytes), dtype=np.uint8)
+    rs.parity_of(data)  # warm
+    start = time.perf_counter()
+    for _ in range(iters):
+        rs.parity_of(data)
+    dt = time.perf_counter() - start
+    return (10 * shard_bytes * iters) / dt / 1e9
+
+
+def main() -> None:
+    tpu = _tpu_pallas_rate()
+    cpu = _cpu_rate()
+    print(
+        json.dumps(
+            {
+                "metric": "ec_encode_GBps",
+                "value": round(tpu["rate"], 2),
+                "unit": "GB/s",
+                "vs_baseline": round(tpu["rate"] / cpu, 1) if cpu else None,
+                "impl": "pallas_swar_u32",
+                "cpu_simd_GBps": round(cpu, 3),
+                "sweep_bytes": tpu["bytes"],
+                "seconds": round(tpu["seconds"], 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

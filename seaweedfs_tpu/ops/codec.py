@@ -1,8 +1,12 @@
-"""Codec registry — the `-ec.codec={cpu|tpu|tpu_mxu}` switch.
+"""Codec registry — the `-ec.codec={cpu|tpu|tpu_xor|tpu_mxu}` switch.
 
 The reference hardwires klauspost/reedsolomon; here every consumer (file
 encoder, degraded reads, gRPC handlers, shell commands) goes through
 ``get_codec`` so the backend is a deployment choice.
+
+Backends: ``cpu`` (numpy + C++ SIMD, no jax) · ``tpu`` (the Pallas SWAR
+kernel — runs in interpreter mode off-TPU) · ``tpu_xor`` (fused XLA XOR
+network) · ``tpu_mxu`` (bit-plane int8 matmul on the systolic array).
 
 The TPU codec is imported lazily: the CPU-only per-needle path (storage
 servers doing small degraded reads) must not pay a jax import, and must work
@@ -23,7 +27,11 @@ def get_codec(name: str = "cpu", data_shards: int = DATA_SHARDS,
     """Return a codec with encode/reconstruct/reconstruct_data/verify."""
     if name in ("cpu", "go", "numpy"):
         return ReedSolomon(data_shards, parity_shards)
-    if name in ("tpu", "jax", "tpu_xor"):
+    if name in ("tpu", "pallas", "tpu_pallas"):
+        from .rs_jax import ReedSolomonTPU
+
+        return ReedSolomonTPU(data_shards, parity_shards, impl="pallas")
+    if name in ("jax", "tpu_xor"):
         from .rs_jax import ReedSolomonTPU
 
         return ReedSolomonTPU(data_shards, parity_shards, impl="xor")

@@ -107,13 +107,23 @@ def _rows_of(matrix: np.ndarray) -> tuple[tuple[int, ...], ...]:
     return tuple(tuple(int(c) for c in row) for row in np.asarray(matrix))
 
 
+def _impl_fn(rows: tuple[tuple[int, ...], ...], impl: str):
+    if impl == "xor":
+        return make_apply_xor(rows)
+    if impl == "mxu":
+        return make_apply_mxu(rows)
+    if impl == "pallas":
+        from .rs_pallas import make_apply_pallas
+
+        return make_apply_pallas(rows)
+    raise ValueError(f"unknown jax codec impl {impl!r}")
+
+
 def apply_matrix(
     matrix: np.ndarray, data: jax.Array, impl: str = "xor"
 ) -> jax.Array:
     """GF matmul: (R, S) constant matrix x (S, B) device data -> (R, B)."""
-    rows = _rows_of(matrix)
-    fn = make_apply_xor(rows) if impl == "xor" else make_apply_mxu(rows)
-    return fn(data)
+    return _impl_fn(_rows_of(matrix), impl)(data)
 
 
 class ReedSolomonTPU:
@@ -142,12 +152,7 @@ class ReedSolomonTPU:
 
     def encode_device(self, data: jax.Array) -> jax.Array:
         """(data_shards, B) uint8 on device -> (parity_shards, B) parity."""
-        fn = (
-            make_apply_xor(self._parity_rows)
-            if self.impl == "xor"
-            else make_apply_mxu(self._parity_rows)
-        )
-        return fn(data)
+        return _impl_fn(self._parity_rows, self.impl)(data)
 
     def apply_rows_device(self, rows: np.ndarray, inputs: jax.Array) -> jax.Array:
         """Arbitrary GF matrix application (used for decode/rebuild)."""

@@ -1,0 +1,6 @@
+from .mesh import (  # noqa: F401
+    batch_encode_sharded,
+    distributed_reconstruct,
+    make_mesh,
+    train_step,
+)

@@ -2,15 +2,30 @@
 
 Multi-chip hardware is not available in CI; sharding/collective paths are
 validated on virtual CPU devices exactly as the driver's dryrun does.
-Must run before the first `import jax` anywhere in the test process.
+
+The environment preloads the jax *module* at interpreter startup, but the
+backend is only created on first use — so pinning the platform via
+jax.config here (before any test touches a device) still takes effect.
+
+Set JAX_PLATFORMS explicitly (e.g. =tpu) to run the suite against real
+hardware instead; the pin below only applies when the var is unset.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_explicit = "JAX_PLATFORMS" in os.environ
+if not _explicit:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+if not _explicit:
+    try:
+        import jax
+    except ImportError:
+        pass
+    else:
+        jax.config.update("jax_platforms", "cpu")
