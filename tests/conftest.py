@@ -3,26 +3,24 @@
 Multi-chip hardware is not available in CI; sharding/collective paths are
 validated on virtual CPU devices exactly as the driver's dryrun does.
 
-The environment preloads the jax *module* at interpreter startup, but the
-backend is only created on first use — so pinning the platform via
-jax.config here (before any test touches a device) still takes effect.
+The environment preloads the jax *module* at interpreter startup (and sets
+JAX_PLATFORMS=axon ambiently), but the backend is only created on first use —
+so pinning the platform via jax.config here (before any test touches a
+device) still takes effect.
 
-Set JAX_PLATFORMS explicitly (e.g. =tpu) to run the suite against real
-hardware instead; the pin below only applies when the var is unset.
+To run the suite against real hardware instead, set SEAWEEDFS_TPU_TEST_REAL=1
+(a dedicated opt-out: the ambient JAX_PLATFORMS can't express user intent).
 """
 
 import os
 
-_explicit = "JAX_PLATFORMS" in os.environ
-if not _explicit:
+if not os.environ.get("SEAWEEDFS_TPU_TEST_REAL"):
     os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-if not _explicit:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     try:
         import jax
     except ImportError:
