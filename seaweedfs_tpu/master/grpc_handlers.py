@@ -1,0 +1,222 @@
+"""Master gRPC service: heartbeat ingest, assign/lookup, location pub/sub.
+
+Reference: weed/server/master_grpc_server*.go.
+"""
+
+from __future__ import annotations
+
+import queue
+import random
+import threading
+import time
+
+import grpc
+
+from ..pb import master_pb2
+from ..storage.file_id import FileId
+from ..topology.topology import DataNode
+
+
+class MasterGrpcService:
+    def __init__(self, master):
+        self.master = master  # MasterServer
+        self.topo = master.topo
+
+    # -- heartbeat ingest (bidi) -----------------------------------------
+
+    def SendHeartbeat(self, request_iterator, context):
+        node: DataNode | None = None
+        try:
+            for hb in request_iterator:
+                if node is None:
+                    node = self.topo.register_node(
+                        DataNode(
+                            id=f"{hb.ip}:{hb.port}",
+                            public_url=hb.public_url or f"{hb.ip}:{hb.port}",
+                            grpc_address=f"{hb.ip}:{hb.port + 10000}",
+                            data_center=hb.data_center or "DefaultDataCenter",
+                            rack=hb.rack or "DefaultRack",
+                            max_volumes=sum(hb.max_volume_counts.values()) or 7,
+                        )
+                    )
+                if hb.max_file_key:
+                    self.master.sequencer.set_max(hb.max_file_key)
+                new_vids, deleted_vids = [], []
+                if hb.volumes or hb.has_no_volumes:
+                    before = set(node.volumes)
+                    self.topo.sync_volumes(node, list(hb.volumes))
+                    after = set(node.volumes)
+                    new_vids = sorted(after - before)
+                    deleted_vids = sorted(before - after)
+                    self.master.rebuild_layouts(node)
+                if hb.ec_shards or hb.has_no_ec_shards:
+                    self.topo.sync_ec_shards(node, list(hb.ec_shards))
+                if (hb.new_volumes or hb.deleted_volumes or hb.new_ec_shards
+                        or hb.deleted_ec_shards):
+                    self.topo.apply_incremental(node, hb)
+                    self.master.rebuild_layouts(node)
+                    new_vids += [m.id for m in hb.new_volumes]
+                    deleted_vids += [m.id for m in hb.deleted_volumes]
+                node.last_seen = time.monotonic()
+                if new_vids or deleted_vids:
+                    self.master.broadcast_location(
+                        node, new_vids, deleted_vids
+                    )
+                yield master_pb2.HeartbeatResponse(
+                    volume_size_limit=self.topo.volume_size_limit,
+                    leader=self.master.leader(),
+                    leader_grpc=self.master.leader_grpc(),
+                )
+        finally:
+            if node is not None and context.code() is None:
+                pass  # connection drop handled by liveness sweep
+
+    # -- location pub/sub -------------------------------------------------
+
+    def KeepConnected(self, request_iterator, context):
+        q: queue.Queue = queue.Queue()
+        self.master.subscribe(q)
+        try:
+            first = next(iter(request_iterator), None)
+            _ = first
+            # initial snapshot: all known volume locations
+            with self.topo.lock:
+                for n in self.topo.nodes.values():
+                    yield master_pb2.VolumeLocation(
+                        url=n.id,
+                        public_url=n.public_url,
+                        new_vids=sorted(set(n.volumes) | set(n.ec_shards)),
+                        leader=self.master.leader(),
+                        data_center=n.data_center,
+                    )
+            while context.is_active():
+                try:
+                    loc = q.get(timeout=1.0)
+                except queue.Empty:
+                    continue
+                yield loc
+        finally:
+            self.master.unsubscribe(q)
+
+    # -- assign / lookup --------------------------------------------------
+
+    def Assign(self, request, context):
+        try:
+            fid, url, public_url, count = self.master.assign(
+                count=max(int(request.count), 1),
+                collection=request.collection,
+                replication=request.replication,
+                ttl=request.ttl,
+                data_center=request.data_center,
+                rack=request.rack,
+            )
+        except Exception as e:
+            return master_pb2.AssignResponse(error=str(e))
+        return master_pb2.AssignResponse(
+            fid=fid, url=url, public_url=public_url, count=count
+        )
+
+    def LookupVolume(self, request, context):
+        resp = master_pb2.LookupVolumeResponse()
+        for vof in request.volume_or_file_ids:
+            entry = resp.volume_id_locations.add(volume_or_file_id=vof)
+            try:
+                vid = int(vof.split(",", 1)[0])
+            except ValueError:
+                entry.error = "invalid volume id"
+                continue
+            locations = self.master.lookup_volume_locations(vid)
+            if not locations:
+                entry.error = f"volume {vid} not found"
+                continue
+            for url, public_url in locations:
+                entry.locations.add(url=url, public_url=public_url)
+        return resp
+
+    def LookupEcVolume(self, request, context):
+        shard_map = self.topo.lookup_ec_shards(request.volume_id)
+        if not shard_map:
+            context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"ec volume {request.volume_id} not found",
+            )
+        resp = master_pb2.LookupEcVolumeResponse(volume_id=request.volume_id)
+        for sid in sorted(shard_map):
+            e = resp.shard_id_locations.add(shard_id=sid)
+            for n in shard_map[sid]:
+                e.locations.add(url=n.id, public_url=n.public_url)
+        return resp
+
+    # -- cluster info -----------------------------------------------------
+
+    def VolumeList(self, request, context):
+        return master_pb2.VolumeListResponse(
+            topology_info=self.topo.to_topology_info(),
+            volume_size_limit_mb=self.topo.volume_size_limit // (1 << 20),
+        )
+
+    def Statistics(self, request, context):
+        total = used = files = 0
+        with self.topo.lock:
+            for n in self.topo.nodes.values():
+                for v in n.volumes.values():
+                    if request.collection and v.collection != request.collection:
+                        continue
+                    used += v.size
+                    files += v.file_count
+                total += n.max_volumes * self.topo.volume_size_limit
+        return master_pb2.StatisticsResponse(
+            total_size=total, used_size=used, file_count=files
+        )
+
+    def CollectionList(self, request, context):
+        resp = master_pb2.CollectionListResponse()
+        for name in sorted(self.topo.collections()):
+            if name:
+                resp.collections.add(name=name)
+        return resp
+
+    def CollectionDelete(self, request, context):
+        from ..pb import rpc as rpclib
+        from ..pb import volume_server_pb2 as vs
+
+        with self.topo.lock:
+            nodes = list(self.topo.nodes.values())
+        for n in nodes:
+            try:
+                rpclib.volume_server_stub(n.grpc_address, timeout=30).DeleteCollection(
+                    vs.DeleteCollectionRequest(collection=request.name)
+                )
+            except grpc.RpcError:
+                pass
+        return master_pb2.CollectionDeleteResponse()
+
+    def GetMasterConfiguration(self, request, context):
+        return master_pb2.GetMasterConfigurationResponse(
+            volume_size_limit_mb=self.topo.volume_size_limit // (1 << 20),
+            default_replication=self.master.default_replication,
+            leader=self.master.leader(),
+        )
+
+    def ListMasterClients(self, request, context):
+        return master_pb2.ListMasterClientsResponse()
+
+    def VacuumVolume(self, request, context):
+        self.master.vacuum(request.garbage_threshold or 0.3)
+        return master_pb2.VacuumVolumeResponse()
+
+    # -- admin lock -------------------------------------------------------
+
+    def LeaseAdminToken(self, request, context):
+        token = self.master.lease_admin_token(
+            request.lock_name, request.previous_token
+        )
+        if token is None:
+            context.abort(grpc.StatusCode.ABORTED, "already locked")
+        return master_pb2.LeaseAdminTokenResponse(
+            token=token, lock_ts_ns=time.time_ns()
+        )
+
+    def ReleaseAdminToken(self, request, context):
+        self.master.release_admin_token(request.lock_name, request.previous_token)
+        return master_pb2.ReleaseAdminTokenResponse()

@@ -1,0 +1,68 @@
+"""File-key sequencers (reference: weed/sequence/ — memory, etcd, snowflake).
+
+The memory sequencer is the default; the snowflake variant gives collision-
+free ids across multiple masters without coordination.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class MemorySequencer:
+    def __init__(self, start: int = 1):
+        self._counter = max(start, 1)
+        self._lock = threading.Lock()
+
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            start = self._counter
+            self._counter += count
+            return start
+
+    def set_max(self, seen_value: int) -> None:
+        with self._lock:
+            if seen_value > self._counter:
+                self._counter = seen_value + 1
+
+    def peek(self) -> int:
+        with self._lock:
+            return self._counter
+
+
+class SnowflakeSequencer:
+    """41-bit ms timestamp | 10-bit node | 12-bit sequence."""
+
+    EPOCH_MS = 1_600_000_000_000
+
+    def __init__(self, node_id: int = 0):
+        self.node_id = node_id & 0x3FF
+        self._lock = threading.Lock()
+        self._last_ms = 0
+        self._seq = 0
+
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            now = int(time.time() * 1000) - self.EPOCH_MS
+            if now == self._last_ms:
+                self._seq += count
+                if self._seq >= 1 << 12:
+                    time.sleep(0.001)
+                    now += 1
+                    self._seq = 0
+            else:
+                self._seq = 0
+            self._last_ms = now
+            return (now << 22) | (self.node_id << 12) | self._seq
+
+    def set_max(self, seen_value: int) -> None:
+        pass  # timestamps make collisions impossible
+
+
+def make_sequencer(kind: str = "memory", node_id: int = 0):
+    if kind == "memory":
+        return MemorySequencer()
+    if kind == "snowflake":
+        return SnowflakeSequencer(node_id)
+    raise ValueError(f"unknown sequencer {kind!r}")

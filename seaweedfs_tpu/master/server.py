@@ -1,0 +1,409 @@
+"""MasterServer: placement metadata owner, out of the data path.
+
+Reference: weed/server/master_server.go.  Single-master mode this round;
+the leader() hook is where raft slots in.  Includes the volume growth path
+(grow -> AllocateVolume on chosen volume servers), the vacuum sweep, and a
+maintenance loop that runs EC encode/rebuild/balance periodically like the
+reference's [master.maintenance] script block (master_server.go:187-242).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import grpc
+
+from ..pb import master_pb2
+from ..pb import rpc as rpclib
+from ..pb import volume_server_pb2 as vs
+from ..storage.replica_placement import ReplicaPlacement
+from ..topology.placement import Candidate, pick_nodes_for_write
+from ..topology.topology import Topology
+from ..topology.volume_layout import VolumeLayout
+from .grpc_handlers import MasterGrpcService
+from .sequence import make_sequencer
+
+GRPC_PORT_OFFSET = 10000
+
+
+class MasterServer:
+    def __init__(
+        self,
+        ip: str = "127.0.0.1",
+        port: int = 9333,
+        volume_size_limit_mb: int = 30 * 1024,
+        default_replication: str = "000",
+        pulse_seconds: float = 3.0,
+        sequencer: str = "memory",
+        garbage_threshold: float = 0.3,
+        maintenance_interval: float = 0.0,  # seconds; 0 disables
+    ):
+        self.ip = ip
+        self.port = port
+        self.grpc_port = port + GRPC_PORT_OFFSET
+        self.topo = Topology(
+            volume_size_limit=volume_size_limit_mb * (1 << 20),
+            pulse_seconds=pulse_seconds,
+        )
+        self.default_replication = default_replication
+        self.garbage_threshold = garbage_threshold
+        self.maintenance_interval = maintenance_interval
+        self.sequencer = make_sequencer(sequencer)
+        self.layouts: dict[tuple[str, str, str], VolumeLayout] = {}
+        self._layout_lock = threading.RLock()
+        self._subscribers: list = []
+        self._sub_lock = threading.Lock()
+        self._admin_locks: dict[str, int] = {}
+        self._admin_lock_mutex = threading.Lock()
+        self._stop = threading.Event()
+        self._grpc_server = None
+        self._httpd = None
+        self._rng = random.Random()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self._grpc_server = rpclib.serve(
+            [(rpclib.MASTER, MasterGrpcService(self))], self.grpc_port
+        )
+        self._httpd = _serve_http(self, "0.0.0.0", self.port)
+        threading.Thread(target=self._liveness_loop, daemon=True).start()
+        if self.maintenance_interval > 0:
+            threading.Thread(target=self._maintenance_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd:
+            self._httpd.shutdown()
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.5)
+
+    def leader(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def leader_grpc(self) -> str:
+        return f"{self.ip}:{self.grpc_port}"
+
+    # -- layouts ----------------------------------------------------------
+
+    def get_layout(self, collection: str, replication: str, ttl: str) -> VolumeLayout:
+        replication = replication or self.default_replication
+        key = (collection, replication, ttl)
+        with self._layout_lock:
+            layout = self.layouts.get(key)
+            if layout is None:
+                layout = VolumeLayout(
+                    ReplicaPlacement.parse(replication),
+                    ttl,
+                    self.topo.volume_size_limit,
+                )
+                self.layouts[key] = layout
+            return layout
+
+    def rebuild_layouts(self, node) -> None:
+        """Re-register a node's volumes into their layouts."""
+        with self.topo.lock:
+            volumes = list(node.volumes.values())
+        for v in volumes:
+            rp = ReplicaPlacement.from_byte(v.replica_placement)
+            from ..storage.ttl import TTL
+
+            layout = self.get_layout(
+                v.collection, str(rp), str(TTL.from_uint32(v.ttl))
+            )
+            layout.register(v.volume_id, node.id, v.size, v.read_only)
+            layout.set_oversized(v.volume_id, v.size)
+
+    # -- assign -----------------------------------------------------------
+
+    def assign(self, count: int, collection: str, replication: str,
+               ttl: str, data_center: str = "", rack: str = "") -> tuple[str, str, str, int]:
+        layout = self.get_layout(collection, replication, ttl)
+        try:
+            vid, node_ids = layout.pick_for_write()
+        except LookupError:
+            self.grow_volumes(collection, replication or self.default_replication,
+                              ttl, data_center, rack)
+            vid, node_ids = layout.pick_for_write()
+        key = self.sequencer.next_file_id(count)
+        cookie = self._rng.randrange(0, 2**32)
+        fid = f"{vid},{key:x}{cookie:08x}"
+        node = self.topo.nodes.get(node_ids[0])
+        url = node.id if node else node_ids[0]
+        public_url = node.public_url if node else node_ids[0]
+        return fid, url, public_url, count
+
+    def grow_volumes(self, collection: str, replication: str, ttl: str,
+                     data_center: str = "", rack: str = "",
+                     target_count: int | None = None) -> list[int]:
+        """VolumeGrowth: pick nodes per placement, AllocateVolume on each."""
+        rp = ReplicaPlacement.parse(replication)
+        # grow several volumes for write concurrency, like the reference's
+        # automatic growth defaults (volume_growth.go)
+        n_grow = target_count or max(1, 7 // rp.copy_count() // 2)
+        grown: list[int] = []
+        for _ in range(n_grow):
+            with self.topo.lock:
+                candidates = [
+                    Candidate(n.id, n.data_center, n.rack, n.free_slots())
+                    for n in self.topo.nodes.values()
+                ]
+            try:
+                picked = pick_nodes_for_write(
+                    candidates, rp, data_center, rack,
+                    rng=random.Random(self._rng.random()),
+                )
+            except ValueError:
+                if grown:
+                    break
+                raise
+            vid = self.topo.next_volume_id()
+            ok = True
+            for c in picked:
+                node = self.topo.nodes[c.node_id]
+                try:
+                    rpclib.volume_server_stub(node.grpc_address, timeout=30).AllocateVolume(
+                        vs.AllocateVolumeRequest(
+                            volume_id=vid,
+                            collection=collection,
+                            replication=replication,
+                            ttl=ttl,
+                        )
+                    )
+                except grpc.RpcError:
+                    ok = False
+                    break
+            if ok:
+                layout = self.get_layout(collection, replication, ttl)
+                for c in picked:
+                    layout.register(vid, c.node_id, 0, False)
+                grown.append(vid)
+        return grown
+
+    def lookup_volume_locations(self, vid: int) -> list[tuple[str, str]]:
+        """-> [(url, public_url)]: layouts first (fresh growth), then the
+        topology (heartbeat state), then EC shard holders."""
+        node_ids: list[str] = []
+        with self._layout_lock:
+            for layout in self.layouts.values():
+                if vid in layout.locations:
+                    node_ids = list(layout.locations[vid])
+                    break
+        out = []
+        with self.topo.lock:
+            if not node_ids:
+                node_ids = [
+                    n.id for n in self.topo.nodes.values() if vid in n.volumes
+                ]
+            for nid in node_ids:
+                n = self.topo.nodes.get(nid)
+                out.append((nid, n.public_url if n else nid))
+        if not out:
+            seen = {}
+            for ns in self.topo.lookup_ec_shards(vid).values():
+                for n in ns:
+                    seen[n.id] = n.public_url
+            out = sorted(seen.items())
+        return out
+
+    # -- pub/sub ----------------------------------------------------------
+
+    def subscribe(self, q) -> None:
+        with self._sub_lock:
+            self._subscribers.append(q)
+
+    def unsubscribe(self, q) -> None:
+        with self._sub_lock:
+            if q in self._subscribers:
+                self._subscribers.remove(q)
+
+    def broadcast_location(self, node, new_vids, deleted_vids) -> None:
+        loc = master_pb2.VolumeLocation(
+            url=node.id,
+            public_url=node.public_url,
+            new_vids=sorted(set(new_vids)),
+            deleted_vids=sorted(set(deleted_vids)),
+            leader=self.leader(),
+            data_center=node.data_center,
+        )
+        with self._sub_lock:
+            for q in self._subscribers:
+                q.put(loc)
+
+    # -- liveness ---------------------------------------------------------
+
+    def _liveness_loop(self) -> None:
+        while not self._stop.wait(self.topo.pulse_seconds):
+            for node_id in self.topo.collect_dead_nodes():
+                vids = self.topo.unregister_node(node_id)
+                with self._layout_lock:
+                    for layout in self.layouts.values():
+                        for vid in vids:
+                            layout.unregister(vid, node_id)
+
+    # -- vacuum -----------------------------------------------------------
+
+    def vacuum(self, threshold: float | None = None) -> list[int]:
+        """Leader-driven Check -> Compact -> Commit over gRPC."""
+        threshold = threshold or self.garbage_threshold
+        vacuumed = []
+        with self.topo.lock:
+            vid_nodes: dict[int, list] = {}
+            for n in self.topo.nodes.values():
+                for vid in n.volumes:
+                    vid_nodes.setdefault(vid, []).append(n)
+        for vid, nodes in vid_nodes.items():
+            try:
+                ratios = [
+                    rpclib.volume_server_stub(n.grpc_address, timeout=30)
+                    .VacuumVolumeCheck(vs.VacuumVolumeCheckRequest(volume_id=vid))
+                    .garbage_ratio
+                    for n in nodes
+                ]
+                if not ratios or min(ratios) < threshold:
+                    continue
+                for n in nodes:
+                    rpclib.volume_server_stub(n.grpc_address, timeout=600).VacuumVolumeCompact(
+                        vs.VacuumVolumeCompactRequest(volume_id=vid)
+                    )
+                for n in nodes:
+                    rpclib.volume_server_stub(n.grpc_address, timeout=600).VacuumVolumeCommit(
+                        vs.VacuumVolumeCommitRequest(volume_id=vid)
+                    )
+                vacuumed.append(vid)
+            except grpc.RpcError:
+                for n in nodes:
+                    try:
+                        rpclib.volume_server_stub(n.grpc_address, timeout=30).VacuumVolumeCleanup(
+                            vs.VacuumVolumeCleanupRequest(volume_id=vid)
+                        )
+                    except grpc.RpcError:
+                        pass
+        return vacuumed
+
+    # -- maintenance loop (ec.encode/rebuild/balance automation) ----------
+
+    def _maintenance_loop(self) -> None:
+        from ..shell.commands import CommandEnv, run_maintenance
+
+        while not self._stop.wait(self.maintenance_interval):
+            try:
+                env = CommandEnv(f"{self.ip}:{self.grpc_port}")
+                run_maintenance(env)
+            except Exception:
+                pass
+
+    # -- admin lock -------------------------------------------------------
+
+    def lease_admin_token(self, lock_name: str, previous: int) -> int | None:
+        with self._admin_lock_mutex:
+            current = self._admin_locks.get(lock_name)
+            if current is not None and current != previous:
+                return None
+            token = int(time.time_ns())
+            self._admin_locks[lock_name] = token
+            return token
+
+    def release_admin_token(self, lock_name: str, token: int) -> None:
+        with self._admin_lock_mutex:
+            if self._admin_locks.get(lock_name) == token:
+                del self._admin_locks[lock_name]
+
+
+# ---------------------------------------------------------------------------
+# HTTP API (/dir/assign, /dir/lookup, /cluster/status, /vol/vacuum)
+# ---------------------------------------------------------------------------
+
+
+class _MasterHttpHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    master: MasterServer = None
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        u = urllib.parse.urlparse(self.path)
+        q = urllib.parse.parse_qs(u.query)
+
+        def qget(name, default=""):
+            return q.get(name, [default])[0]
+
+        if u.path == "/dir/assign":
+            try:
+                fid, url, public_url, count = self.master.assign(
+                    count=int(qget("count", "1") or 1),
+                    collection=qget("collection"),
+                    replication=qget("replication"),
+                    ttl=qget("ttl"),
+                    data_center=qget("dataCenter"),
+                    rack=qget("rack"),
+                )
+                return self._json(200, {
+                    "fid": fid, "url": url, "publicUrl": public_url,
+                    "count": count,
+                })
+            except Exception as e:
+                return self._json(500, {"error": str(e)})
+        if u.path == "/dir/lookup":
+            vid_s = qget("volumeId") or qget("fileId").split(",")[0]
+            try:
+                vid = int(vid_s)
+            except ValueError:
+                return self._json(400, {"error": "invalid volumeId"})
+            locations = self.master.lookup_volume_locations(vid)
+            if not locations:
+                return self._json(404, {"volumeId": vid_s, "error": "not found"})
+            return self._json(200, {
+                "volumeId": vid_s,
+                "locations": [
+                    {"url": url, "publicUrl": public_url}
+                    for url, public_url in locations
+                ],
+            })
+        if u.path in ("/cluster/status", "/dir/status"):
+            with self.master.topo.lock:
+                return self._json(200, {
+                    "IsLeader": True,
+                    "Leader": self.master.leader(),
+                    "MaxVolumeId": self.master.topo.max_volume_id,
+                    "DataNodes": {
+                        n.id: {
+                            "publicUrl": n.public_url,
+                            "volumes": sorted(n.volumes),
+                            "ecShards": {
+                                str(vid): bits.shard_ids()
+                                for vid, bits in n.ec_shards.items()
+                            },
+                            "dataCenter": n.data_center,
+                            "rack": n.rack,
+                        }
+                        for n in self.master.topo.nodes.values()
+                    },
+                })
+        if u.path == "/vol/vacuum":
+            vacuumed = self.master.vacuum(
+                float(qget("garbageThreshold", "0") or 0) or None
+            )
+            return self._json(200, {"vacuumed": vacuumed})
+        return self._json(404, {"error": f"unknown path {u.path}"})
+
+
+def _serve_http(master: MasterServer, host: str, port: int) -> ThreadingHTTPServer:
+    handler = type("BoundMasterHttp", (_MasterHttpHandler,), {"master": master})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd

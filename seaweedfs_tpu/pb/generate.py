@@ -1,0 +1,33 @@
+"""Regenerate *_pb2.py from the .proto files with protoc.
+
+No grpc codegen plugin is available in this image, so services are wired via
+grpc's generic-handler API (see rpc.py) against these message classes.
+Run: python -m seaweedfs_tpu.pb.generate
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PROTOS = [
+    "master.proto",
+    "volume_server.proto",
+    "filer.proto",
+    "messaging.proto",
+    "volume_info.proto",
+]
+
+
+def main() -> None:
+    subprocess.run(
+        ["protoc", f"-I{HERE}", f"--python_out={HERE}", *PROTOS],
+        cwd=HERE,
+        check=True,
+    )
+    print("generated:", ", ".join(p.replace(".proto", "_pb2.py") for p in PROTOS))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from .commands import CommandEnv, run_command  # noqa: F401

@@ -1,0 +1,114 @@
+"""Admin shell core: CommandEnv, registry, and the maintenance script.
+
+Reference: weed/shell/commands.go (CommandEnv + exclusive admin lock) and
+master_server.go:187-242 (the [master.maintenance] loop that runs
+`ec.encode; ec.rebuild; ec.balance; volume.balance; volume.fix.replication`
+every 17 minutes under the admin lock).
+"""
+
+from __future__ import annotations
+
+import shlex
+from dataclasses import dataclass, field
+
+import grpc
+
+from ..pb import master_pb2
+from ..pb import rpc as rpclib
+
+
+@dataclass
+class CommandEnv:
+    master_grpc: str  # "ip:grpc_port"
+    locked_token: int = 0
+    option: dict = field(default_factory=dict)
+
+    def master(self) -> rpclib.Stub:
+        return rpclib.master_stub(self.master_grpc, timeout=60)
+
+    def volume_server(self, grpc_address: str) -> rpclib.Stub:
+        return rpclib.volume_server_stub(grpc_address, timeout=600)
+
+    def topology(self) -> master_pb2.TopologyInfo:
+        return self.master().VolumeList(master_pb2.VolumeListRequest()).topology_info
+
+    def volume_size_limit(self) -> int:
+        resp = self.master().VolumeList(master_pb2.VolumeListRequest())
+        return resp.volume_size_limit_mb * (1 << 20)
+
+    # -- exclusive admin lock (wdclient/exclusive_locks analogue) ---------
+
+    def acquire_lock(self) -> bool:
+        try:
+            resp = self.master().LeaseAdminToken(
+                master_pb2.LeaseAdminTokenRequest(
+                    previous_token=self.locked_token, lock_name="admin"
+                )
+            )
+            self.locked_token = resp.token
+            return True
+        except grpc.RpcError:
+            return False
+
+    def release_lock(self) -> None:
+        if self.locked_token:
+            try:
+                self.master().ReleaseAdminToken(
+                    master_pb2.ReleaseAdminTokenRequest(
+                        previous_token=self.locked_token, lock_name="admin"
+                    )
+                )
+            except grpc.RpcError:
+                pass
+            self.locked_token = 0
+
+
+COMMANDS: dict[str, object] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        COMMANDS[name] = fn
+        return fn
+
+    return deco
+
+
+def run_command(env: CommandEnv, line: str) -> str:
+    """Run one shell command line; returns its output text."""
+    parts = shlex.split(line)
+    if not parts:
+        return ""
+    name, args = parts[0], parts[1:]
+    fn = COMMANDS.get(name)
+    if fn is None:
+        raise ValueError(
+            f"unknown command {name!r}; available: {', '.join(sorted(COMMANDS))}"
+        )
+    return fn(env, args) or ""
+
+
+def run_maintenance(env: CommandEnv) -> list[str]:
+    """The [master.maintenance] script block (scaffold.go:503-518)."""
+    out = []
+    if not env.acquire_lock():
+        return ["maintenance: admin lock busy"]
+    try:
+        for line in (
+            "ec.encode -fullPercent=95 -quietFor=1h",
+            "ec.rebuild -force",
+            "ec.balance -force",
+            "volume.fix.replication",
+        ):
+            try:
+                out.append(f"> {line}\n{run_command(env, line)}")
+            except Exception as e:
+                out.append(f"> {line}\nerror: {e}")
+    finally:
+        env.release_lock()
+    return out
+
+
+# import command modules for registration side effects
+from . import ec_commands  # noqa: E402,F401
+from . import volume_commands  # noqa: E402,F401

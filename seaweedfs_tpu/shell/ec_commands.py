@@ -1,0 +1,380 @@
+"""EC admin commands: ec.encode / ec.rebuild / ec.balance / ec.decode.
+
+Client-side orchestration over gRPC, mirroring the reference's protocol
+(command_ec_encode.go:24-35 documents the 6 steps):
+  1. mark the volume readonly on every replica
+  2. VolumeEcShardsGenerate on one holder (this is where `-codec=tpu` lands)
+  3. spread shards: balanced allocation by free EC slots, targets PULL via
+     VolumeEcShardsCopy, then VolumeEcShardsMount
+  4. unmount + delete moved shards on the source
+  5. delete the original volume from all replicas
+Shard bookkeeping flows back to the master via heartbeat deltas.
+"""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+
+from ..pb import master_pb2
+from ..pb import volume_server_pb2 as vs
+from ..storage.ec.constants import TOTAL_SHARDS
+from ..storage.ec.shard_bits import ShardBits
+from ..topology.placement import balanced_ec_distribution
+from .commands import CommandEnv, register
+
+
+def _parse_flags(args: list[str]) -> dict[str, str]:
+    out = {}
+    for a in args:
+        if a.startswith("-"):
+            k, _, v = a.lstrip("-").partition("=")
+            out[k] = v if v else "true"
+    return out
+
+
+def _iter_nodes(topo: master_pb2.TopologyInfo):
+    for dc in topo.data_center_infos:
+        for rack in dc.rack_infos:
+            for dn in rack.data_node_infos:
+                yield dc.id, rack.id, dn
+
+
+def _node_grpc(dn_id: str) -> str:
+    host, port = dn_id.rsplit(":", 1)
+    return f"{host}:{int(port) + 10000}"
+
+
+def _volume_locations(topo, vid: int) -> list[str]:
+    out = []
+    for _dc, _rack, dn in _iter_nodes(topo):
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                if v.id == vid:
+                    out.append(dn.id)
+    return out
+
+
+def _free_ec_slots(dn) -> int:
+    free = 0
+    for disk in dn.disk_infos.values():
+        used_shards = sum(
+            ShardBits(e.ec_index_bits).count() for e in disk.ec_shard_infos
+        )
+        free += max(
+            (disk.max_volume_count - disk.volume_count) * 10 - used_shards, 0
+        )
+    return free
+
+
+def collect_volume_ids_for_ec_encode(
+    topo: master_pb2.TopologyInfo,
+    volume_size_limit: int,
+    full_percent: float,
+    collection: str = "",
+) -> list[int]:
+    """Pure selection logic (tier-3 testable): volumes full enough to freeze."""
+    vids = set()
+    for _dc, _rack, dn in _iter_nodes(topo):
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                if collection and v.collection != collection:
+                    continue
+                if v.size >= volume_size_limit * full_percent / 100.0:
+                    vids.add(v.id)
+    return sorted(vids)
+
+
+@register("ec.encode")
+def ec_encode(env: CommandEnv, args: list[str]) -> str:
+    flags = _parse_flags(args)
+    collection = flags.get("collection", "")
+    full_percent = float(flags.get("fullPercent", "95"))
+    codec = flags.get("codec", "")
+    explicit_vid = int(flags["volumeId"]) if "volumeId" in flags else None
+
+    topo = env.topology()
+    limit = env.volume_size_limit()
+    if explicit_vid is not None:
+        vids = [explicit_vid]
+    else:
+        vids = collect_volume_ids_for_ec_encode(
+            topo, limit, full_percent, collection
+        )
+    out = []
+    for vid in vids:
+        out.append(do_ec_encode(env, topo, vid, collection, codec))
+    return "\n".join(out) if out else "ec.encode: no volumes selected"
+
+
+def do_ec_encode(env: CommandEnv, topo, vid: int, collection: str,
+                 codec: str = "") -> str:
+    locations = _volume_locations(topo, vid)
+    if not locations:
+        # freshly grown volumes may not be in the heartbeat snapshot yet;
+        # the master's layout-backed lookup has them immediately
+        resp = env.master().LookupVolume(
+            master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)])
+        )
+        for entry in resp.volume_id_locations:
+            locations = [loc.url for loc in entry.locations]
+    if not locations:
+        return f"ec.encode {vid}: no locations"
+    # 1. freeze writes on every replica
+    for loc in locations:
+        env.volume_server(_node_grpc(loc)).VolumeMarkReadonly(
+            vs.VolumeMarkReadonlyRequest(volume_id=vid)
+        )
+    source = locations[0]
+    # 2. generate shards on the source (the TPU codec dispatch point)
+    env.volume_server(_node_grpc(source)).VolumeEcShardsGenerate(
+        vs.VolumeEcShardsGenerateRequest(
+            volume_id=vid, collection=collection, codec=codec
+        )
+    )
+    # 3. spread shards by free EC slots
+    nodes = {dn.id: dn for _dc, _rack, dn in _iter_nodes(topo)}
+    free = {nid: _free_ec_slots(dn) for nid, dn in nodes.items()}
+    free[source] = max(free.get(source, 0), 1)  # source can keep shards
+    plan = balanced_ec_distribution(free, TOTAL_SHARDS)
+    moved_from_source = []
+    for target, sids in plan.items():
+        if target == source:
+            env.volume_server(_node_grpc(source)).VolumeEcShardsMount(
+                vs.VolumeEcShardsMountRequest(
+                    volume_id=vid, collection=collection, shard_ids=sids
+                )
+            )
+            continue
+        env.volume_server(_node_grpc(target)).VolumeEcShardsCopy(
+            vs.VolumeEcShardsCopyRequest(
+                volume_id=vid,
+                collection=collection,
+                shard_ids=sids,
+                copy_ecx_file=True,
+                copy_ecj_file=True,
+                copy_vif_file=True,
+                copy_from_data_node=_node_grpc(source),
+            )
+        )
+        env.volume_server(_node_grpc(target)).VolumeEcShardsMount(
+            vs.VolumeEcShardsMountRequest(
+                volume_id=vid, collection=collection, shard_ids=sids
+            )
+        )
+        moved_from_source.extend(sids)
+    # 4. drop moved shard files from the source
+    if moved_from_source:
+        env.volume_server(_node_grpc(source)).VolumeEcShardsDelete(
+            vs.VolumeEcShardsDeleteRequest(
+                volume_id=vid, collection=collection,
+                shard_ids=moved_from_source,
+            )
+        )
+    # 5. delete the original volume everywhere
+    for loc in locations:
+        env.volume_server(_node_grpc(loc)).VolumeDelete(
+            vs.VolumeDeleteRequest(volume_id=vid)
+        )
+    return f"ec.encode {vid}: spread {dict((k, v) for k, v in plan.items())}"
+
+
+@register("ec.rebuild")
+def ec_rebuild(env: CommandEnv, args: list[str]) -> str:
+    topo = env.topology()
+    # vid -> {node_id: bits}
+    holdings: dict[int, dict[str, ShardBits]] = {}
+    collections: dict[int, str] = {}
+    for _dc, _rack, dn in _iter_nodes(topo):
+        for disk in dn.disk_infos.values():
+            for e in disk.ec_shard_infos:
+                holdings.setdefault(e.id, {})[dn.id] = ShardBits(e.ec_index_bits)
+                collections[e.id] = e.collection
+    out = []
+    for vid, by_node in sorted(holdings.items()):
+        have = ShardBits(0)
+        for bits in by_node.values():
+            have = have.plus(bits)
+        count = have.count()
+        if count == TOTAL_SHARDS:
+            continue
+        if count < 10:
+            out.append(f"ec.rebuild {vid}: unrepairable ({count} shards)")
+            continue
+        out.append(_rebuild_one(env, vid, collections.get(vid, ""), by_node, have))
+    return "\n".join(out) if out else "ec.rebuild: nothing to do"
+
+
+def _rebuild_one(env: CommandEnv, vid: int, collection: str,
+                 by_node: dict[str, ShardBits], have: ShardBits) -> str:
+    # rebuilder = node already holding the most shards
+    rebuilder = max(by_node, key=lambda n: by_node[n].count())
+    stub = env.volume_server(_node_grpc(rebuilder))
+    # pull every shard the rebuilder lacks
+    local = by_node[rebuilder]
+    for node, bits in by_node.items():
+        if node == rebuilder:
+            continue
+        need = [s for s in bits.shard_ids() if not local.has(s)]
+        if not need:
+            continue
+        stub.VolumeEcShardsCopy(
+            vs.VolumeEcShardsCopyRequest(
+                volume_id=vid, collection=collection, shard_ids=need,
+                copy_from_data_node=_node_grpc(node),
+            )
+        )
+        for s in need:
+            local = local.add(s)
+    resp = stub.VolumeEcShardsRebuild(
+        vs.VolumeEcShardsRebuildRequest(volume_id=vid, collection=collection)
+    )
+    rebuilt = list(resp.rebuilt_shard_ids)
+    if rebuilt:
+        stub.VolumeEcShardsMount(
+            vs.VolumeEcShardsMountRequest(
+                volume_id=vid, collection=collection, shard_ids=rebuilt
+            )
+        )
+    # drop the staging copies that are mounted elsewhere
+    staged = [
+        s for s in local.shard_ids()
+        if s not in rebuilt and not by_node[rebuilder].has(s)
+    ]
+    if staged:
+        stub.VolumeEcShardsDelete(
+            vs.VolumeEcShardsDeleteRequest(
+                volume_id=vid, collection=collection, shard_ids=staged
+            )
+        )
+    return f"ec.rebuild {vid}: rebuilt {rebuilt} on {rebuilder}"
+
+
+@register("ec.balance")
+def ec_balance(env: CommandEnv, args: list[str]) -> str:
+    """Move shards from loaded nodes to nodes with more free EC slots."""
+    topo = env.topology()
+    nodes = {dn.id: dn for _dc, _rack, dn in _iter_nodes(topo)}
+    free = {nid: _free_ec_slots(dn) for nid, dn in nodes.items()}
+    shard_count = {
+        nid: sum(
+            ShardBits(e.ec_index_bits).count()
+            for disk in dn.disk_infos.values()
+            for e in disk.ec_shard_infos
+        )
+        for nid, dn in nodes.items()
+    }
+    if not shard_count:
+        return "ec.balance: no ec shards"
+    moves = []
+    avg = sum(shard_count.values()) / max(len(shard_count), 1)
+    for nid, dn in nodes.items():
+        while shard_count[nid] > avg + 1:
+            target = max(free, key=lambda n: (free[n] - shard_count[n], n != nid))
+            if target == nid or free[target] <= 0:
+                break
+            moved = _move_one_shard(env, topo, nid, target)
+            if not moved:
+                break
+            shard_count[nid] -= 1
+            shard_count[target] = shard_count.get(target, 0) + 1
+            free[target] -= 1
+            moves.append(f"{moved} {nid} -> {target}")
+            topo = env.topology()
+    return "ec.balance: " + ("; ".join(moves) if moves else "balanced")
+
+
+def _move_one_shard(env: CommandEnv, topo, source: str, target: str):
+    for _dc, _rack, dn in _iter_nodes(topo):
+        if dn.id != source:
+            continue
+        for disk in dn.disk_infos.values():
+            for e in disk.ec_shard_infos:
+                sids = ShardBits(e.ec_index_bits).shard_ids()
+                if not sids:
+                    continue
+                sid = sids[0]
+                tgt = env.volume_server(_node_grpc(target))
+                tgt.VolumeEcShardsCopy(
+                    vs.VolumeEcShardsCopyRequest(
+                        volume_id=e.id, collection=e.collection,
+                        shard_ids=[sid], copy_ecx_file=True,
+                        copy_ecj_file=True, copy_vif_file=True,
+                        copy_from_data_node=_node_grpc(source),
+                    )
+                )
+                tgt.VolumeEcShardsMount(
+                    vs.VolumeEcShardsMountRequest(
+                        volume_id=e.id, collection=e.collection,
+                        shard_ids=[sid],
+                    )
+                )
+                src = env.volume_server(_node_grpc(source))
+                src.VolumeEcShardsUnmount(
+                    vs.VolumeEcShardsUnmountRequest(
+                        volume_id=e.id, shard_ids=[sid]
+                    )
+                )
+                src.VolumeEcShardsDelete(
+                    vs.VolumeEcShardsDeleteRequest(
+                        volume_id=e.id, collection=e.collection,
+                        shard_ids=[sid],
+                    )
+                )
+                return f"{e.id}.{sid}"
+    return None
+
+
+@register("ec.decode")
+def ec_decode(env: CommandEnv, args: list[str]) -> str:
+    flags = _parse_flags(args)
+    vid = int(flags["volumeId"]) if "volumeId" in flags else None
+    collection = flags.get("collection", "")
+    topo = env.topology()
+    holdings: dict[int, dict[str, ShardBits]] = {}
+    collections: dict[int, str] = {}
+    for _dc, _rack, dn in _iter_nodes(topo):
+        for disk in dn.disk_infos.values():
+            for e in disk.ec_shard_infos:
+                holdings.setdefault(e.id, {})[dn.id] = ShardBits(e.ec_index_bits)
+                collections[e.id] = e.collection
+    targets = [vid] if vid is not None else sorted(holdings)
+    out = []
+    for v in targets:
+        by_node = holdings.get(v)
+        if not by_node:
+            out.append(f"ec.decode {v}: no shards")
+            continue
+        coll = collection or collections.get(v, "")
+        # gather all shards onto the node with the most
+        gather = max(by_node, key=lambda n: by_node[n].count())
+        stub = env.volume_server(_node_grpc(gather))
+        local = by_node[gather]
+        for node, bits in by_node.items():
+            if node == gather:
+                continue
+            need = [s for s in bits.shard_ids() if not local.has(s)]
+            if need:
+                stub.VolumeEcShardsCopy(
+                    vs.VolumeEcShardsCopyRequest(
+                        volume_id=v, collection=coll, shard_ids=need,
+                        copy_ecx_file=True, copy_ecj_file=True,
+                        copy_from_data_node=_node_grpc(node),
+                    )
+                )
+                for s in need:
+                    local = local.add(s)
+        stub.VolumeEcShardsToVolume(
+            vs.VolumeEcShardsToVolumeRequest(volume_id=v, collection=coll)
+        )
+        # drop EC remnants cluster-wide
+        for node in by_node:
+            env.volume_server(_node_grpc(node)).VolumeEcShardsDelete(
+                vs.VolumeEcShardsDeleteRequest(
+                    volume_id=v, collection=coll,
+                    shard_ids=list(range(TOTAL_SHARDS)),
+                )
+            )
+        out.append(f"ec.decode {v}: restored on {gather}")
+    return "\n".join(out)

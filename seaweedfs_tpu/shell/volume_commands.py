@@ -1,0 +1,220 @@
+"""Volume admin commands: volume.list / volume.vacuum / volume.fix.replication
+/ volume.balance / volume.move / volume.mount / volume.unmount / volume.delete.
+
+Reference: weed/shell/command_volume_*.go.  Placement decisions are pure
+functions over the TopologyInfo snapshot (tier-3 test pattern).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from ..pb import master_pb2
+from ..pb import volume_server_pb2 as vs
+from ..storage.replica_placement import ReplicaPlacement
+from .commands import CommandEnv, register
+from .ec_commands import _iter_nodes, _node_grpc, _parse_flags
+
+
+@register("volume.list")
+def volume_list(env: CommandEnv, args: list[str]) -> str:
+    topo = env.topology()
+    lines = []
+    for dc, rack, dn in _iter_nodes(topo):
+        for disk in dn.disk_infos.values():
+            vols = [
+                f"v{v.id}(size={v.size} files={v.file_count}"
+                f"{' ro' if v.read_only else ''})"
+                for v in disk.volume_infos
+            ]
+            ecs = [
+                f"ec{e.id}[{bin(e.ec_index_bits)}]" for e in disk.ec_shard_infos
+            ]
+            lines.append(
+                f"{dc}/{rack}/{dn.id}: {' '.join(vols + ecs) or '(empty)'}"
+            )
+    return "\n".join(lines)
+
+
+@register("volume.vacuum")
+def volume_vacuum(env: CommandEnv, args: list[str]) -> str:
+    flags = _parse_flags(args)
+    threshold = float(flags.get("garbageThreshold", "0.3"))
+    env.master().VacuumVolume(
+        master_pb2.VacuumVolumeRequest(garbage_threshold=threshold)
+    )
+    return "vacuum triggered"
+
+
+@register("volume.mount")
+def volume_mount(env: CommandEnv, args: list[str]) -> str:
+    flags = _parse_flags(args)
+    env.volume_server(flags["node"]).VolumeMount(
+        vs.VolumeMountRequest(volume_id=int(flags["volumeId"]))
+    )
+    return "mounted"
+
+
+@register("volume.unmount")
+def volume_unmount(env: CommandEnv, args: list[str]) -> str:
+    flags = _parse_flags(args)
+    env.volume_server(flags["node"]).VolumeUnmount(
+        vs.VolumeUnmountRequest(volume_id=int(flags["volumeId"]))
+    )
+    return "unmounted"
+
+
+@register("volume.delete")
+def volume_delete(env: CommandEnv, args: list[str]) -> str:
+    flags = _parse_flags(args)
+    env.volume_server(flags["node"]).VolumeDelete(
+        vs.VolumeDeleteRequest(volume_id=int(flags["volumeId"]))
+    )
+    return "deleted"
+
+
+@register("volume.move")
+def volume_move(env: CommandEnv, args: list[str]) -> str:
+    """Copy a volume to a target node, then delete from the source."""
+    flags = _parse_flags(args)
+    vid = int(flags["volumeId"])
+    source, target = flags["source"], flags["target"]
+    topo = env.topology()
+    collection = ""
+    for _dc, _rack, dn in _iter_nodes(topo):
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                if v.id == vid:
+                    collection = v.collection
+    env.volume_server(target).VolumeCopy(
+        vs.VolumeCopyRequest(
+            volume_id=vid, collection=collection, source_data_node=source
+        )
+    )
+    env.volume_server(source).VolumeDelete(vs.VolumeDeleteRequest(volume_id=vid))
+    return f"moved {vid} {source} -> {target}"
+
+
+def find_misplaced_volumes(topo: master_pb2.TopologyInfo) -> dict[int, dict]:
+    """Pure analysis: vid -> {want, have, locations} for under/over-replication."""
+    placements: dict[int, dict] = {}
+    for dc, rack, dn in _iter_nodes(topo):
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                p = placements.setdefault(
+                    v.id,
+                    {"want": ReplicaPlacement.from_byte(v.replica_placement)
+                     .copy_count(), "locations": [], "collection": v.collection},
+                )
+                p["locations"].append((dc, rack, dn.id))
+    return {
+        vid: {**p, "have": len(p["locations"])}
+        for vid, p in placements.items()
+        if len(p["locations"]) != p["want"]
+    }
+
+
+@register("volume.fix.replication")
+def volume_fix_replication(env: CommandEnv, args: list[str]) -> str:
+    topo = env.topology()
+    issues = find_misplaced_volumes(topo)
+    if not issues:
+        return "volume.fix.replication: all volumes healthy"
+    nodes = {dn.id: dn for _dc, _rack, dn in _iter_nodes(topo)}
+    fixed = []
+    for vid, info in sorted(issues.items()):
+        have, want = info["have"], info["want"]
+        locs = [n for _dc, _rack, n in info["locations"]]
+        if have < want:
+            candidates = [
+                nid for nid, dn in nodes.items()
+                if nid not in locs and _free_slots(dn) > 0
+            ]
+            if not candidates:
+                fixed.append(f"{vid}: under-replicated, no target")
+                continue
+            target = candidates[0]
+            try:
+                env.volume_server(_node_grpc(target)).VolumeCopy(
+                    vs.VolumeCopyRequest(
+                        volume_id=vid, collection=info["collection"],
+                        source_data_node=_node_grpc(locs[0]),
+                    )
+                )
+                fixed.append(f"{vid}: copied to {target}")
+            except grpc.RpcError as e:
+                fixed.append(f"{vid}: copy failed: {e.code()}")
+        elif have > want:
+            victim = locs[-1]
+            try:
+                env.volume_server(_node_grpc(victim)).VolumeDelete(
+                    vs.VolumeDeleteRequest(volume_id=vid)
+                )
+                fixed.append(f"{vid}: removed extra replica on {victim}")
+            except grpc.RpcError as e:
+                fixed.append(f"{vid}: delete failed: {e.code()}")
+    return "\n".join(fixed)
+
+
+def _free_slots(dn) -> int:
+    free = 0
+    for disk in dn.disk_infos.values():
+        free += max(disk.max_volume_count - disk.volume_count, 0)
+    return free
+
+
+@register("volume.balance")
+def volume_balance(env: CommandEnv, args: list[str]) -> str:
+    """Even out volume counts across nodes (greedy, like the reference)."""
+    topo = env.topology()
+    nodes = {dn.id: dn for _dc, _rack, dn in _iter_nodes(topo)}
+    counts = {
+        nid: sum(d.volume_count for d in dn.disk_infos.values())
+        for nid, dn in nodes.items()
+    }
+    if not counts:
+        return "volume.balance: no nodes"
+    moves = []
+    avg = sum(counts.values()) / len(counts)
+    for nid in sorted(counts, key=counts.get, reverse=True):
+        while counts[nid] > avg + 1:
+            target = min(counts, key=counts.get)
+            if counts[target] >= avg:
+                break
+            vid = _pick_volume_on(topo, nid)
+            if vid is None:
+                break
+            try:
+                run = volume_move(
+                    env,
+                    [f"-volumeId={vid}", f"-source={_node_grpc(nid)}",
+                     f"-target={_node_grpc(target)}"],
+                )
+                moves.append(run)
+                counts[nid] -= 1
+                counts[target] += 1
+                topo = env.topology()
+            except grpc.RpcError:
+                break
+    return "volume.balance: " + ("; ".join(moves) if moves else "balanced")
+
+
+def _pick_volume_on(topo, node_id: str):
+    for _dc, _rack, dn in _iter_nodes(topo):
+        if dn.id != node_id:
+            continue
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                return v.id
+    return None
+
+
+@register("lock")
+def lock_cmd(env: CommandEnv, args: list[str]) -> str:
+    return "locked" if env.acquire_lock() else "lock busy"
+
+
+@register("unlock")
+def unlock_cmd(env: CommandEnv, args: list[str]) -> str:
+    env.release_lock()
+    return "unlocked"

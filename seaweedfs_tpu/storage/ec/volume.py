@@ -67,9 +67,11 @@ class EcVolume:
         codec_name: str = "cpu",
         large_block_size: int = LARGE_BLOCK_SIZE,
         small_block_size: int = SMALL_BLOCK_SIZE,
+        collection: str = "",
     ):
         self.base_name = base_name
         self.volume_id = volume_id
+        self.collection = collection
         self.version = version
         self.codec = get_codec(codec_name)
         self.large_block_size = large_block_size

@@ -1,0 +1,447 @@
+"""Store: the per-volume-server aggregate over disk locations.
+
+Routes needle operations by volume id, manages EC volumes/shards, and builds
+master heartbeats with full + incremental (delta) volume and EC registrations.
+Reference: weed/storage/store.go + store_ec.go.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from ..pb import master_pb2
+from .disk_location import DiskLocation
+from .ec import constants as ecc
+from .ec.encoder import (
+    rebuild_ec_files,
+    write_ec_files,
+    write_sorted_file_from_idx,
+)
+from .ec.decoder import (
+    find_dat_file_size,
+    write_dat_file,
+    write_idx_file_from_ec_index,
+)
+from .ec.shard_bits import ShardBits
+from .ec.volume import EcVolume
+from .needle import Needle
+from .replica_placement import ReplicaPlacement
+from .super_block import CURRENT_VERSION, SuperBlock
+from .ttl import TTL
+from .vacuum import commit_compact, compact
+from .vif import save_volume_info
+
+
+class Store:
+    def __init__(
+        self,
+        directories: list[str],
+        ip: str = "localhost",
+        port: int = 8080,
+        public_url: str = "",
+        data_center: str = "",
+        rack: str = "",
+        codec_name: str = "cpu",
+        max_volume_counts: dict[str, int] | None = None,
+    ):
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.data_center = data_center
+        self.rack = rack
+        self.codec_name = codec_name
+        self.locations = [
+            DiskLocation(d, codec_name=codec_name) for d in directories
+        ]
+        self.max_volume_counts = max_volume_counts or {
+            "": sum(loc.max_volume_count for loc in self.locations)
+        }
+        self._lock = threading.RLock()
+        # delta channels to the master (drained into heartbeats)
+        self.new_volumes: list[master_pb2.VolumeShortInformationMessage] = []
+        self.deleted_volumes: list[master_pb2.VolumeShortInformationMessage] = []
+        self.new_ec_shards: list[master_pb2.VolumeEcShardInformationMessage] = []
+        self.deleted_ec_shards: list[master_pb2.VolumeEcShardInformationMessage] = []
+        self.volume_size_limit = 30 * 1024 * 1024 * 1024
+        # vid -> FetchFn factory, injected by the volume server so EcVolumes
+        # can read remote shards (store_ec.go's readRemoteEcShardInterval)
+        self.ec_fetcher_factory = None
+
+    # -- lookup -----------------------------------------------------------
+
+    def find_volume(self, vid: int):
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def find_ec_volume(self, vid: int) -> EcVolume | None:
+        for loc in self.locations:
+            ev = loc.ec_volumes.get(vid)
+            if ev is not None:
+                return ev
+        return None
+
+    def _location_of(self, vid: int) -> DiskLocation | None:
+        for loc in self.locations:
+            if vid in loc.volumes or vid in loc.ec_volumes:
+                return loc
+        return None
+
+    def has_free_location(self) -> DiskLocation | None:
+        best, free = None, 0
+        for loc in self.locations:
+            f = loc.max_volume_count - loc.volume_count()
+            if f > free:
+                best, free = loc, f
+        return best
+
+    # -- volume lifecycle -------------------------------------------------
+
+    def add_volume(self, vid: int, collection: str, replication: str = "000",
+                   ttl: str = "", preallocate: int = 0) -> None:
+        with self._lock:
+            if self.find_volume(vid) is not None:
+                raise ValueError(f"volume {vid} already exists")
+            loc = self.has_free_location()
+            if loc is None:
+                raise IOError("no free disk location")
+            sb = SuperBlock(
+                version=CURRENT_VERSION,
+                replica_placement=ReplicaPlacement.parse(replication),
+                ttl=TTL.parse(ttl),
+            )
+            v = loc.add_volume(vid, collection, super_block=sb)
+            save_volume_info(v.file_name() + ".vif", v.version)
+            self.new_volumes.append(self._short_info(v))
+
+    def delete_volume(self, vid: int) -> bool:
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.get(vid)
+                if v is not None:
+                    info = self._short_info(v)
+                    if loc.delete_volume(vid):
+                        self.deleted_volumes.append(info)
+                        return True
+            return False
+
+    def unmount_volume(self, vid: int) -> bool:
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.get(vid)
+                if v is not None:
+                    info = self._short_info(v)
+                    if loc.unmount_volume(vid):
+                        self.deleted_volumes.append(info)
+                        return True
+            return False
+
+    def mount_volume(self, vid: int) -> bool:
+        with self._lock:
+            for loc in self.locations:
+                for fname in os.listdir(loc.directory):
+                    if not fname.endswith(".dat"):
+                        continue
+                    base = fname[:-4]
+                    from .disk_location import parse_volume_file_name
+
+                    try:
+                        collection, fvid = parse_volume_file_name(base)
+                    except ValueError:
+                        continue
+                    if fvid == vid:
+                        v = loc.add_volume(vid, collection)
+                        self.new_volumes.append(self._short_info(v))
+                        return True
+            return False
+
+    def mark_readonly(self, vid: int) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        v.read_only = True
+        return True
+
+    def mark_writable(self, vid: int) -> bool:
+        v = self.find_volume(vid)
+        if v is None:
+            return False
+        v.read_only = False
+        return True
+
+    # -- needle ops -------------------------------------------------------
+
+    def write_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        _offset, size = v.append_needle(n)
+        return size
+
+    def read_needle(self, vid: int, needle_id: int,
+                    expected_cookie: int | None = None) -> Needle:
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.read_needle(needle_id, expected_cookie)
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            return ev.read_needle(needle_id)
+        raise KeyError(f"volume {vid} not found")
+
+    def delete_needle(self, vid: int, needle_id: int) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        return v.delete_needle(needle_id)
+
+    # -- vacuum -----------------------------------------------------------
+
+    def check_compact_volume(self, vid: int) -> float:
+        v = self.find_volume(vid)
+        return v.garbage_level() if v else 0.0
+
+    def compact_volume(self, vid: int) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        _base, snapshot = compact(v)
+        self._compact_snapshots = getattr(self, "_compact_snapshots", {})
+        self._compact_snapshots[vid] = snapshot
+        return snapshot
+
+    def commit_compact_volume(self, vid: int) -> None:
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        snapshot = getattr(self, "_compact_snapshots", {}).pop(vid, None)
+        if snapshot is None:
+            raise ValueError(f"no compaction in progress for {vid}")
+        commit_compact(v, snapshot)
+
+    def cleanup_compact_volume(self, vid: int) -> None:
+        v = self.find_volume(vid)
+        if v is None:
+            return
+        base = v.file_name()
+        for ext in (".cpd", ".cpx"):
+            try:
+                os.remove(base + ext)
+            except FileNotFoundError:
+                pass
+        getattr(self, "_compact_snapshots", {}).pop(vid, None)
+
+    # -- EC ops -----------------------------------------------------------
+
+    def generate_ec_shards(self, vid: int, collection: str,
+                           codec_name: str | None = None) -> None:
+        """The VolumeEcShardsGenerate work: .dat -> .ecNN + .ecx + .vif."""
+        v = self.find_volume(vid)
+        if v is None:
+            raise KeyError(f"volume {vid} not found")
+        base = v.file_name()
+        v.sync()
+        write_ec_files(base, codec_name=codec_name or self.codec_name)
+        write_sorted_file_from_idx(base)
+        save_volume_info(base + ".vif", v.version)
+
+    def rebuild_ec_shards(self, vid: int, collection: str,
+                          codec_name: str | None = None) -> list[int]:
+        base = self._ec_base(vid, collection)
+        return rebuild_ec_files(base, codec_name=codec_name or self.codec_name)
+
+    def _ec_base(self, vid: int, collection: str = "") -> str:
+        for loc in self.locations:
+            ev = loc.ec_volumes.get(vid)
+            if ev is not None:
+                return ev.base_name
+            base = loc.base_name(vid, collection)
+            if os.path.exists(base + ".ecx") or os.path.exists(base + ".ec00"):
+                return base
+            base = loc.base_name(vid, "")
+            if os.path.exists(base + ".ecx") or os.path.exists(base + ".ec00"):
+                return base
+        raise KeyError(f"ec volume {vid} not found")
+
+    def mount_ec_shards(self, vid: int, collection: str,
+                        shard_ids: list[int]) -> None:
+        with self._lock:
+            ev = self.find_ec_volume(vid)
+            if ev is None:
+                base = self._ec_base(vid, collection)
+                ev = EcVolume(base, vid, codec_name=self.codec_name)
+                ev.collection = collection
+                if self.ec_fetcher_factory is not None:
+                    ev.remote_fetch = self.ec_fetcher_factory(vid)
+                # keep only the requested shards mounted
+                for sid in list(ev.shards):
+                    if sid not in shard_ids:
+                        ev.delete_shard(sid)
+                self._location_for_base(base).ec_volumes[vid] = ev
+            else:
+                for sid in shard_ids:
+                    ev.add_shard(sid)
+            self.new_ec_shards.append(
+                master_pb2.VolumeEcShardInformationMessage(
+                    id=vid,
+                    collection=collection,
+                    ec_index_bits=int(_bits(shard_ids)),
+                )
+            )
+
+    def _location_for_base(self, base: str) -> DiskLocation:
+        d = os.path.dirname(base)
+        for loc in self.locations:
+            if loc.directory == d:
+                return loc
+        return self.locations[0]
+
+    def unmount_ec_shards(self, vid: int, shard_ids: list[int]) -> None:
+        with self._lock:
+            ev = self.find_ec_volume(vid)
+            if ev is None:
+                return
+            for sid in shard_ids:
+                ev.delete_shard(sid)
+            self.deleted_ec_shards.append(
+                master_pb2.VolumeEcShardInformationMessage(
+                    id=vid,
+                    collection=getattr(ev, "collection", ""),
+                    ec_index_bits=int(_bits(shard_ids)),
+                )
+            )
+            if not ev.shards:
+                for loc in self.locations:
+                    if loc.ec_volumes.get(vid) is ev:
+                        del loc.ec_volumes[vid]
+                ev.close()
+
+    def delete_ec_shards(self, vid: int, collection: str,
+                         shard_ids: list[int]) -> None:
+        with self._lock:
+            self.unmount_ec_shards(vid, shard_ids)
+            try:
+                base = self._ec_base(vid, collection)
+            except KeyError:
+                return
+            for sid in shard_ids:
+                try:
+                    os.remove(base + ecc.to_ext(sid))
+                except FileNotFoundError:
+                    pass
+            # if no shards remain on disk, remove the index files too
+            if not any(
+                os.path.exists(base + ecc.to_ext(i))
+                for i in range(ecc.TOTAL_SHARDS)
+            ):
+                for ext in (".ecx", ".ecj"):
+                    try:
+                        os.remove(base + ext)
+                    except FileNotFoundError:
+                        pass
+
+    def ec_shards_to_volume(self, vid: int, collection: str) -> None:
+        """Convert a complete local EC volume back to a normal volume."""
+        base = self._ec_base(vid, collection)
+        dat_size = find_dat_file_size(base, base)
+        write_dat_file(base, dat_size)
+        write_idx_file_from_ec_index(base)
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            self.unmount_ec_shards(vid, list(ev.shards))
+        self.mount_volume(vid)
+
+    # -- heartbeat --------------------------------------------------------
+
+    def _short_info(self, v) -> master_pb2.VolumeShortInformationMessage:
+        return master_pb2.VolumeShortInformationMessage(
+            id=v.volume_id,
+            collection=v.collection,
+            replica_placement=v.super_block.replica_placement.to_byte(),
+            version=v.version,
+            ttl=v.super_block.ttl.to_uint32(),
+            disk_type="",
+        )
+
+    def collect_heartbeat(self) -> master_pb2.Heartbeat:
+        hb = master_pb2.Heartbeat(
+            ip=self.ip,
+            port=self.port,
+            public_url=self.public_url,
+            data_center=self.data_center,
+            rack=self.rack,
+        )
+        max_key = 0
+        for loc in self.locations:
+            for v in loc.volumes.values():
+                max_key = max(max_key, v.needle_map.maximum_key)
+                hb.volumes.add(
+                    id=v.volume_id,
+                    size=v.content_size,
+                    collection=v.collection,
+                    file_count=v.file_count(),
+                    delete_count=v.needle_map.deleted_count,
+                    deleted_byte_count=v.needle_map.deleted_bytes,
+                    read_only=v.read_only,
+                    replica_placement=v.super_block.replica_placement.to_byte(),
+                    version=v.version,
+                    ttl=v.super_block.ttl.to_uint32(),
+                    compact_revision=v.super_block.compaction_revision,
+                )
+            for vid, ev in loc.ec_volumes.items():
+                hb.ec_shards.add(
+                    id=vid,
+                    collection=getattr(ev, "collection", ""),
+                    ec_index_bits=int(_bits(ev.shard_ids())),
+                )
+        hb.max_file_key = max_key
+        for k, c in self.max_volume_counts.items():
+            hb.max_volume_counts[k] = c
+        if not hb.volumes:
+            hb.has_no_volumes = True
+        if not hb.ec_shards:
+            hb.has_no_ec_shards = True
+        return hb
+
+    def drain_deltas(self):
+        """Pop pending incremental registrations for the heartbeat stream."""
+        with self._lock:
+            out = (
+                self.new_volumes,
+                self.deleted_volumes,
+                self.new_ec_shards,
+                self.deleted_ec_shards,
+            )
+            self.new_volumes = []
+            self.deleted_volumes = []
+            self.new_ec_shards = []
+            self.deleted_ec_shards = []
+            return out
+
+    def status(self) -> dict:
+        return {
+            "volumes": sorted(
+                vid for loc in self.locations for vid in loc.volumes
+            ),
+            "ec_volumes": {
+                vid: ev.shard_ids()
+                for loc in self.locations
+                for vid, ev in loc.ec_volumes.items()
+            },
+        }
+
+    def close(self) -> None:
+        for loc in self.locations:
+            for v in loc.volumes.values():
+                v.close()
+            for ev in loc.ec_volumes.values():
+                ev.close()
+
+
+def _bits(shard_ids) -> ShardBits:
+    b = ShardBits(0)
+    for sid in shard_ids:
+        b = b.add(sid)
+    return b

@@ -1,0 +1,34 @@
+"""`.vif` sidecar: protobuf VolumeInfo (version, tier files, replication).
+
+Reference: weed/pb/volume_info.go — written as protobuf-JSON text in the
+reference; we write binary protobuf with a JSON fallback reader for
+interoperability with hand-edited files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from google.protobuf import json_format
+
+from ..pb import volume_info_pb2
+
+
+def save_volume_info(path: str, version: int, replication: str = "") -> None:
+    info = volume_info_pb2.VolumeInfo(version=version, replication=replication)
+    with open(path, "w") as f:
+        f.write(json_format.MessageToJson(info))
+
+
+def load_volume_info(path: str) -> volume_info_pb2.VolumeInfo | None:
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        raw = f.read()
+    if not raw:
+        return None
+    try:
+        return json_format.Parse(raw.decode("utf-8"), volume_info_pb2.VolumeInfo())
+    except (json.JSONDecodeError, json_format.ParseError, UnicodeDecodeError):
+        return volume_info_pb2.VolumeInfo.FromString(raw)

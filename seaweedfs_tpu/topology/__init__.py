@@ -1,0 +1,2 @@
+from .topology import DataNode, Topology, VolumeInfo  # noqa: F401
+from .volume_layout import VolumeLayout  # noqa: F401

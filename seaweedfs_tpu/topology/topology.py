@@ -1,0 +1,228 @@
+"""Cluster topology: DC -> rack -> data node tree with volume/EC bookkeeping.
+
+Reference: weed/topology/ (node tree, topology.go, topology_ec.go).  The
+tree is kept as flat dicts keyed by node id ("ip:port") with dc/rack
+attributes — placement logic consumes snapshots, not the tree itself, so
+the Go pointer-tree shape isn't load-bearing and is not reproduced.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..pb import master_pb2
+from ..storage.ec.shard_bits import ShardBits
+
+
+@dataclass
+class VolumeInfo:
+    volume_id: int
+    size: int = 0
+    collection: str = ""
+    file_count: int = 0
+    delete_count: int = 0
+    deleted_byte_count: int = 0
+    read_only: bool = False
+    replica_placement: int = 0
+    version: int = 3
+    ttl: int = 0
+    compact_revision: int = 0
+
+    @classmethod
+    def from_pb(cls, m: master_pb2.VolumeInformationMessage) -> "VolumeInfo":
+        return cls(
+            volume_id=m.id,
+            size=m.size,
+            collection=m.collection,
+            file_count=m.file_count,
+            delete_count=m.delete_count,
+            deleted_byte_count=m.deleted_byte_count,
+            read_only=m.read_only,
+            replica_placement=m.replica_placement,
+            version=m.version,
+            ttl=m.ttl,
+            compact_revision=m.compact_revision,
+        )
+
+
+@dataclass
+class DataNode:
+    id: str  # "ip:port" (HTTP url)
+    public_url: str
+    grpc_address: str
+    data_center: str = "DefaultDataCenter"
+    rack: str = "DefaultRack"
+    max_volumes: int = 7
+    volumes: dict = field(default_factory=dict)  # vid -> VolumeInfo
+    ec_shards: dict = field(default_factory=dict)  # vid -> ShardBits
+    ec_collections: dict = field(default_factory=dict)  # vid -> collection
+    last_seen: float = field(default_factory=time.monotonic)
+
+    def free_slots(self) -> int:
+        return self.max_volumes - len(self.volumes) - (len(self.ec_shards) + 9) // 10
+
+    def free_ec_slots(self) -> int:
+        used = sum(ShardBits(b).count() for b in self.ec_shards.values())
+        return (self.max_volumes - len(self.volumes)) * 10 - used
+
+
+class Topology:
+    def __init__(self, volume_size_limit: int = 30 * 1024**3,
+                 pulse_seconds: float = 5.0):
+        self.nodes: dict[str, DataNode] = {}
+        self.volume_size_limit = volume_size_limit
+        self.pulse_seconds = pulse_seconds
+        self.lock = threading.RLock()
+        self.max_volume_id = 0
+
+    # -- membership -------------------------------------------------------
+
+    def register_node(self, node: DataNode) -> DataNode:
+        with self.lock:
+            existing = self.nodes.get(node.id)
+            if existing is None:
+                self.nodes[node.id] = node
+                return node
+            existing.last_seen = time.monotonic()
+            existing.public_url = node.public_url
+            existing.grpc_address = node.grpc_address
+            if node.data_center:
+                existing.data_center = node.data_center
+            if node.rack:
+                existing.rack = node.rack
+            if node.max_volumes:
+                existing.max_volumes = node.max_volumes
+            return existing
+
+    def unregister_node(self, node_id: str) -> list[int]:
+        """Remove a node; returns vids whose locations changed."""
+        with self.lock:
+            node = self.nodes.pop(node_id, None)
+            if node is None:
+                return []
+            return list(node.volumes) + list(node.ec_shards)
+
+    def collect_dead_nodes(self) -> list[str]:
+        """Nodes silent for 3 missed pulses (topology_event_handling.go:17)."""
+        cutoff = time.monotonic() - 3 * self.pulse_seconds
+        with self.lock:
+            return [nid for nid, n in self.nodes.items() if n.last_seen < cutoff]
+
+    # -- volume bookkeeping ----------------------------------------------
+
+    def sync_volumes(self, node: DataNode,
+                     volumes: list[master_pb2.VolumeInformationMessage]) -> None:
+        with self.lock:
+            node.volumes = {m.id: VolumeInfo.from_pb(m) for m in volumes}
+            for m in volumes:
+                self.max_volume_id = max(self.max_volume_id, m.id)
+            node.last_seen = time.monotonic()
+
+    def sync_ec_shards(self, node: DataNode,
+                       shards: list[master_pb2.VolumeEcShardInformationMessage]) -> None:
+        with self.lock:
+            node.ec_shards = {m.id: ShardBits(m.ec_index_bits) for m in shards}
+            node.ec_collections = {m.id: m.collection for m in shards}
+            node.last_seen = time.monotonic()
+
+    def apply_incremental(self, node: DataNode, hb: master_pb2.Heartbeat) -> None:
+        with self.lock:
+            for m in hb.new_volumes:
+                node.volumes[m.id] = VolumeInfo(
+                    volume_id=m.id, collection=m.collection,
+                    replica_placement=m.replica_placement, version=m.version,
+                    ttl=m.ttl,
+                )
+                self.max_volume_id = max(self.max_volume_id, m.id)
+            for m in hb.deleted_volumes:
+                node.volumes.pop(m.id, None)
+            for m in hb.new_ec_shards:
+                bits = node.ec_shards.get(m.id, ShardBits(0))
+                node.ec_shards[m.id] = bits.plus(m.ec_index_bits)
+                node.ec_collections[m.id] = m.collection
+            for m in hb.deleted_ec_shards:
+                bits = node.ec_shards.get(m.id, ShardBits(0))
+                left = bits.minus(m.ec_index_bits)
+                if left:
+                    node.ec_shards[m.id] = left
+                else:
+                    node.ec_shards.pop(m.id, None)
+            node.last_seen = time.monotonic()
+
+    # -- lookups ----------------------------------------------------------
+
+    def lookup_volume(self, vid: int) -> list[DataNode]:
+        with self.lock:
+            return [n for n in self.nodes.values() if vid in n.volumes]
+
+    def lookup_ec_shards(self, vid: int) -> dict[int, list[DataNode]]:
+        """shard id -> nodes holding it."""
+        out: dict[int, list[DataNode]] = {}
+        with self.lock:
+            for n in self.nodes.values():
+                bits = n.ec_shards.get(vid)
+                if bits is None:
+                    continue
+                for sid in ShardBits(bits).shard_ids():
+                    out.setdefault(sid, []).append(n)
+        return out
+
+    def next_volume_id(self) -> int:
+        with self.lock:
+            self.max_volume_id += 1
+            return self.max_volume_id
+
+    def collections(self) -> set[str]:
+        with self.lock:
+            names = set()
+            for n in self.nodes.values():
+                for v in n.volumes.values():
+                    names.add(v.collection)
+                for c in n.ec_collections.values():
+                    names.add(c)
+            return names
+
+    def to_topology_info(self) -> master_pb2.TopologyInfo:
+        """Snapshot for VolumeList / shell placement logic."""
+        info = master_pb2.TopologyInfo(id="topo")
+        with self.lock:
+            dcs: dict[str, master_pb2.DataCenterInfo] = {}
+            racks: dict[tuple[str, str], master_pb2.RackInfo] = {}
+            for n in self.nodes.values():
+                dc = dcs.get(n.data_center)
+                if dc is None:
+                    dc = info.data_center_infos.add(id=n.data_center)
+                    dcs[n.data_center] = dc
+                rack_key = (n.data_center, n.rack)
+                rack = racks.get(rack_key)
+                if rack is None:
+                    rack = dc.rack_infos.add(id=n.rack)
+                    racks[rack_key] = rack
+                dn = rack.data_node_infos.add(id=n.id)
+                disk = dn.disk_infos[""]
+                disk.volume_count = len(n.volumes)
+                disk.max_volume_count = n.max_volumes
+                disk.free_volume_count = n.free_slots()
+                disk.active_volume_count = len(n.volumes)
+                for v in n.volumes.values():
+                    disk.volume_infos.add(
+                        id=v.volume_id,
+                        size=v.size,
+                        collection=v.collection,
+                        file_count=v.file_count,
+                        delete_count=v.delete_count,
+                        deleted_byte_count=v.deleted_byte_count,
+                        read_only=v.read_only,
+                        replica_placement=v.replica_placement,
+                        version=v.version,
+                        ttl=v.ttl,
+                    )
+                for vid, bits in n.ec_shards.items():
+                    disk.ec_shard_infos.add(
+                        id=vid,
+                        collection=n.ec_collections.get(vid, ""),
+                        ec_index_bits=int(bits),
+                    )
+        return info

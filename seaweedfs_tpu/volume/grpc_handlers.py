@@ -1,0 +1,384 @@
+"""Volume-server gRPC service implementation.
+
+Covers the admin surface incl. the nine erasure-coding rpcs
+(reference: weed/server/volume_grpc_erasure_coding.go, volume_grpc_vacuum.go,
+volume_grpc_admin.go, volume_grpc_copy.go).  EC generate/rebuild dispatch
+into the codec selected per-request (`codec` field) or the server default —
+this is the `-ec.codec=tpu` switch at the rpc boundary.
+"""
+
+from __future__ import annotations
+
+import os
+
+import grpc
+
+from ..pb import rpc as rpclib
+from ..pb import volume_server_pb2 as vs
+from ..storage import types as t
+from ..storage.ec import constants as ecc
+from ..storage.needle import Needle, actual_size
+
+COPY_CHUNK = 1024 * 1024
+
+
+class VolumeGrpcService:
+    def __init__(self, server):
+        self.server = server  # VolumeServer
+        self.store = server.store
+
+    # -- volume lifecycle -------------------------------------------------
+
+    def AllocateVolume(self, request, context):
+        self.store.add_volume(
+            request.volume_id,
+            request.collection,
+            replication=request.replication or "000",
+            ttl=request.ttl,
+            preallocate=request.preallocate,
+        )
+        return vs.AllocateVolumeResponse()
+
+    def VolumeMount(self, request, context):
+        if not self.store.mount_volume(request.volume_id):
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        return vs.VolumeMountResponse()
+
+    def VolumeUnmount(self, request, context):
+        if not self.store.unmount_volume(request.volume_id):
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        return vs.VolumeUnmountResponse()
+
+    def VolumeDelete(self, request, context):
+        self.store.delete_volume(request.volume_id)
+        return vs.VolumeDeleteResponse()
+
+    def VolumeMarkReadonly(self, request, context):
+        if not self.store.mark_readonly(request.volume_id):
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        return vs.VolumeMarkReadonlyResponse()
+
+    def VolumeMarkWritable(self, request, context):
+        if not self.store.mark_writable(request.volume_id):
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        return vs.VolumeMarkWritableResponse()
+
+    def VolumeStatus(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        return vs.VolumeStatusResponse(is_read_only=v.read_only)
+
+    def VolumeConfigure(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            return vs.VolumeConfigureResponse(error="volume not found")
+        from ..storage.replica_placement import ReplicaPlacement
+
+        v.super_block.replica_placement = ReplicaPlacement.parse(
+            request.replication
+        )
+        return vs.VolumeConfigureResponse()
+
+    def DeleteCollection(self, request, context):
+        for loc in self.store.locations:
+            for vid, v in list(loc.volumes.items()):
+                if v.collection == request.collection:
+                    self.store.delete_volume(vid)
+        return vs.DeleteCollectionResponse()
+
+    # -- needle ops -------------------------------------------------------
+
+    def BatchDelete(self, request, context):
+        from ..storage.file_id import FileId
+
+        resp = vs.BatchDeleteResponse()
+        for fid_str in request.file_ids:
+            r = resp.results.add(file_id=fid_str)
+            try:
+                fid = FileId.parse(fid_str)
+                if not request.skip_cookie_check:
+                    n = self.store.read_needle(fid.volume_id, fid.key)
+                    if n.cookie != fid.cookie:
+                        r.status, r.error = 403, "cookie mismatch"
+                        continue
+                size = self.store.delete_needle(fid.volume_id, fid.key)
+                r.status, r.size = 202, size
+            except KeyError:
+                r.status, r.error = 404, "not found"
+            except Exception as e:  # pragma: no cover
+                r.status, r.error = 500, str(e)
+        return resp
+
+    def ReadNeedleBlob(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        with v._lock:
+            v._dat.seek(request.offset)
+            blob = v._dat.read(actual_size(request.size, v.version))
+        return vs.ReadNeedleBlobResponse(needle_blob=blob)
+
+    def WriteNeedleBlob(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        n = Needle.from_bytes(request.needle_blob, v.version, verify=False)
+        v.append_needle(n)
+        return vs.WriteNeedleBlobResponse()
+
+    def ReadAllNeedles(self, request, context):
+        for vid in request.volume_ids:
+            v = self.store.find_volume(vid)
+            if v is None:
+                continue
+            for nv in list(v.needle_map.items_ascending()):
+                n = v.read_needle(nv.key)
+                yield vs.ReadAllNeedlesResponse(
+                    volume_id=vid,
+                    needle_id=nv.key,
+                    cookie=n.cookie,
+                    needle_blob=n.data,
+                )
+
+    # -- vacuum (4-phase protocol) ----------------------------------------
+
+    def VacuumVolumeCheck(self, request, context):
+        ratio = self.store.check_compact_volume(request.volume_id)
+        return vs.VacuumVolumeCheckResponse(garbage_ratio=ratio)
+
+    def VacuumVolumeCompact(self, request, context):
+        self.store.compact_volume(request.volume_id)
+        return vs.VacuumVolumeCompactResponse()
+
+    def VacuumVolumeCommit(self, request, context):
+        self.store.commit_compact_volume(request.volume_id)
+        v = self.store.find_volume(request.volume_id)
+        return vs.VacuumVolumeCommitResponse(
+            is_read_only=bool(v and v.read_only)
+        )
+
+    def VacuumVolumeCleanup(self, request, context):
+        self.store.cleanup_compact_volume(request.volume_id)
+        return vs.VacuumVolumeCleanupResponse()
+
+    # -- status / sync ----------------------------------------------------
+
+    def VolumeSyncStatus(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        return vs.VolumeSyncStatusResponse(
+            volume_id=v.volume_id,
+            collection=v.collection,
+            replication=str(v.super_block.replica_placement),
+            ttl=str(v.super_block.ttl),
+            tail_offset=v.content_size,
+            compact_revision=v.super_block.compaction_revision,
+            idx_file_size=os.path.getsize(v.file_name() + ".idx")
+            if os.path.exists(v.file_name() + ".idx")
+            else 0,
+        )
+
+    def ReadVolumeFileStatus(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        base = v.file_name()
+        return vs.ReadVolumeFileStatusResponse(
+            volume_id=v.volume_id,
+            idx_file_size=os.path.getsize(base + ".idx")
+            if os.path.exists(base + ".idx")
+            else 0,
+            dat_file_size=v.content_size,
+            file_count=v.file_count(),
+            compaction_revision=v.super_block.compaction_revision,
+            collection=v.collection,
+        )
+
+    def VolumeServerStatus(self, request, context):
+        resp = vs.VolumeServerStatusResponse()
+        for loc in self.store.locations:
+            st = os.statvfs(loc.directory)
+            all_b = st.f_blocks * st.f_frsize
+            free_b = st.f_bavail * st.f_frsize
+            resp.disk_statuses.add(
+                dir=loc.directory,
+                all=all_b,
+                used=all_b - free_b,
+                free=free_b,
+                percent_free=100.0 * free_b / all_b if all_b else 0.0,
+                percent_used=100.0 * (all_b - free_b) / all_b if all_b else 0.0,
+            )
+        return resp
+
+    def VolumeServerLeave(self, request, context):
+        self.server.stop_heartbeat()
+        return vs.VolumeServerLeaveResponse()
+
+    # -- bulk file copy ---------------------------------------------------
+
+    def CopyFile(self, request, context):
+        if request.is_ec_volume:
+            base = self.store._ec_base(request.volume_id, request.collection)
+        else:
+            v = self.store.find_volume(request.volume_id)
+            if v is None:
+                context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+            base = v.file_name()
+        path = base + request.ext
+        if not os.path.exists(path):
+            if request.ignore_source_file_not_found:
+                return
+            context.abort(grpc.StatusCode.NOT_FOUND, f"{path} not found")
+        stop = request.stop_offset or os.path.getsize(path)
+        with open(path, "rb") as f:
+            sent = 0
+            while sent < stop:
+                chunk = f.read(min(COPY_CHUNK, stop - sent))
+                if not chunk:
+                    break
+                sent += len(chunk)
+                yield vs.CopyFileResponse(file_content=chunk)
+
+    def VolumeCopy(self, request, context):
+        """Pull a whole volume (.dat/.idx/.vif) from another volume server."""
+        loc = self.store.has_free_location()
+        if loc is None:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "no free slot")
+        base = loc.base_name(request.volume_id, request.collection)
+        src = rpclib.volume_server_stub(request.source_data_node)
+        for ext in (".dat", ".idx", ".vif"):
+            stream = src.CopyFile(
+                vs.CopyFileRequest(
+                    volume_id=request.volume_id,
+                    collection=request.collection,
+                    ext=ext,
+                    ignore_source_file_not_found=(ext == ".vif"),
+                )
+            )
+            _write_stream(base + ext, stream)
+        self.store.mount_volume(request.volume_id)
+        v = self.store.find_volume(request.volume_id)
+        return vs.VolumeCopyResponse(
+            last_append_at_ns=0 if v is None else v.needle_map.maximum_key
+        )
+
+    # -- erasure coding ---------------------------------------------------
+
+    def VolumeEcShardsGenerate(self, request, context):
+        try:
+            self.store.generate_ec_shards(
+                request.volume_id,
+                request.collection,
+                codec_name=request.codec or None,
+            )
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return vs.VolumeEcShardsGenerateResponse()
+
+    def VolumeEcShardsRebuild(self, request, context):
+        rebuilt = self.store.rebuild_ec_shards(
+            request.volume_id,
+            request.collection,
+            codec_name=request.codec or None,
+        )
+        return vs.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
+
+    def VolumeEcShardsCopy(self, request, context):
+        """Pull shard files from the source node (server-side pull protocol)."""
+        loc = self.store.has_free_location() or self.store.locations[0]
+        base = loc.base_name(request.volume_id, request.collection)
+        src = rpclib.volume_server_stub(request.copy_from_data_node)
+
+        def pull(ext: str, ignore_missing: bool = False):
+            stream = src.CopyFile(
+                vs.CopyFileRequest(
+                    volume_id=request.volume_id,
+                    collection=request.collection,
+                    ext=ext,
+                    is_ec_volume=True,
+                    ignore_source_file_not_found=ignore_missing,
+                )
+            )
+            _write_stream(base + ext, stream, drop_empty=ignore_missing)
+
+        for sid in request.shard_ids:
+            pull(ecc.to_ext(sid))
+        if request.copy_ecx_file:
+            pull(".ecx")
+        if request.copy_ecj_file:
+            pull(".ecj", ignore_missing=True)
+        if request.copy_vif_file:
+            pull(".vif", ignore_missing=True)
+        return vs.VolumeEcShardsCopyResponse()
+
+    def VolumeEcShardsDelete(self, request, context):
+        self.store.delete_ec_shards(
+            request.volume_id, request.collection, list(request.shard_ids)
+        )
+        return vs.VolumeEcShardsDeleteResponse()
+
+    def VolumeEcShardsMount(self, request, context):
+        try:
+            self.store.mount_ec_shards(
+                request.volume_id, request.collection, list(request.shard_ids)
+            )
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return vs.VolumeEcShardsMountResponse()
+
+    def VolumeEcShardsUnmount(self, request, context):
+        self.store.unmount_ec_shards(request.volume_id, list(request.shard_ids))
+        return vs.VolumeEcShardsUnmountResponse()
+
+    def VolumeEcShardRead(self, request, context):
+        ev = self.store.find_ec_volume(request.volume_id)
+        if ev is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not found")
+        sh = ev.shards.get(request.shard_id)
+        if sh is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "ec shard not found")
+        if request.file_key:
+            entry = ev._search_ecx(request.file_key)
+            if entry is not None and t.size_is_deleted(entry[2]):
+                yield vs.VolumeEcShardReadResponse(is_deleted=True)
+        remaining = request.size
+        offset = request.offset
+        while remaining > 0:
+            chunk = sh.read_at(offset, min(COPY_CHUNK, remaining))
+            if not chunk:
+                break
+            yield vs.VolumeEcShardReadResponse(data=chunk)
+            offset += len(chunk)
+            remaining -= len(chunk)
+
+    def VolumeEcBlobDelete(self, request, context):
+        ev = self.store.find_ec_volume(request.volume_id)
+        if ev is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not found")
+        ev.delete_needle(request.file_key)
+        return vs.VolumeEcBlobDeleteResponse()
+
+    def VolumeEcShardsToVolume(self, request, context):
+        try:
+            self.store.ec_shards_to_volume(request.volume_id, request.collection)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return vs.VolumeEcShardsToVolumeResponse()
+
+
+def _write_stream(path: str, stream, drop_empty: bool = False) -> None:
+    wrote = False
+    try:
+        with open(path, "wb") as f:
+            for resp in stream:
+                if resp.file_content:
+                    f.write(resp.file_content)
+                    wrote = True
+    except grpc.RpcError:
+        if os.path.exists(path):
+            os.remove(path)
+        raise
+    if drop_empty and not wrote:
+        os.remove(path)

@@ -1,0 +1,202 @@
+"""Volume-server HTTP data path: POST/GET/DELETE `/<vid>,<fid>`.
+
+Reference: weed/server/volume_server_handlers_{read,write}.go — clients
+upload directly to volume servers after a master Assign; reads fall back to
+EC volumes transparently; replicated writes fan out to peers with
+`?type=replicate`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..storage.file_id import FileId
+from ..storage.needle import FLAG_HAS_MIME, FLAG_HAS_NAME, Needle
+
+
+class VolumeHttpHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "seaweedfs-tpu-volume"
+
+    # injected by serve():
+    volume_server = None
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    @property
+    def store(self):
+        return self.volume_server.store
+
+    def _send(self, code: int, body: bytes = b"", content_type: str = "application/json", extra: dict | None = None):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        if body and self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _send_json(self, code: int, obj: dict):
+        self._send(code, json.dumps(obj).encode(), "application/json")
+
+    # -- read -------------------------------------------------------------
+
+    def do_GET(self):
+        path = urllib.parse.urlparse(self.path)
+        if path.path in ("/status", "/healthz"):
+            return self._send_json(200, {"Version": "seaweedfs-tpu", **self.store.status()})
+        try:
+            fid = FileId.parse(path.path.lstrip("/"))
+        except ValueError:
+            return self._send_json(404, {"error": "invalid file id"})
+        if (
+            self.store.find_volume(fid.volume_id) is None
+            and self.store.find_ec_volume(fid.volume_id) is None
+        ):
+            # not local: redirect to a server that has it (ReadRedirect)
+            target = self.volume_server.lookup_volume_url(fid.volume_id)
+            if target and target != f"{self.volume_server.ip}:{self.volume_server.port}":
+                return self._send(
+                    302, b"", "text/plain",
+                    {"Location": f"http://{target}{self.path}"},
+                )
+            return self._send_json(404, {"error": f"volume {fid.volume_id} not found"})
+        try:
+            n = self.store.read_needle(fid.volume_id, fid.key)
+        except KeyError:
+            return self._send_json(404, {"error": "not found"})
+        except IOError as e:
+            return self._send_json(500, {"error": str(e)})
+        if n.cookie != fid.cookie:
+            return self._send_json(404, {"error": "cookie mismatch"})
+        mime = n.mime.decode() if n.has(FLAG_HAS_MIME) and n.mime else "application/octet-stream"
+        data = n.data
+        rng = self.headers.get("Range")
+        extra = {
+            "Etag": f'"{n.checksum:x}"',
+            "Accept-Ranges": "bytes",
+        }
+        if rng and rng.startswith("bytes="):
+            try:
+                start_s, end_s = rng[len("bytes="):].split("-", 1)
+                start = int(start_s) if start_s else 0
+                end = int(end_s) if end_s else len(data) - 1
+                end = min(end, len(data) - 1)
+                if start > end:
+                    raise ValueError
+                extra["Content-Range"] = f"bytes {start}-{end}/{len(data)}"
+                return self._send(206, data[start : end + 1], mime, extra)
+            except ValueError:
+                return self._send_json(416, {"error": "bad range"})
+        self._send(200, data, mime, extra)
+
+    do_HEAD = do_GET
+
+    # -- write ------------------------------------------------------------
+
+    def do_POST(self):
+        path = urllib.parse.urlparse(self.path)
+        qs = urllib.parse.parse_qs(path.query)
+        try:
+            fid = FileId.parse(path.path.lstrip("/"))
+        except ValueError:
+            return self._send_json(400, {"error": "invalid file id"})
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        ctype = self.headers.get("Content-Type", "")
+        name = b""
+        mime = b""
+        data = body
+        if ctype.startswith("multipart/form-data"):
+            data, name, mime = _parse_multipart(body, ctype)
+        n = Needle(cookie=fid.cookie, id=fid.key, data=data)
+        if name:
+            n.set(FLAG_HAS_NAME)
+            n.name = name[:255]
+        if mime and mime != b"application/octet-stream":
+            n.set(FLAG_HAS_MIME)
+            n.mime = mime
+        n.append_at_ns = time.time_ns()
+        try:
+            size = self.store.write_needle(fid.volume_id, n)
+        except KeyError:
+            return self._send_json(404, {"error": f"volume {fid.volume_id} not found"})
+        except PermissionError as e:
+            return self._send_json(403, {"error": str(e)})
+        # replicate to peers unless this IS a replicated write
+        if "replicate" not in qs.get("type", []):
+            err = self.volume_server.replicate_write(fid, self.path, body, self.headers)
+            if err:
+                return self._send_json(500, {"error": f"replication: {err}"})
+        self._send_json(201, {"name": name.decode(errors="replace"), "size": int(size), "eTag": f"{n.checksum:x}"})
+
+    def do_PUT(self):
+        self.do_POST()
+
+    # -- delete -----------------------------------------------------------
+
+    def do_DELETE(self):
+        path = urllib.parse.urlparse(self.path)
+        qs = urllib.parse.parse_qs(path.query)
+        try:
+            fid = FileId.parse(path.path.lstrip("/"))
+        except ValueError:
+            return self._send_json(400, {"error": "invalid file id"})
+        try:
+            n = self.store.read_needle(fid.volume_id, fid.key)
+            if n.cookie != fid.cookie:
+                return self._send_json(404, {"error": "cookie mismatch"})
+            size = self.store.delete_needle(fid.volume_id, fid.key)
+        except KeyError:
+            return self._send_json(404, {"error": "not found"})
+        if "replicate" not in qs.get("type", []):
+            self.volume_server.replicate_delete(fid, self.path)
+        self._send_json(202, {"size": int(size)})
+
+
+def _parse_multipart(body: bytes, ctype: str) -> tuple[bytes, bytes, bytes]:
+    """Minimal multipart/form-data parse: first file part wins."""
+    boundary = None
+    for piece in ctype.split(";"):
+        piece = piece.strip()
+        if piece.startswith("boundary="):
+            boundary = piece[len("boundary="):].strip('"').encode()
+    if not boundary:
+        return body, b"", b""
+    delim = b"--" + boundary
+    for part in body.split(delim):
+        if b"\r\n\r\n" not in part:
+            continue
+        head, _, content = part.partition(b"\r\n\r\n")
+        content = content.rstrip(b"\r\n-")
+        name = b""
+        mime = b""
+        for line in head.split(b"\r\n"):
+            low = line.lower()
+            if low.startswith(b"content-disposition") and b"filename=" in low:
+                fn = line.split(b"filename=")[-1].strip(b'"')
+                name = fn.split(b'"')[0]
+            elif low.startswith(b"content-type:"):
+                mime = line.split(b":", 1)[1].strip()
+        if name or content:
+            return content, name, mime
+    return body, b"", b""
+
+
+def serve_http(volume_server, host: str, port: int) -> ThreadingHTTPServer:
+    handler = type(
+        "BoundVolumeHttpHandler",
+        (VolumeHttpHandler,),
+        {"volume_server": volume_server},
+    )
+    httpd = ThreadingHTTPServer((host, port), handler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    return httpd

@@ -1,0 +1,250 @@
+"""VolumeServer process: HTTP data path + gRPC admin + master heartbeat.
+
+Reference: weed/server/volume_server.go + volume_grpc_client_to_master.go.
+The gRPC port is http_port + 10000 by convention, like the reference.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.request
+
+import grpc
+
+from ..pb import master_pb2
+from ..pb import rpc as rpclib
+from ..storage.store import Store
+from .grpc_handlers import VolumeGrpcService
+from .http_handlers import serve_http
+
+GRPC_PORT_OFFSET = 10000
+
+
+class VolumeServer:
+    def __init__(
+        self,
+        directories: list[str],
+        master_addresses: list[str],
+        ip: str = "127.0.0.1",
+        port: int = 8080,
+        public_url: str = "",
+        data_center: str = "",
+        rack: str = "",
+        codec_name: str = "cpu",
+        pulse_seconds: float = 3.0,
+        max_volume_count: int | None = None,
+    ):
+        self.ip = ip
+        self.port = port
+        self.grpc_port = port + GRPC_PORT_OFFSET
+        self.master_addresses = master_addresses
+        self.pulse_seconds = pulse_seconds
+        self.store = Store(
+            directories,
+            ip=ip,
+            port=port,
+            public_url=public_url,
+            data_center=data_center,
+            rack=rack,
+            codec_name=codec_name,
+        )
+        if max_volume_count:
+            for loc in self.store.locations:
+                loc.max_volume_count = max_volume_count
+            self.store.max_volume_counts = {"": max_volume_count * len(self.store.locations)}
+        self.current_leader: str | None = None
+        self._stop = threading.Event()
+        self._httpd = None
+        self._grpc_server = None
+        self._hb_thread: threading.Thread | None = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self.store.ec_fetcher_factory = self._make_ec_fetcher
+        for loc in self.store.locations:
+            for vid, ev in loc.ec_volumes.items():
+                ev.remote_fetch = self._make_ec_fetcher(vid)
+        self._httpd = serve_http(self, "0.0.0.0", self.port)
+        self._grpc_server = rpclib.serve(
+            [(rpclib.VOLUME_SERVER, VolumeGrpcService(self))], self.grpc_port
+        )
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        self._hb_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._httpd:
+            self._httpd.shutdown()
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.5)
+        self.store.close()
+
+    def stop_heartbeat(self) -> None:
+        self._stop.set()
+
+    # -- heartbeat client -------------------------------------------------
+
+    def _heartbeat_loop(self) -> None:
+        """Reconnecting SendHeartbeat bidi stream, chasing the leader."""
+        idx = 0
+        while not self._stop.is_set():
+            master = self.current_leader or self.master_addresses[
+                idx % len(self.master_addresses)
+            ]
+            idx += 1
+            try:
+                self._heartbeat_once(master)
+            except grpc.RpcError:
+                time.sleep(min(self.pulse_seconds, 1.0))
+            except Exception:
+                time.sleep(min(self.pulse_seconds, 1.0))
+
+    def _heartbeat_once(self, master: str) -> None:
+        stub = rpclib.master_stub(master)
+
+        def requests():
+            yield self.store.collect_heartbeat()
+            last_full = time.monotonic()
+            while not self._stop.is_set():
+                time.sleep(min(self.pulse_seconds / 3, 1.0))
+                nv, dv, ne, de = self.store.drain_deltas()
+                if nv or dv or ne or de:
+                    yield master_pb2.Heartbeat(
+                        ip=self.store.ip,
+                        port=self.store.port,
+                        public_url=self.store.public_url,
+                        new_volumes=nv,
+                        deleted_volumes=dv,
+                        new_ec_shards=ne,
+                        deleted_ec_shards=de,
+                    )
+                if time.monotonic() - last_full >= self.pulse_seconds:
+                    last_full = time.monotonic()
+                    yield self.store.collect_heartbeat()
+
+        for resp in stub.SendHeartbeat(requests()):
+            if resp.volume_size_limit:
+                self.store.volume_size_limit = resp.volume_size_limit
+            if resp.leader_grpc and resp.leader_grpc != master:
+                self.current_leader = resp.leader_grpc
+                raise grpc.RpcError()  # reconnect to leader
+            if self._stop.is_set():
+                return
+
+    # -- remote EC shard access ------------------------------------------
+
+    def _make_ec_fetcher(self, vid: int):
+        """FetchFn for EcVolume: resolve shard locations via the master
+        (cached briefly, like store_ec.go's TTL-tiered cache) and stream the
+        interval from the owning peer via VolumeEcShardRead."""
+        from ..pb import volume_server_pb2 as vs
+
+        cache: dict = {"at": 0.0, "locations": {}}
+        me = f"{self.ip}:{self.port}"
+
+        def lookup() -> dict[int, list[str]]:
+            now = time.monotonic()
+            if now - cache["at"] < 10.0 and cache["locations"]:
+                return cache["locations"]
+            master = self.current_leader or self.master_addresses[0]
+            try:
+                resp = rpclib.master_stub(master, timeout=5).LookupEcVolume(
+                    master_pb2.LookupEcVolumeRequest(volume_id=vid)
+                )
+            except grpc.RpcError:
+                return cache["locations"]
+            locations: dict[int, list[str]] = {}
+            for e in resp.shard_id_locations:
+                locations[e.shard_id] = [loc.url for loc in e.locations]
+            cache["at"], cache["locations"] = now, locations
+            return locations
+
+        def fetch(shard_id: int, offset: int, length: int) -> bytes | None:
+            for url in lookup().get(shard_id, []):
+                if url == me:
+                    continue
+                host, port = url.rsplit(":", 1)
+                grpc_addr = f"{host}:{int(port) + GRPC_PORT_OFFSET}"
+                try:
+                    stream = rpclib.volume_server_stub(grpc_addr, timeout=30).VolumeEcShardRead(
+                        vs.VolumeEcShardReadRequest(
+                            volume_id=vid, shard_id=shard_id,
+                            offset=offset, size=length,
+                        )
+                    )
+                    data = b"".join(r.data for r in stream)
+                    if len(data) == length:
+                        return data
+                except grpc.RpcError:
+                    continue
+            return None
+
+        return fetch
+
+    def lookup_volume_url(self, vid: int) -> str | None:
+        """Public URL of some server holding vid (for read redirects)."""
+        master = self.current_leader or self.master_addresses[0]
+        try:
+            resp = rpclib.master_stub(master, timeout=5).LookupVolume(
+                master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)])
+            )
+        except grpc.RpcError:
+            return None
+        for entry in resp.volume_id_locations:
+            for loc in entry.locations:
+                return loc.public_url or loc.url
+        return None
+
+    # -- replication fan-out ---------------------------------------------
+
+    def other_replica_locations(self, vid: int) -> list[str]:
+        """Ask the master where the other replicas of vid live."""
+        master = self.current_leader or self.master_addresses[0]
+        try:
+            stub = rpclib.master_stub(master, timeout=5)
+            resp = stub.LookupVolume(
+                master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)])
+            )
+        except grpc.RpcError:
+            return []
+        out = []
+        me = self.store.public_url
+        for loc in resp.volume_id_locations:
+            for location in loc.locations:
+                if location.url not in (me, f"{self.ip}:{self.port}"):
+                    out.append(location.url)
+        return out
+
+    def replicate_write(self, fid, path: str, body: bytes, headers) -> str | None:
+        v = self.store.find_volume(fid.volume_id)
+        if v is None or v.super_block.replica_placement.copy_count() <= 1:
+            return None
+        sep = "&" if "?" in path else "?"
+        for peer in self.other_replica_locations(fid.volume_id):
+            url = f"http://{peer}{path}{sep}type=replicate"
+            req = urllib.request.Request(url, data=body, method="POST")
+            ct = headers.get("Content-Type")
+            if ct:
+                req.add_header("Content-Type", ct)
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    if r.status >= 300:
+                        return f"peer {peer} status {r.status}"
+            except OSError as e:
+                return f"peer {peer}: {e}"
+        return None
+
+    def replicate_delete(self, fid, path: str) -> None:
+        v = self.store.find_volume(fid.volume_id)
+        if v is None or v.super_block.replica_placement.copy_count() <= 1:
+            return
+        sep = "&" if "?" in path else "?"
+        for peer in self.other_replica_locations(fid.volume_id):
+            url = f"http://{peer}{path}{sep}type=replicate"
+            req = urllib.request.Request(url, method="DELETE")
+            try:
+                urllib.request.urlopen(req, timeout=10)
+            except OSError:
+                pass
