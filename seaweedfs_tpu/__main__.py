@@ -1,0 +1,6 @@
+"""`python -m seaweedfs_tpu <subcommand>` — the `weed` binary equivalent
+(reference: weed/weed.go:39)."""
+
+from .cli import main
+
+main()

@@ -22,8 +22,11 @@ class MemorySequencer:
             return start
 
     def set_max(self, seen_value: int) -> None:
+        # reference bumps when counter <= seenValue: a heartbeat reporting
+        # max_file_key equal to the current counter must still advance it,
+        # or the next assign would reuse a live needle id
         with self._lock:
-            if seen_value > self._counter:
+            if seen_value >= self._counter:
                 self._counter = seen_value + 1
 
     def peek(self) -> int:
@@ -43,18 +46,28 @@ class SnowflakeSequencer:
         self._seq = 0
 
     def next_file_id(self, count: int = 1) -> int:
+        if not 1 <= count <= 1 << 12:
+            # a range can never exceed the 12-bit sequence space, or ids
+            # would carry into the node-id bits and collide across masters
+            raise ValueError(f"snowflake range {count} exceeds 4096")
         with self._lock:
             now = int(time.time() * 1000) - self.EPOCH_MS
+            if now < self._last_ms:
+                now = self._last_ms  # keep monotonic under clock skew
             if now == self._last_ms:
-                self._seq += count
-                if self._seq >= 1 << 12:
-                    time.sleep(0.001)
+                first = self._seq + 1
+                if first + count - 1 >= 1 << 12:
+                    # sequence exhausted: advance to the next logical ms.
+                    # _last_ms is monotonic (clamp above), so this ms can
+                    # never be re-entered at seq 0 even if the wall clock
+                    # later catches up — no duplicate ids, no lock-held spin.
                     now += 1
-                    self._seq = 0
+                    first = 0
             else:
-                self._seq = 0
+                first = 0
+            self._seq = first + count - 1
             self._last_ms = now
-            return (now << 22) | (self.node_id << 12) | self._seq
+            return (now << 22) | (self.node_id << 12) | first
 
     def set_max(self, seen_value: int) -> None:
         pass  # timestamps make collisions impossible

@@ -22,6 +22,16 @@ PARITY_SHARDS = 4
 TOTAL_SHARDS = DATA_SHARDS + PARITY_SHARDS
 
 
+def available_codecs() -> list[str]:
+    """Canonical codec names usable with ``get_codec`` on this host."""
+    names = ["cpu"]
+    try:
+        import jax  # noqa: F401
+    except ImportError:
+        return names
+    return names + ["tpu", "tpu_xor", "tpu_mxu"]
+
+
 def get_codec(name: str = "cpu", data_shards: int = DATA_SHARDS,
               parity_shards: int = PARITY_SHARDS):
     """Return a codec with encode/reconstruct/reconstruct_data/verify."""

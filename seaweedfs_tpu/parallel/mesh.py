@@ -32,11 +32,31 @@ from ..ops import gf256
 from ..ops.rs_jax import _multiples, _rows_of, make_apply_xor
 
 
-def make_mesh(devices=None, axis_names=("dp", "sp")) -> Mesh:
-    """2-D mesh: dp (volumes / shard-splitting) x sp (block columns)."""
+def make_mesh(
+    devices=None,
+    axis_names=("dp", "sp"),
+    dp: int | None = None,
+    shard_axis: int = 10,
+) -> Mesh:
+    """2-D mesh: dp (volumes / shard-splitting) x sp (block columns).
+
+    ``dp`` must divide both the device count and the GF shard axis
+    (``distributed_reconstruct`` splits S=10 shards over dp).  When not
+    given, pick the largest valid dp ≤ sqrt(n) so the mesh stays balanced:
+    n=8 -> (2, 4); n=4 -> (2, 2); n=16 -> (2, 8); odd n -> (1, n).
+    """
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    dp = 2 if n % 2 == 0 and n > 1 else 1
+    if dp is None:
+        dp = 1
+        for cand in range(2, int(n**0.5) + 1):
+            if n % cand == 0 and shard_axis % cand == 0:
+                dp = cand
+    elif n % dp or shard_axis % dp:
+        raise ValueError(
+            f"dp={dp} must divide both device count {n} and "
+            f"shard axis {shard_axis}"
+        )
     sp = n // dp
     arr = np.asarray(devices[: dp * sp]).reshape(dp, sp)
     return Mesh(arr, axis_names)

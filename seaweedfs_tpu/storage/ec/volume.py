@@ -27,7 +27,7 @@ from .constants import (
     TOTAL_SHARDS,
     to_ext,
 )
-from .locate import Interval, locate_data
+from .locate import Interval, locate_data, shard_file_size
 
 
 class NotFoundError(KeyError):
@@ -80,6 +80,7 @@ class EcVolume:
         self._ecx = open(base_name + ".ecx", "r+b")
         self.ecx_size = os.path.getsize(base_name + ".ecx")
         self._ecj_lock = threading.Lock()
+        self._ecx_derived_shard_size: int | None = None
         self.remote_fetch: FetchFn | None = None
         for sid in range(TOTAL_SHARDS):
             p = base_name + to_ext(sid)
@@ -102,7 +103,29 @@ class EcVolume:
 
     @property
     def shard_size(self) -> int:
-        return next(iter(self.shards.values())).size if self.shards else 0
+        """Size of every shard file.  Prefer a locally mounted shard; with
+        none mounted (all shards remote), derive it from the .ecx: the volume
+        extends at least to max(offset + actual_size) over all entries, and
+        the shard size is a deterministic function of the dat size
+        (reference: ec_decoder.go FindDatFileSize derives the same bound)."""
+        if self.shards:
+            return next(iter(self.shards.values())).size
+        if self._ecx_derived_shard_size is None:
+            self._ecx_derived_shard_size = self._shard_size_from_ecx()
+        return self._ecx_derived_shard_size
+
+    def _shard_size_from_ecx(self) -> int:
+        end = 0
+        self._ecx.seek(0)
+        entries = self.ecx_size // t.NEEDLE_MAP_ENTRY_SIZE
+        for i in range(entries):
+            self._ecx.seek(i * t.NEEDLE_MAP_ENTRY_SIZE)
+            _key, offset, size = t.unpack_index_entry(
+                self._ecx.read(t.NEEDLE_MAP_ENTRY_SIZE)
+            )
+            if not t.size_is_deleted(size):
+                end = max(end, offset + actual_size(size, self.version))
+        return shard_file_size(end, self.large_block_size, self.small_block_size)
 
     def shard_ids(self) -> list[int]:
         return sorted(self.shards)
@@ -157,6 +180,13 @@ class EcVolume:
 
     def locate(self, needle_id: int) -> tuple[int, int, list[Interval]]:
         offset, size = self.find_needle_from_ecx(needle_id)
+        if self.shard_size == 0:
+            # dat_size=0 would silently produce wrong intervals for
+            # remote/degraded reads — fail fast instead
+            raise IOError(
+                f"ec volume {self.volume_id}: shard size unknown "
+                "(no local shard, empty .ecx) — cannot locate intervals"
+            )
         dat_size = DATA_SHARDS * self.shard_size
         intervals = locate_data(
             self.large_block_size,

@@ -38,6 +38,22 @@ def walk_index_file(
     return out
 
 
+def parse_index_arrays(path: str | os.PathLike):
+    """Vectorised parse of a whole .idx file -> (keys, offsets, sizes) numpy
+    arrays (uint64, int64 actual bytes, int32).  Entry order preserved."""
+    import numpy as np
+
+    with open(path, "rb") as f:
+        blob = f.read()
+    n = len(blob) // t.NEEDLE_MAP_ENTRY_SIZE
+    raw = np.frombuffer(blob, dtype=np.uint8, count=n * 16).reshape(n, 16)
+    keys = raw[:, 0:8][:, ::-1].copy().view(np.uint64).reshape(n)
+    stored = raw[:, 8:12][:, ::-1].copy().view(np.uint32).reshape(n)
+    offsets = stored.astype(np.int64) * t.NEEDLE_PADDING_SIZE
+    sizes = raw[:, 12:16][:, ::-1].copy().view(np.int32).reshape(n)
+    return keys, offsets, sizes
+
+
 class IndexWriter:
     """Append-only .idx writer."""
 

@@ -29,7 +29,7 @@ def compact(volume: Volume) -> tuple[str, int]:
     with volume._lock:
         volume.sync()
         snapshot_end = volume.content_size
-        live = {v.key: v for v in volume.needle_map._m.values()}
+        live = {v.key: v for v in volume.needle_map.items_ascending()}
         version = volume.version
         sb = volume.super_block
 

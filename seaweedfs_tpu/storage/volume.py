@@ -151,7 +151,7 @@ class Volume:
         self._dat.seek(0, os.SEEK_END)
         file_size = self._dat.tell()
         last = None
-        for v in self.needle_map._m.values():
+        for v in self.needle_map.items_ascending():
             if last is None or v.offset > last.offset:
                 last = v
         if last is None:

@@ -342,7 +342,10 @@ class VolumeGrpcService:
         if request.file_key:
             entry = ev._search_ecx(request.file_key)
             if entry is not None and t.size_is_deleted(entry[2]):
+                # reference returns immediately after is_deleted; streaming
+                # interval bytes afterwards would read as valid data
                 yield vs.VolumeEcShardReadResponse(is_deleted=True)
+                return
         remaining = request.size
         offset = request.offset
         while remaining > 0:

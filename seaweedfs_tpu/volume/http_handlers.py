@@ -86,8 +86,13 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
         if rng and rng.startswith("bytes="):
             try:
                 start_s, end_s = rng[len("bytes="):].split("-", 1)
-                start = int(start_s) if start_s else 0
-                end = int(end_s) if end_s else len(data) - 1
+                if not start_s:
+                    # suffix range (RFC 7233): bytes=-N means the last N bytes
+                    start = max(0, len(data) - int(end_s))
+                    end = len(data) - 1
+                else:
+                    start = int(start_s)
+                    end = int(end_s) if end_s else len(data) - 1
                 end = min(end, len(data) - 1)
                 if start > end:
                     raise ValueError

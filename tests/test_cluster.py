@@ -98,6 +98,30 @@ def test_write_read_delete(cluster):
     assert code == 404
 
 
+def test_range_reads(cluster):
+    master, _ = cluster
+    a = _assign(master)
+    payload = bytes(range(256)) * 4
+    code, _ = _http("POST", f"http://{a['url']}/{a['fid']}", payload)
+    assert code == 201
+    base = f"http://{a['url']}/{a['fid']}"
+
+    def get_range(spec):
+        req = urllib.request.Request(base, headers={"Range": spec})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read(), r.headers.get("Content-Range")
+
+    code, got, cr = get_range("bytes=0-9")
+    assert code == 206 and got == payload[:10]
+    assert cr == f"bytes 0-9/{len(payload)}"
+    code, got, cr = get_range("bytes=100-")
+    assert code == 206 and got == payload[100:]
+    # suffix range: last N bytes (RFC 7233)
+    code, got, cr = get_range("bytes=-10")
+    assert code == 206 and got == payload[-10:]
+    assert cr == f"bytes {len(payload) - 10}-{len(payload) - 1}/{len(payload)}"
+
+
 def test_replicated_write(cluster):
     master, servers = cluster
     a = _assign(master, replication="001")
@@ -133,13 +157,15 @@ def test_ec_encode_flow(cluster):
     out = run_command(env, f"ec.encode -volumeId={vid} -collection=ectest")
     assert f"ec.encode {vid}" in out
 
-    # wait for ec shard registrations to reach the master
+    # wait until all 14 shard registrations reach the master (delta channels
+    # deliver incrementally, so a partial map is expected transiently)
     deadline = time.time() + 15
+    shard_map = {}
     while time.time() < deadline:
-        if master.topo.lookup_ec_shards(vid):
+        shard_map = master.topo.lookup_ec_shards(vid)
+        if len(shard_map) == 14:
             break
         time.sleep(0.2)
-    shard_map = master.topo.lookup_ec_shards(vid)
     assert len(shard_map) == 14, f"expected 14 shards, got {len(shard_map)}"
     # original volume is gone from every server
     assert all(s.store.find_volume(vid) is None for s in servers)

@@ -193,6 +193,34 @@ def test_ec_volume_runtime(synthetic_base):
     ev.close()
 
 
+def test_ec_volume_remote_only_reads(synthetic_base):
+    """A server holding only the .ecx (every shard remote) must still locate
+    and read needles: shard size is derived from the index, intervals are
+    served through the remote-fetch hook."""
+    _encode_dir(synthetic_base)
+    ref = EcVolume(synthetic_base, volume_id=1, version=VERSION3,
+                   large_block_size=LARGE, small_block_size=SMALL)
+    want = ref.read_needle(5)
+    real_shard_size = ref.shard_size
+    ref.close()
+
+    ev = EcVolume(synthetic_base, volume_id=1, version=VERSION3,
+                  large_block_size=LARGE, small_block_size=SMALL)
+    for sid in list(ev.shards):
+        ev.delete_shard(sid)
+
+    def fetch(shard_id, offset, length):
+        with open(synthetic_base + ecc.to_ext(shard_id), "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    ev.remote_fetch = fetch
+    assert ev.shard_size == real_shard_size
+    got = ev.read_needle(5)
+    assert got.data == want.data
+    ev.close()
+
+
 @pytest.mark.skipif(not os.path.isdir(REF_EC_DIR), reason="reference fixture absent")
 def test_reference_fixture_conformance(tmp_path):
     """Encode the reference's real 1.dat volume (written by the original

@@ -1,0 +1,124 @@
+"""Compact needle map: two-tier correctness + the 10M-entry scale test
+(reference: weed/storage/needle_map/compact_map.go:28-50 and its
+compact_map_perf_test.go, which loads 10M entries)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage.needle_map import NeedleMap
+
+
+def test_put_get_delete_with_merges():
+    nm = NeedleMap(merge_threshold=8)  # force frequent tier merges
+    for k in range(100):
+        nm.put(k + 1, (k + 1) * 8, 100 + k)
+    assert len(nm) == 100
+    assert nm.file_count == 100
+    assert nm.get(50).offset == 50 * 8
+    assert nm.get(50).size == 149
+    assert 101 not in nm
+    # overwrite: accounting moves old bytes to deleted
+    nm.put(50, 8000, 500)
+    assert nm.get(50) == nm.get(50)
+    assert nm.get(50).size == 500
+    assert nm.deleted_count == 1
+    assert nm.deleted_bytes == 149
+    # delete across tiers
+    freed = nm.delete(51)
+    assert freed == 150
+    assert nm.get(51) is None
+    assert len(nm) == 99
+    assert nm.delete(51) == 0  # idempotent
+    # re-insert after delete
+    nm.put(51, 400, 7)
+    assert nm.get(51).size == 7
+    assert len(nm) == 100
+
+
+def test_iteration_sorted_and_next_key():
+    nm = NeedleMap(merge_threshold=4)
+    keys = [9, 2, 77, 31, 5, 64, 100, 1]
+    for k in keys:
+        nm.put(k, k * 8, k)
+    assert nm.sorted_keys() == sorted(keys)
+    got = [v.key for v in nm.items_ascending()]
+    assert got == sorted(keys)
+    assert nm.next_key_after(5) == 9
+    assert nm.next_key_after(100) is None
+    assert nm.maximum_key == 100
+
+
+def test_content_size_accounting():
+    nm = NeedleMap()
+    nm.put(1, 8, 10)
+    nm.put(2, 16, 20)
+    assert nm.content_size == 30
+    nm.delete(1)
+    assert nm.content_size == 20
+    nm.put(2, 24, 5)  # overwrite shrinks
+    assert nm.content_size == 5
+
+
+def test_write_sorted_index_matches_scalar_pack(tmp_path):
+    nm = NeedleMap()
+    vals = [(5, 40, 11), (1, 8, 22), (9, 1024, 33)]
+    for k, o, s in vals:
+        nm.put(k, o, s)
+    p = tmp_path / "x.ecx"
+    nm.write_sorted_index(p)
+    from seaweedfs_tpu.storage import types as t
+
+    blob = p.read_bytes()
+    want = b"".join(
+        t.pack_index_entry(k, o, s) for k, o, s in sorted(vals)
+    )
+    assert blob == want
+
+
+def test_load_from_idx_with_deletes(tmp_path):
+    from seaweedfs_tpu.storage import types as t
+
+    p = tmp_path / "v.idx"
+    with open(p, "wb") as f:
+        f.write(t.pack_index_entry(1, 8, 100))
+        f.write(t.pack_index_entry(2, 112, 200))
+        f.write(t.pack_index_entry(1, 320, 150))  # overwrite
+        f.write(t.pack_index_entry(2, 480, t.TOMBSTONE_FILE_SIZE))  # delete
+    nm = NeedleMap.load_from_idx(p)
+    assert len(nm) == 1
+    assert nm.get(1).offset == 320
+    assert nm.get(2) is None
+    assert nm.deleted_count == 2  # one overwrite + one tombstone
+
+
+def test_scale_10m_entries(tmp_path):
+    """10M entries load in seconds and cost ~20 bytes each (the reference's
+    compact-map perf envelope), with correct random lookups."""
+    n = 10_000_000
+    keys = np.arange(1, n + 1, dtype=np.uint64)
+    out = np.empty((n, 16), dtype=np.uint8)
+    out[:, 0:8] = keys[:, None].view(np.uint8).reshape(n, 8)[:, ::-1]
+    stored = np.arange(1, n + 1, dtype=">u4")  # offset/8
+    out[:, 8:12] = stored[:, None].view(np.uint8).reshape(n, 4)
+    sizes = np.full(n, 1000, dtype=">u4")
+    out[:, 12:16] = sizes[:, None].view(np.uint8).reshape(n, 4)
+    p = tmp_path / "big.idx"
+    with open(p, "wb") as f:
+        f.write(out.tobytes())
+
+    t0 = time.monotonic()
+    nm = NeedleMap.load_from_idx(p)
+    load_s = time.monotonic() - t0
+    assert len(nm) == n
+    assert nm.maximum_key == n
+    # ~20 B/entry in the base tier (plus numpy overhead, nowhere near a dict)
+    base_bytes = nm._keys.nbytes + nm._offsets.nbytes + nm._sizes.nbytes
+    assert base_bytes <= 24 * n
+    rng = np.random.default_rng(0)
+    for k in rng.integers(1, n + 1, 1000):
+        v = nm.get(int(k))
+        assert v is not None and v.offset == int(k) * 8
+    assert nm.get(n + 5) is None
+    assert load_s < 30, f"10M-entry load took {load_s:.1f}s"

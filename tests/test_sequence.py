@@ -1,0 +1,51 @@
+"""Sequencer semantics (reference: weed/sequence/memory_sequencer.go,
+snowflake via weed/sequence/).
+
+Pins the advisor-flagged edge cases: set_max must advance past an *equal*
+seen value, and the snowflake generator must stay monotonic and return the
+first id of a reserved range.
+"""
+
+from seaweedfs_tpu.master.sequence import MemorySequencer, SnowflakeSequencer
+
+
+def test_memory_sequencer_basic():
+    s = MemorySequencer()
+    a = s.next_file_id()
+    b = s.next_file_id(5)
+    c = s.next_file_id()
+    assert b == a + 1
+    assert c == b + 5
+
+
+def test_memory_set_max_equal_value_advances():
+    # a heartbeat reporting max_file_key == counter must still bump, or the
+    # next assign reuses a live needle id (reference: counter <= seenValue)
+    s = MemorySequencer(start=5)
+    assert s.peek() == 5
+    s.set_max(5)
+    assert s.next_file_id() == 6
+    s.set_max(3)  # lower values never move the counter back
+    assert s.next_file_id() == 7
+
+
+def test_snowflake_monotonic_and_range_start():
+    s = SnowflakeSequencer(node_id=7)
+    ids = [s.next_file_id() for _ in range(100)]
+    assert ids == sorted(ids)
+    assert len(set(ids)) == 100
+    # count>1 reserves [first, first+count) and returns the first id
+    first = s.next_file_id(10)
+    nxt = s.next_file_id()
+    assert nxt > first
+    # node id occupies bits 12..21
+    assert (first >> 12) & 0x3FF == 7
+
+
+def test_snowflake_overflow_waits_for_real_clock():
+    s = SnowflakeSequencer(node_id=1)
+    # exhaust a millisecond's 4096-id space; generator must roll into a
+    # *real* later millisecond, never a fabricated one that could repeat
+    ids = [s.next_file_id(512) for _ in range(20)]
+    assert ids == sorted(ids)
+    assert len(set(ids)) == 20
