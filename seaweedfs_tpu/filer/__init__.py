@@ -1,0 +1,10 @@
+"""Filer: the path -> chunks metadata plane.
+
+Reference surface: weed/filer (filer.go:30, filerstore.go:18-41,
+filechunks.go) + weed/server/filer_server*.go.
+"""
+
+from .filer import Filer
+from .filerstore import FilerStore
+
+__all__ = ["Filer", "FilerStore"]

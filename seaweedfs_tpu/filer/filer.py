@@ -1,0 +1,269 @@
+"""Filer core: path namespace over a FilerStore, with chunk lifecycle.
+
+Reference: weed/filer/filer.go:30-45 plus filer_delete_entry.go /
+filer_deletion.go (recursive delete + async blob deletion queue) and
+filer_notify.go (metadata event log).  Paths are absolute ("/a/b/c");
+an entry lives at (directory="/a/b", name="c").  Buckets live under
+/buckets/<name> and map to collections.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+
+from ..pb import filer_pb2
+from . import filechunks
+from .filerstore import FilerStore
+from .meta_log import MetaLogBuffer
+
+ROOT = "/"
+DIR_BUCKETS = "/buckets"
+
+
+def split_path(path: str) -> tuple[str, str]:
+    path = "/" + path.strip("/")
+    if path == "/":
+        return "/", ""
+    directory, name = path.rsplit("/", 1)
+    return directory or "/", name
+
+
+def join_path(directory: str, name: str) -> str:
+    if not name:
+        return directory
+    return (directory.rstrip("/") or "") + "/" + name
+
+
+class Filer:
+    def __init__(self, store: FilerStore, delete_chunks_fn=None):
+        """``delete_chunks_fn(file_ids: list[str])`` deletes blobs; when
+        None, chunk deletion is a no-op (offline/metadata-only use)."""
+        self.store = store
+        self.meta_log = MetaLogBuffer()
+        self._delete_fn = delete_chunks_fn
+        self._deletion_q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._deleter = threading.Thread(target=self._deletion_loop, daemon=True)
+        self._deleter.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._deletion_q.put(None)
+        self.store.close()
+
+    # -- create/update -----------------------------------------------------
+
+    def create_entry(self, directory: str, entry: filer_pb2.Entry,
+                     o_excl: bool = False, signatures=None) -> None:
+        old = self.store.find_entry(directory, entry.name)
+        if old is not None and o_excl:
+            raise FileExistsError(join_path(directory, entry.name))
+        self._ensure_parents(directory)
+        if not entry.attributes.crtime:
+            entry.attributes.crtime = int(time.time())
+        if not entry.attributes.mtime:
+            entry.attributes.mtime = int(time.time())
+        self.store.insert_entry(directory, entry)
+        # blobs shadowed by the rewrite get deleted asynchronously
+        if old is not None and old.chunks:
+            garbage = filechunks.minus_chunks(old.chunks, entry.chunks)
+            self.queue_chunk_deletion([c.file_id for c in garbage])
+        self.meta_log.append(directory, old, entry, signatures=signatures)
+
+    def update_entry(self, directory: str, entry: filer_pb2.Entry,
+                     signatures=None) -> None:
+        old = self.store.find_entry(directory, entry.name)
+        if old is None:
+            raise FileNotFoundError(join_path(directory, entry.name))
+        self.store.update_entry(directory, entry)
+        if old.chunks:
+            garbage = filechunks.minus_chunks(old.chunks, entry.chunks)
+            self.queue_chunk_deletion([c.file_id for c in garbage])
+        self.meta_log.append(directory, old, entry, signatures=signatures)
+
+    def append_chunks(self, directory: str, name: str, chunks) -> None:
+        entry = self.store.find_entry(directory, name)
+        if entry is None:
+            entry = filer_pb2.Entry(name=name)
+            entry.attributes.crtime = int(time.time())
+        offset = filechunks.total_size(entry.chunks)
+        for c in chunks:
+            c2 = filer_pb2.FileChunk()
+            c2.CopyFrom(c)
+            c2.offset = offset
+            offset += c2.size
+            entry.chunks.append(c2)
+        entry.attributes.mtime = int(time.time())
+        entry.attributes.file_size = offset
+        self.store.insert_entry(directory, entry)
+        self.meta_log.append(directory, None, entry)
+
+    def _ensure_parents(self, directory: str) -> None:
+        """mkdir -p the ancestor chain (filer.go ensures parent dirs)."""
+        if directory in ("/", ""):
+            return
+        parent, name = split_path(directory)
+        existing = self.store.find_entry(parent, name)
+        if existing is not None:
+            return
+        self._ensure_parents(parent)
+        d = filer_pb2.Entry(name=name, is_directory=True)
+        d.attributes.crtime = int(time.time())
+        d.attributes.mtime = d.attributes.crtime
+        d.attributes.file_mode = 0o40755  # dir bit
+        self.store.insert_entry(parent, d)
+        self.meta_log.append(parent, None, d)
+
+    # -- read --------------------------------------------------------------
+
+    def find_entry(self, path: str) -> filer_pb2.Entry | None:
+        directory, name = split_path(path)
+        if name == "":
+            root = filer_pb2.Entry(name="/", is_directory=True)
+            return root
+        return self.store.find_entry(directory, name)
+
+    def list_directory(self, directory: str, start_from: str = "",
+                       inclusive: bool = False, prefix: str = "",
+                       limit: int = 1024):
+        return self.store.list_entries(
+            directory, start_from, inclusive, prefix, limit
+        )
+
+    # -- delete ------------------------------------------------------------
+
+    def delete_entry(self, directory: str, name: str,
+                     is_recursive: bool = False,
+                     ignore_recursive_error: bool = False,
+                     is_delete_data: bool = True,
+                     signatures=None) -> None:
+        entry = self.store.find_entry(directory, name)
+        if entry is None:
+            raise FileNotFoundError(join_path(directory, name))
+        if entry.is_directory:
+            path = join_path(directory, name)
+            children = list(self.store.list_entries(path, limit=2))
+            if children and not is_recursive:
+                raise IsADirectoryError(f"{path} is not empty")
+            try:
+                self._delete_tree(path, is_delete_data)
+            except Exception:
+                if not ignore_recursive_error:
+                    raise
+        elif is_delete_data and entry.chunks:
+            self.queue_chunk_deletion([c.file_id for c in entry.chunks])
+        self.store.delete_entry(directory, name)
+        self.meta_log.append(
+            directory, entry, None, delete_chunks=is_delete_data,
+            signatures=signatures,
+        )
+
+    def _delete_tree(self, path: str, is_delete_data: bool) -> None:
+        """Collect chunk fids of the whole subtree, then drop the metadata."""
+        stack = [path]
+        while stack:
+            d = stack.pop()
+            start = ""
+            while True:
+                batch = list(self.store.list_entries(d, start_from=start, limit=1024))
+                if not batch:
+                    break
+                for e in batch:
+                    if e.is_directory:
+                        stack.append(join_path(d, e.name))
+                    elif is_delete_data and e.chunks:
+                        self.queue_chunk_deletion(
+                            [c.file_id for c in e.chunks]
+                        )
+                start = batch[-1].name
+        self.store.delete_folder_children(path)
+
+    # -- rename ------------------------------------------------------------
+
+    def rename_entry(self, old_dir: str, old_name: str,
+                     new_dir: str, new_name: str) -> None:
+        """AtomicRenameEntry (filer_grpc_server_rename.go): move the entry
+        and, for directories, re-root all children."""
+        entry = self.store.find_entry(old_dir, old_name)
+        if entry is None:
+            raise FileNotFoundError(join_path(old_dir, old_name))
+        if self.store.find_entry(new_dir, new_name) is not None:
+            raise FileExistsError(join_path(new_dir, new_name))
+        self._ensure_parents(new_dir)
+        moved = filer_pb2.Entry()
+        moved.CopyFrom(entry)
+        moved.name = new_name
+        self.store.insert_entry(new_dir, moved)
+        if entry.is_directory:
+            old_path = join_path(old_dir, old_name)
+            new_path = join_path(new_dir, new_name)
+            self._move_children(old_path, new_path)
+        self.store.delete_entry(old_dir, old_name)
+        self.meta_log.append(
+            old_dir, entry, moved, new_parent_path=new_dir,
+        )
+
+    def _move_children(self, old_path: str, new_path: str) -> None:
+        start = ""
+        while True:
+            batch = list(self.store.list_entries(old_path, start_from=start, limit=1024))
+            if not batch:
+                break
+            for e in batch:
+                child = filer_pb2.Entry()
+                child.CopyFrom(e)
+                self.store.insert_entry(new_path, child)
+                if e.is_directory:
+                    self._move_children(
+                        join_path(old_path, e.name), join_path(new_path, e.name)
+                    )
+                self.store.delete_entry(old_path, e.name)
+            start = batch[-1].name
+
+    # -- buckets / collections --------------------------------------------
+
+    def bucket_collection(self, path: str) -> str:
+        """Files under /buckets/<b>/ go to collection <b> (filer.go
+        DirBucketsPath convention)."""
+        path = "/" + path.strip("/")
+        if path.startswith(DIR_BUCKETS + "/"):
+            rest = path[len(DIR_BUCKETS) + 1 :]
+            return rest.split("/", 1)[0]
+        return ""
+
+    def delete_collection_entries(self, collection: str) -> None:
+        """Drop /buckets/<collection> metadata (blobs die with the
+        collection on the volume servers)."""
+        try:
+            self.delete_entry(DIR_BUCKETS, collection, is_recursive=True,
+                              is_delete_data=False)
+        except FileNotFoundError:
+            pass
+
+    # -- async blob deletion ----------------------------------------------
+
+    def queue_chunk_deletion(self, file_ids: list[str]) -> None:
+        if file_ids:
+            self._deletion_q.put(list(file_ids))
+
+    def _deletion_loop(self) -> None:
+        while not self._stop.is_set():
+            item = self._deletion_q.get()
+            if item is None:
+                return
+            if self._delete_fn is None:
+                continue
+            try:
+                self._delete_fn(item)
+            except Exception:
+                pass
+
+    def drain_deletions(self, timeout: float = 5.0) -> None:
+        """Testing hook: wait for queued blob deletions to be processed."""
+        deadline = time.monotonic() + timeout
+        while not self._deletion_q.empty() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        time.sleep(0.05)

@@ -1,0 +1,92 @@
+"""FilerStore: the pluggable metadata backend interface.
+
+Reference: weed/filer/filerstore.go:18-41 — InsertEntry/UpdateEntry/
+FindEntry/DeleteEntry/DeleteFolderChildren/ListDirectoryEntries + KV +
+transactions.  Stores persist pb-serialized Entry bytes keyed by
+(directory, name); backends register by name like the reference's
+blank-import init() plugin pattern (weed/server/filer_server.go:23-36).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Callable, Iterator
+
+from ..pb import filer_pb2
+
+_REGISTRY: dict[str, Callable[..., "FilerStore"]] = {}
+
+
+def register_store(name: str):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def make_store(name: str, **kwargs) -> "FilerStore":
+    # import for registration side effects
+    from . import stores  # noqa: F401
+
+    try:
+        return _REGISTRY[name](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown filer store {name!r}; have {sorted(_REGISTRY)}"
+        ) from None
+
+
+class FilerStore(ABC):
+    name = "abstract"
+
+    @abstractmethod
+    def insert_entry(self, directory: str, entry: filer_pb2.Entry) -> None: ...
+
+    @abstractmethod
+    def update_entry(self, directory: str, entry: filer_pb2.Entry) -> None: ...
+
+    @abstractmethod
+    def find_entry(self, directory: str, name: str) -> filer_pb2.Entry | None: ...
+
+    @abstractmethod
+    def delete_entry(self, directory: str, name: str) -> None: ...
+
+    @abstractmethod
+    def delete_folder_children(self, directory: str) -> None: ...
+
+    @abstractmethod
+    def list_entries(
+        self,
+        directory: str,
+        start_from: str = "",
+        inclusive: bool = False,
+        prefix: str = "",
+        limit: int = 1024,
+    ) -> Iterator[filer_pb2.Entry]: ...
+
+    # -- KV ----------------------------------------------------------------
+
+    @abstractmethod
+    def kv_get(self, key: bytes) -> bytes | None: ...
+
+    @abstractmethod
+    def kv_put(self, key: bytes, value: bytes) -> None: ...
+
+    def kv_delete(self, key: bytes) -> None:
+        self.kv_put(key, b"")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        pass
+
+    # transactions are no-ops for embedded stores
+    def begin(self) -> None:
+        pass
+
+    def commit(self) -> None:
+        pass
+
+    def rollback(self) -> None:
+        pass

@@ -1,0 +1,195 @@
+"""Filer gRPC service (filer_pb.SeaweedFiler, 19 rpcs).
+
+Reference: weed/server/filer_grpc_server*.go.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import grpc
+
+from ..pb import filer_pb2
+from .filer import join_path
+
+
+class FilerGrpcService:
+    def __init__(self, filer_server):
+        self.fs = filer_server
+
+    @property
+    def filer(self):
+        return self.fs.filer
+
+    # -- metadata ----------------------------------------------------------
+
+    def LookupDirectoryEntry(self, request, context):
+        entry = self.filer.store.find_entry(request.directory, request.name)
+        if entry is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"{join_path(request.directory, request.name)} not found")
+        resp = filer_pb2.LookupDirectoryEntryResponse()
+        resp.entry.CopyFrom(entry)
+        return resp
+
+    def ListEntries(self, request, context):
+        limit = request.limit or 1024
+        for e in self.filer.list_directory(
+            request.directory,
+            start_from=request.start_from_file_name,
+            inclusive=request.inclusive_start_from,
+            prefix=request.prefix,
+            limit=limit,
+        ):
+            resp = filer_pb2.ListEntriesResponse()
+            resp.entry.CopyFrom(e)
+            yield resp
+
+    def CreateEntry(self, request, context):
+        try:
+            self.filer.create_entry(
+                request.directory, request.entry, o_excl=request.o_excl,
+                signatures=list(request.signatures),
+            )
+            return filer_pb2.CreateEntryResponse()
+        except FileExistsError as e:
+            return filer_pb2.CreateEntryResponse(error=str(e))
+
+    def UpdateEntry(self, request, context):
+        try:
+            self.filer.update_entry(request.directory, request.entry,
+                                    signatures=list(request.signatures))
+        except FileNotFoundError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return filer_pb2.UpdateEntryResponse()
+
+    def AppendToEntry(self, request, context):
+        self.filer.append_chunks(
+            request.directory, request.entry_name, list(request.chunks)
+        )
+        return filer_pb2.AppendToEntryResponse()
+
+    def DeleteEntry(self, request, context):
+        try:
+            self.filer.delete_entry(
+                request.directory,
+                request.name,
+                is_recursive=request.is_recursive,
+                ignore_recursive_error=request.ignore_recursive_error,
+                is_delete_data=request.is_delete_data,
+                signatures=list(request.signatures),
+            )
+            return filer_pb2.DeleteEntryResponse()
+        except (FileNotFoundError, IsADirectoryError) as e:
+            return filer_pb2.DeleteEntryResponse(error=str(e))
+
+    def AtomicRenameEntry(self, request, context):
+        try:
+            self.filer.rename_entry(
+                request.old_directory, request.old_name,
+                request.new_directory, request.new_name,
+            )
+        except (FileNotFoundError, FileExistsError) as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        return filer_pb2.AtomicRenameEntryResponse()
+
+    # -- cluster proxies ---------------------------------------------------
+
+    def AssignVolume(self, request, context):
+        try:
+            result = self.fs.assign(
+                count=request.count or 1,
+                collection=request.collection
+                or self.filer.bucket_collection(request.path),
+                replication=request.replication,
+                ttl_sec=request.ttl_sec,
+                data_center=request.data_center,
+                rack=request.rack,
+            )
+        except Exception as e:
+            return filer_pb2.AssignVolumeResponse(error=str(e))
+        return filer_pb2.AssignVolumeResponse(
+            file_id=result.fid,
+            url=result.url,
+            public_url=result.public_url,
+            count=result.count,
+            auth=result.auth,
+            collection=request.collection,
+            replication=request.replication,
+        )
+
+    def LookupVolume(self, request, context):
+        resp = filer_pb2.LookupVolumeResponse()
+        for vid_s in request.volume_ids:
+            try:
+                vid = int(vid_s.split(",", 1)[0])
+            except ValueError:
+                continue
+            locs = filer_pb2.Locations()
+            for l in self.fs.master_client.lookup_volume(vid):
+                locs.locations.append(
+                    filer_pb2.Location(url=l.url, public_url=l.public_url)
+                )
+            resp.locations_map[vid_s].CopyFrom(locs)
+        return resp
+
+    def CollectionList(self, request, context):
+        resp = filer_pb2.CollectionListResponse()
+        seen = set()
+        for e in self.filer.list_directory("/buckets", limit=10000):
+            if e.is_directory and e.name not in seen:
+                seen.add(e.name)
+                resp.collections.add(name=e.name)
+        return resp
+
+    def DeleteCollection(self, request, context):
+        self.fs.delete_collection(request.collection)
+        return filer_pb2.DeleteCollectionResponse()
+
+    def Statistics(self, request, context):
+        return filer_pb2.StatisticsResponse(
+            total_size=0, used_size=0, file_count=0
+        )
+
+    def GetFilerConfiguration(self, request, context):
+        return filer_pb2.GetFilerConfigurationResponse(
+            masters=self.fs.masters,
+            max_mb=self.fs.max_mb,
+            dir_buckets="/buckets",
+            collection="",
+            replication=self.fs.default_replication,
+            signature=self.fs.signature,
+        )
+
+    # -- metadata subscription ---------------------------------------------
+
+    def SubscribeMetadata(self, request, context):
+        stop = threading.Event()
+        context.add_callback(stop.set)
+        for ev in self.filer.meta_log.subscribe(
+            request.since_ns, request.path_prefix, stop_event=stop
+        ):
+            if request.signature and request.signature in ev.event_notification.signatures:
+                continue  # skip events this subscriber itself caused
+            yield ev
+
+    SubscribeLocalMetadata = SubscribeMetadata
+
+    def KeepConnected(self, request_iterator, context):
+        for req in request_iterator:
+            yield filer_pb2.KeepConnectedResponse()
+
+    def LocateBroker(self, request, context):
+        return self.fs.locate_broker(request.resource)
+
+    # -- KV ----------------------------------------------------------------
+
+    def KvGet(self, request, context):
+        value = self.filer.store.kv_get(bytes(request.key))
+        if value is None:
+            return filer_pb2.KvGetResponse(error="not found")
+        return filer_pb2.KvGetResponse(value=value)
+
+    def KvPut(self, request, context):
+        self.filer.store.kv_put(bytes(request.key), bytes(request.value))
+        return filer_pb2.KvPutResponse()

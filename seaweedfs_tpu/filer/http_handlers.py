@@ -1,0 +1,202 @@
+"""Filer HTTP data path: auto-chunking writes, chunk-resolved reads, listing.
+
+Reference: weed/server/filer_server_handlers_write_autochunk.go:24 (upload
+split into fixed-size chunks, each assigned + uploaded to volume servers,
+then one CreateEntry) and filer_server_handlers_read.go (resolve chunk
+views, range reads).  Directory GETs return a JSON listing with
+pagination (?limit=&lastFileName=).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..pb import filer_pb2
+from . import filechunks
+from .filer import join_path, split_path
+
+
+class FilerHttpHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "seaweedfs-tpu-filer"
+
+    filer_server = None  # injected by serve_http
+
+    def log_message(self, fmt, *args):
+        pass
+
+    @property
+    def filer(self):
+        return self.filer_server.filer
+
+    def _send(self, code: int, body: bytes = b"",
+              content_type: str = "application/json",
+              extra: dict | None = None):
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        if body and self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _json(self, code: int, obj: dict):
+        self._send(code, json.dumps(obj).encode())
+
+    # -- read / list -------------------------------------------------------
+
+    def do_GET(self):
+        u = urllib.parse.urlparse(self.path)
+        path = urllib.parse.unquote(u.path)
+        q = urllib.parse.parse_qs(u.query)
+        entry = self.filer.find_entry(path)
+        if entry is None:
+            return self._json(404, {"error": f"{path}: not found"})
+        if entry.is_directory:
+            return self._list_dir(path, q)
+        return self._read_file(path, entry)
+
+    do_HEAD = do_GET
+
+    def _list_dir(self, path: str, q: dict):
+        limit = int(q.get("limit", ["100"])[0])
+        last = q.get("lastFileName", [""])[0]
+        entries = list(
+            self.filer.list_directory(
+                "/" + path.strip("/") if path != "/" else "/",
+                start_from=last,
+                limit=limit + 1,
+            )
+        )
+        more = len(entries) > limit
+        entries = entries[:limit]
+        return self._json(200, {
+            "Path": path,
+            "Entries": [_entry_json(path, e) for e in entries],
+            "Limit": limit,
+            "LastFileName": entries[-1].name if entries else "",
+            "ShouldDisplayLoadMore": more,
+        })
+
+    def _read_file(self, path: str, entry: filer_pb2.Entry):
+        mime = entry.attributes.mime or "application/octet-stream"
+        size = filechunks.total_size(entry.chunks) or len(entry.content)
+        etag = filechunks.etag(entry.chunks) if entry.chunks else ""
+        extra = {"Accept-Ranges": "bytes", "Etag": f'"{etag}"'}
+        start, length = 0, size
+        rng = self.headers.get("Range")
+        status = 200
+        if rng and rng.startswith("bytes="):
+            try:
+                start_s, end_s = rng[len("bytes="):].split("-", 1)
+                if not start_s:
+                    start = max(0, size - int(end_s))
+                    end = size - 1
+                else:
+                    start = int(start_s)
+                    end = min(int(end_s), size - 1) if end_s else size - 1
+                if start > end:
+                    raise ValueError
+                length = end - start + 1
+                extra["Content-Range"] = f"bytes {start}-{end}/{size}"
+                status = 206
+            except ValueError:
+                return self._json(416, {"error": "bad range"})
+        if self.command == "HEAD":
+            return self._send(status, b"\0" * 0, mime,
+                              {**extra, "Content-Length": str(length)})
+        try:
+            data = self.filer_server.read_entry_range(entry, start, length)
+        except Exception as e:
+            return self._json(500, {"error": str(e)})
+        self._send(status, data, mime, extra)
+
+    # -- write -------------------------------------------------------------
+
+    def do_POST(self):
+        self._upload()
+
+    def do_PUT(self):
+        self._upload()
+
+    def _upload(self):
+        u = urllib.parse.urlparse(self.path)
+        path = urllib.parse.unquote(u.path)
+        q = urllib.parse.parse_qs(u.query)
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length)
+        ctype = self.headers.get("Content-Type", "")
+        name_hint = b""
+        if ctype.startswith("multipart/form-data"):
+            from ..volume.http_handlers import _parse_multipart
+
+            body, name_hint, part_mime = _parse_multipart(body, ctype)
+            if part_mime:
+                ctype = part_mime.decode()
+        if path.endswith("/"):
+            # upload INTO a directory: use the part filename
+            if not name_hint:
+                return self._json(400, {"error": "no filename for directory upload"})
+            path = path + name_hint.decode(errors="replace")
+        collection = q.get("collection", [""])[0] or self.filer.bucket_collection(path)
+        ttl = q.get("ttl", [""])[0]
+        try:
+            entry = self.filer_server.write_file(
+                path, body,
+                mime=ctype if ctype and not ctype.startswith("multipart") else "",
+                collection=collection,
+                replication=q.get("replication", [""])[0],
+                ttl=ttl,
+            )
+        except Exception as e:
+            return self._json(500, {"error": str(e)})
+        self._json(201, {
+            "name": entry.name,
+            "size": filechunks.total_size(entry.chunks) or len(entry.content),
+        })
+
+    # -- delete ------------------------------------------------------------
+
+    def do_DELETE(self):
+        u = urllib.parse.urlparse(self.path)
+        path = urllib.parse.unquote(u.path)
+        q = urllib.parse.parse_qs(u.query)
+        recursive = q.get("recursive", ["false"])[0] == "true"
+        directory, name = split_path(path)
+        try:
+            self.filer.delete_entry(
+                directory, name, is_recursive=recursive,
+                ignore_recursive_error=q.get("ignoreRecursiveError", ["false"])[0] == "true",
+            )
+        except FileNotFoundError:
+            return self._json(404, {"error": f"{path}: not found"})
+        except IsADirectoryError as e:
+            return self._json(400, {"error": str(e)})
+        self._send(204)
+
+
+def _entry_json(dir_path: str, e: filer_pb2.Entry) -> dict:
+    return {
+        "FullPath": join_path("/" + dir_path.strip("/") if dir_path != "/" else "/", e.name),
+        "IsDirectory": e.is_directory,
+        "FileSize": filechunks.total_size(e.chunks) or e.attributes.file_size or len(e.content),
+        "Mtime": e.attributes.mtime,
+        "Crtime": e.attributes.crtime,
+        "Mime": e.attributes.mime,
+        "Chunks": len(e.chunks),
+    }
+
+
+def serve_http(filer_server, host: str, port: int) -> ThreadingHTTPServer:
+    handler = type(
+        "BoundFilerHttpHandler", (FilerHttpHandler,),
+        {"filer_server": filer_server},
+    )
+    httpd = ThreadingHTTPServer((host, port), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd

@@ -1,0 +1,94 @@
+"""Filer metadata event log: in-memory buffer + tailing subscriptions.
+
+Reference: weed/filer/filer_notify.go + weed/util/log_buffer — every
+mutation appends an EventNotification with a monotonic ts_ns; subscribers
+replay events since a timestamp, then tail live.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from ..pb import filer_pb2
+
+
+class MetaLogBuffer:
+    def __init__(self, capacity: int = 1 << 16):
+        self._events: deque = deque(maxlen=capacity)
+        self._cond = threading.Condition()
+        self._last_ts = 0
+        self._listeners: list = []
+
+    def append(self, directory: str,
+               old_entry: filer_pb2.Entry | None,
+               new_entry: filer_pb2.Entry | None,
+               delete_chunks: bool = False,
+               new_parent_path: str = "",
+               signatures: list[int] | None = None) -> int:
+        event = filer_pb2.EventNotification(
+            delete_chunks=delete_chunks,
+            new_parent_path=new_parent_path,
+            signatures=signatures or [],
+        )
+        if old_entry is not None:
+            event.old_entry.CopyFrom(old_entry)
+        if new_entry is not None:
+            event.new_entry.CopyFrom(new_entry)
+        with self._cond:
+            ts = time.time_ns()
+            if ts <= self._last_ts:  # keep ts strictly monotonic
+                ts = self._last_ts + 1
+            self._last_ts = ts
+            resp = filer_pb2.SubscribeMetadataResponse(
+                directory=directory, ts_ns=ts
+            )
+            resp.event_notification.CopyFrom(event)
+            self._events.append(resp)
+            self._cond.notify_all()
+            for fn in self._listeners:
+                try:
+                    fn(resp)
+                except Exception:
+                    pass
+        return ts
+
+    def add_listener(self, fn) -> None:
+        """Synchronous callback per event (notification sinks)."""
+        with self._cond:
+            self._listeners.append(fn)
+
+    def subscribe(self, since_ns: int, path_prefix: str = "",
+                  stop_event: threading.Event | None = None,
+                  poll_interval: float = 0.2):
+        """Yield events with ts_ns > since_ns, then tail until stopped."""
+        cursor = since_ns
+        while stop_event is None or not stop_event.is_set():
+            batch = []
+            with self._cond:
+                for ev in self._events:
+                    if ev.ts_ns > cursor:
+                        batch.append(ev)
+                if not batch:
+                    self._cond.wait(timeout=poll_interval)
+            for ev in batch:
+                cursor = ev.ts_ns
+                if path_prefix and not _matches_prefix(ev, path_prefix):
+                    continue
+                yield ev
+
+
+def _matches_prefix(ev, prefix: str) -> bool:
+    """An event is relevant when any affected full path lives under the
+    prefix (directory + entry name, old or new)."""
+    base = ev.directory.rstrip("/")
+    n = ev.event_notification
+    for entry in (n.old_entry, n.new_entry):
+        if entry.name:
+            full = f"{base}/{entry.name}"
+            if full.startswith(prefix) or prefix.startswith(full + "/"):
+                return True
+    if n.new_parent_path and n.new_parent_path.startswith(prefix):
+        return True
+    return False

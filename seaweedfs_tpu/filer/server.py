@@ -1,0 +1,219 @@
+"""FilerServer: HTTP + gRPC front over the Filer core, talking to the
+cluster through a MasterClient.
+
+Reference: weed/server/filer_server.go + filer_server_handlers_write*.go.
+Uploads are auto-chunked: each max_mb slice gets its own Assign + direct
+volume-server upload, then one CreateEntry records the chunk list
+(filer_server_handlers_write_autochunk.go:24-69).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import random
+import time
+
+from ..operation import delete_file_ids, download, upload_data
+from ..operation.assign import AssignResult, assign_any
+from ..pb import filer_pb2
+from ..pb import rpc as rpclib
+from ..wdclient import MasterClient
+from . import filechunks
+from .filer import Filer, split_path
+from .filerstore import make_store
+from .grpc_handlers import FilerGrpcService
+from .http_handlers import serve_http
+
+GRPC_PORT_OFFSET = 10000
+
+
+class FilerServer:
+    def __init__(
+        self,
+        masters: list[str],  # master gRPC addresses
+        ip: str = "127.0.0.1",
+        port: int = 8888,
+        store: str = "sqlite",
+        store_path: str = "./filer.db",
+        max_mb: int = 4,
+        default_replication: str = "",
+        metrics_port: int = 0,
+    ):
+        self.masters = list(masters)
+        self.ip = ip
+        self.port = port
+        self.grpc_port = port + GRPC_PORT_OFFSET
+        self.max_mb = max_mb
+        self.default_replication = default_replication
+        self.signature = random.randint(1, 2**31 - 1)
+        self.metrics_port = metrics_port
+        self.master_client = MasterClient(f"filer@{ip}:{port}", self.masters)
+        if store == "memory":
+            self.filer = Filer(make_store("memory"), self._delete_chunks)
+        else:
+            self.filer = Filer(
+                make_store(store, path=store_path), self._delete_chunks
+            )
+        self._brokers: dict[str, list[str]] = {}
+        self._grpc_server = None
+        self._httpd = None
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self.master_client.start()
+        self._grpc_server = rpclib.serve(
+            [(rpclib.FILER, FilerGrpcService(self))], self.grpc_port
+        )
+        self._httpd = serve_http(self, "0.0.0.0", self.port)
+
+    def stop(self) -> None:
+        self.master_client.stop()
+        if self._httpd:
+            self._httpd.shutdown()
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.5)
+        self.filer.close()
+        self._pool.shutdown(wait=False)
+
+    # -- cluster helpers ---------------------------------------------------
+
+    def assign(self, count: int = 1, collection: str = "",
+               replication: str = "", ttl_sec: int = 0,
+               data_center: str = "", rack: str = "") -> AssignResult:
+        ttl = f"{max(1, ttl_sec // 60)}m" if ttl_sec else ""
+        return assign_any(
+            self._master_order(),
+            count=count,
+            collection=collection,
+            replication=replication or self.default_replication,
+            ttl=ttl,
+            data_center=data_center,
+            rack=rack,
+        )
+
+    def _master_order(self) -> list[str]:
+        cur = self.master_client.current_master
+        if cur:
+            return [cur, *[m for m in self.masters if m != cur]]
+        return list(self.masters)
+
+    def _delete_chunks(self, file_ids: list[str]) -> None:
+        delete_file_ids(self.master_client.lookup_volume, file_ids)
+
+    # -- write path --------------------------------------------------------
+
+    def write_file(self, path: str, data: bytes, mime: str = "",
+                   collection: str = "", replication: str = "",
+                   ttl: str = "") -> filer_pb2.Entry:
+        """Auto-chunking upload: split, assign+upload each chunk, CreateEntry."""
+        directory, name = split_path(path)
+        chunk_size = self.max_mb << 20
+        ttl_sec = _ttl_seconds(ttl)
+        chunks = []
+        offsets = range(0, max(len(data), 1), chunk_size)
+        upload_one = lambda off: self._upload_chunk(  # noqa: E731
+            data[off : off + chunk_size], off, name, mime,
+            collection, replication, ttl,
+        )
+        if len(offsets) > 1:
+            chunks = list(self._pool.map(upload_one, offsets))
+        elif data:
+            chunks = [upload_one(0)]
+        entry = filer_pb2.Entry(name=name)
+        entry.chunks.extend(chunks)
+        entry.attributes.file_size = len(data)
+        entry.attributes.mime = mime
+        entry.attributes.mtime = int(time.time())
+        entry.attributes.crtime = int(time.time())
+        entry.attributes.file_mode = 0o644
+        entry.attributes.collection = collection
+        entry.attributes.replication = replication
+        entry.attributes.ttl_sec = ttl_sec
+        self.filer.create_entry(directory, entry)
+        return entry
+
+    def _upload_chunk(self, blob: bytes, offset: int, name: str, mime: str,
+                      collection: str, replication: str, ttl: str
+                      ) -> filer_pb2.FileChunk:
+        result = assign_any(
+            self._master_order(), count=1, collection=collection,
+            replication=replication or self.default_replication, ttl=ttl,
+        )
+        up = upload_data(
+            result.fid_url(), blob, filename=name, mime=mime, jwt=result.auth
+        )
+        return filechunks.make_chunk(
+            result.fid, offset, len(blob), time.time_ns(), e_tag=up.etag
+        )
+
+    # -- read path ---------------------------------------------------------
+
+    def read_entry_range(self, entry: filer_pb2.Entry, offset: int,
+                         size: int) -> bytes:
+        if entry.content:  # inline small-file content
+            return bytes(entry.content[offset : offset + size])
+        views = filechunks.view_from_chunks(list(entry.chunks), offset, size)
+        if not views:
+            return b""
+        if len(views) == 1:
+            return self._fetch_view(views[0])
+        parts = list(self._pool.map(self._fetch_view, views))
+        # assemble honoring logical offsets (holes read as zeros)
+        out = bytearray(size)
+        for v, blob in zip(views, parts):
+            lo = v.logical_offset - offset
+            out[lo : lo + len(blob)] = blob
+        return bytes(out)
+
+    def _fetch_view(self, view: filechunks.ChunkView) -> bytes:
+        urls = self.master_client.lookup_file_id(view.file_id)
+        if not urls:
+            raise IOError(f"no locations for chunk {view.file_id}")
+        last_err: Exception | None = None
+        for url in urls:
+            try:
+                rng = f"bytes={view.offset}-{view.offset + view.size - 1}"
+                return download(url, range_header=rng)
+            except Exception as e:
+                last_err = e
+        raise IOError(f"chunk {view.file_id} unreadable: {last_err}")
+
+    # -- collections / brokers --------------------------------------------
+
+    def delete_collection(self, collection: str) -> None:
+        from ..pb import master_pb2
+
+        self.filer.delete_collection_entries(collection)
+        for m in self._master_order():
+            try:
+                rpclib.master_stub(m, timeout=30).CollectionDelete(
+                    master_pb2.CollectionDeleteRequest(name=collection)
+                )
+                return
+            except Exception:
+                continue
+
+    def register_broker(self, resource: str, grpc_address: str) -> None:
+        self._brokers.setdefault(resource, [])
+        if grpc_address not in self._brokers[resource]:
+            self._brokers[resource].append(grpc_address)
+
+    def locate_broker(self, resource: str) -> filer_pb2.LocateBrokerResponse:
+        resp = filer_pb2.LocateBrokerResponse(found=resource in self._brokers)
+        for addr in self._brokers.get(resource, ()):
+            resp.resources.add(grpc_addresses=addr, resource_count=1)
+        return resp
+
+
+def _ttl_seconds(ttl: str) -> int:
+    if not ttl:
+        return 0
+    units = {"s": 1, "m": 60, "h": 3600, "d": 86400, "w": 604800}
+    try:
+        if ttl[-1] in units:
+            return int(ttl[:-1]) * units[ttl[-1]]
+        return int(ttl)
+    except ValueError:
+        return 0

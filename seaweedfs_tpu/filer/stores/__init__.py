@@ -1,0 +1,9 @@
+"""Embedded FilerStore backends; importing registers them.
+
+Reference analogue: weed/filer/<backend>/ dirs registered via blank-import
+init() (weed/server/filer_server.go:23-36).  This build ships the two
+embedded classes: in-memory (tests) and sqlite (the leveldb-class default —
+single-file, transactional, ordered listing).
+"""
+
+from . import memory_store, sqlite_store  # noqa: F401

@@ -1,0 +1,92 @@
+"""Upload / download blob content to/from volume servers over HTTP.
+
+Reference: weed/operation/upload_content.go:69-191 — multipart POST with
+optional gzip compression, retried; the server answers {name,size,eTag}.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import time
+import urllib.error
+import urllib.request
+import uuid
+from dataclasses import dataclass
+
+_COMPRESSIBLE_PREFIXES = ("text/", "application/json", "application/xml")
+
+
+@dataclass
+class UploadResult:
+    name: str
+    size: int
+    etag: str
+    mime: str = ""
+    gzipped: bool = False
+
+
+def upload_data(
+    url: str,
+    data: bytes,
+    filename: str = "",
+    mime: str = "",
+    compress: bool = False,
+    jwt: str = "",
+    retries: int = 3,
+    timeout: float = 30.0,
+) -> UploadResult:
+    """POST data as multipart/form-data to a volume-server fid url."""
+    gzipped = False
+    payload = data
+    if compress and _is_compressible(mime, filename) and len(data) > 128:
+        squeezed = gzip.compress(data, compresslevel=3)
+        if len(squeezed) < len(data) * 0.9:
+            payload = squeezed
+            gzipped = True
+
+    boundary = uuid.uuid4().hex
+    head = (
+        f"--{boundary}\r\n"
+        f'Content-Disposition: form-data; name="file"; '
+        f'filename="{filename or "file"}"\r\n'
+        f"Content-Type: {mime or 'application/octet-stream'}\r\n"
+        + ("Content-Encoding: gzip\r\n" if gzipped else "")
+        + "\r\n"
+    ).encode()
+    body = head + payload + f"\r\n--{boundary}--\r\n".encode()
+    headers = {"Content-Type": f"multipart/form-data; boundary={boundary}"}
+    if jwt:
+        headers["Authorization"] = f"BEARER {jwt}"
+
+    last: Exception | None = None
+    for attempt in range(retries):
+        try:
+            req = urllib.request.Request(url, data=body, headers=headers, method="POST")
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                out = json.loads(resp.read() or b"{}")
+            return UploadResult(
+                name=out.get("name", filename),
+                size=out.get("size", len(data)),
+                etag=out.get("eTag", ""),
+                mime=mime,
+                gzipped=gzipped,
+            )
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+            last = e
+            time.sleep(0.2 * (attempt + 1))
+    raise RuntimeError(f"upload to {url} failed: {last}")
+
+
+def download(url: str, timeout: float = 30.0,
+             range_header: str | None = None) -> bytes:
+    headers = {"Range": range_header} if range_header else {}
+    req = urllib.request.Request(url, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read()
+
+
+def _is_compressible(mime: str, filename: str) -> bool:
+    if any(mime.startswith(p) for p in _COMPRESSIBLE_PREFIXES):
+        return True
+    return filename.endswith((".txt", ".csv", ".json", ".log", ".xml", ".html"))

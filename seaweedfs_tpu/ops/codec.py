@@ -24,10 +24,10 @@ TOTAL_SHARDS = DATA_SHARDS + PARITY_SHARDS
 
 def available_codecs() -> list[str]:
     """Canonical codec names usable with ``get_codec`` on this host."""
+    import importlib.util
+
     names = ["cpu"]
-    try:
-        import jax  # noqa: F401
-    except ImportError:
+    if importlib.util.find_spec("jax") is None:
         return names
     return names + ["tpu", "tpu_xor", "tpu_mxu"]
 

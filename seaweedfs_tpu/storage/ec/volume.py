@@ -17,6 +17,7 @@ from typing import Callable
 import numpy as np
 
 from ...ops.codec import get_codec
+from .. import idx as idx_mod
 from .. import types as t
 from ..needle import Needle, actual_size
 from ..super_block import VERSION3
@@ -104,26 +105,39 @@ class EcVolume:
     @property
     def shard_size(self) -> int:
         """Size of every shard file.  Prefer a locally mounted shard; with
-        none mounted (all shards remote), derive it from the .ecx: the volume
-        extends at least to max(offset + actual_size) over all entries, and
-        the shard size is a deterministic function of the dat size
+        none mounted (all shards remote), use the .dat size recorded in the
+        .vif at encode time; last resort, bound it from the .ecx
         (reference: ec_decoder.go FindDatFileSize derives the same bound)."""
         if self.shards:
             return next(iter(self.shards.values())).size
         if self._ecx_derived_shard_size is None:
-            self._ecx_derived_shard_size = self._shard_size_from_ecx()
+            self._ecx_derived_shard_size = (
+                self._shard_size_from_vif() or self._shard_size_from_ecx()
+            )
         return self._ecx_derived_shard_size
 
+    def _shard_size_from_vif(self) -> int | None:
+        from ..vif import load_volume_info
+
+        info = load_volume_info(self.base_name + ".vif")
+        if info is None or not info.dat_file_size:
+            return None
+        return shard_file_size(
+            info.dat_file_size, self.large_block_size, self.small_block_size
+        )
+
     def _shard_size_from_ecx(self) -> int:
-        end = 0
+        """One bulk read of the .ecx.  Tombstoned entries lose their size
+        field, so they still contribute `offset + 1` — the volume must not
+        shrink because its tail needle was deleted (the shard files on the
+        other holders keep their full extent)."""
         self._ecx.seek(0)
-        entries = self.ecx_size // t.NEEDLE_MAP_ENTRY_SIZE
-        for i in range(entries):
-            self._ecx.seek(i * t.NEEDLE_MAP_ENTRY_SIZE)
-            _key, offset, size = t.unpack_index_entry(
-                self._ecx.read(t.NEEDLE_MAP_ENTRY_SIZE)
-            )
-            if not t.size_is_deleted(size):
+        blob = self._ecx.read(self.ecx_size)
+        end = 0
+        for _key, offset, size in idx_mod.walk_index_blob(blob):
+            if t.size_is_deleted(size):
+                end = max(end, offset + 1)
+            else:
                 end = max(end, offset + actual_size(size, self.version))
         return shard_file_size(end, self.large_block_size, self.small_block_size)
 

@@ -47,10 +47,11 @@ def parse_index_arrays(path: str | os.PathLike):
         blob = f.read()
     n = len(blob) // t.NEEDLE_MAP_ENTRY_SIZE
     raw = np.frombuffer(blob, dtype=np.uint8, count=n * 16).reshape(n, 16)
-    keys = raw[:, 0:8][:, ::-1].copy().view(np.uint64).reshape(n)
-    stored = raw[:, 8:12][:, ::-1].copy().view(np.uint32).reshape(n)
+    # explicit big-endian dtypes keep this host-endianness-independent
+    keys = raw[:, 0:8].copy().view(">u8").reshape(n).astype(np.uint64)
+    stored = raw[:, 8:12].copy().view(">u4").reshape(n).astype(np.uint32)
     offsets = stored.astype(np.int64) * t.NEEDLE_PADDING_SIZE
-    sizes = raw[:, 12:16][:, ::-1].copy().view(np.int32).reshape(n)
+    sizes = raw[:, 12:16].copy().view(">i4").reshape(n).astype(np.int32)
     return keys, offsets, sizes
 
 

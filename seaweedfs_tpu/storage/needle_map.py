@@ -219,7 +219,7 @@ class NeedleMap:
         self._merge()
         n = len(self._keys)
         out = np.empty((n, 16), dtype=np.uint8)
-        out[:, 0:8] = self._keys[:, None].view(np.uint8).reshape(n, 8)[:, ::-1]
+        out[:, 0:8] = self._keys.astype(">u8")[:, None].view(np.uint8).reshape(n, 8)
         stored_off = (self._offsets // t.NEEDLE_PADDING_SIZE).astype(">u4")
         out[:, 8:12] = stored_off[:, None].view(np.uint8).reshape(n, 4)
         out[:, 12:16] = (

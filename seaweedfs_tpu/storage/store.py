@@ -245,7 +245,8 @@ class Store:
         v.sync()
         write_ec_files(base, codec_name=codec_name or self.codec_name)
         write_sorted_file_from_idx(base)
-        save_volume_info(base + ".vif", v.version)
+        save_volume_info(base + ".vif", v.version,
+                         dat_file_size=os.path.getsize(base + ".dat"))
 
     def rebuild_ec_shards(self, vid: int, collection: str,
                           codec_name: str | None = None) -> list[int]:

@@ -15,8 +15,14 @@ from google.protobuf import json_format
 from ..pb import volume_info_pb2
 
 
-def save_volume_info(path: str, version: int, replication: str = "") -> None:
-    info = volume_info_pb2.VolumeInfo(version=version, replication=replication)
+def save_volume_info(path: str, version: int, replication: str = "",
+                     dat_file_size: int = 0) -> None:
+    """``dat_file_size`` records the logical .dat size; EC volumes with no
+    local shard use it to recover interval geometry (a tombstoned .ecx
+    entry loses its size, so the index alone can under-bound the volume)."""
+    info = volume_info_pb2.VolumeInfo(
+        version=version, replication=replication, dat_file_size=dat_file_size
+    )
     with open(path, "w") as f:
         f.write(json_format.MessageToJson(info))
 

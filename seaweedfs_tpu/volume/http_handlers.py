@@ -176,11 +176,19 @@ def _parse_multipart(body: bytes, ctype: str) -> tuple[bytes, bytes, bytes]:
     if not boundary:
         return body, b"", b""
     delim = b"--" + boundary
-    for part in body.split(delim):
-        if b"\r\n\r\n" not in part:
+    # parts are separated by CRLF + delimiter; the first delimiter may have
+    # no preceding CRLF, and the last is delim + b"--".  Splitting on the
+    # exact separator keeps payload bytes intact (no rstrip — trailing
+    # \r\n or '-' bytes in the data must survive).
+    normalized = body if body.startswith(b"\r\n") else b"\r\n" + body
+    for part in normalized.split(b"\r\n" + delim)[1:]:
+        if part.startswith(b"--"):
+            break  # closing delimiter
+        if part.startswith(b"\r\n"):
+            part = part[2:]
+        head, sep, content = part.partition(b"\r\n\r\n")
+        if not sep:
             continue
-        head, _, content = part.partition(b"\r\n\r\n")
-        content = content.rstrip(b"\r\n-")
         name = b""
         mime = b""
         for line in head.split(b"\r\n"):

@@ -1,0 +1,9 @@
+"""Cluster client library: MasterClient + vidMap location cache.
+
+Reference surface: weed/wdclient (masterclient.go, vid_map.go).
+"""
+
+from .masterclient import MasterClient
+from .vid_map import Location, VidMap
+
+__all__ = ["MasterClient", "VidMap", "Location"]

@@ -1,0 +1,123 @@
+"""MasterClient: long-lived client keeping a streamed vid->location cache.
+
+Reference: weed/wdclient/masterclient.go:38-154 — a reconnecting
+KeepConnected stream against the current leader feeds VolumeLocation deltas
+into the vidMap; lookups that miss the cache fall back to a LookupVolume
+rpc.  Clients chase the leader hint carried on each VolumeLocation.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import grpc
+
+from ..pb import master_pb2
+from ..pb import rpc as rpclib
+from .vid_map import Location, VidMap
+
+
+class MasterClient:
+    def __init__(self, name: str, master_grpc_addresses: list[str],
+                 grpc_port: int = 0):
+        self.name = name
+        self.masters = list(master_grpc_addresses)
+        self.grpc_port = grpc_port
+        self.vid_map = VidMap()
+        self.current_master = ""
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._connected = threading.Event()
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._keep_connected_loop, daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def wait_until_connected(self, timeout: float = 10.0) -> bool:
+        return self._connected.wait(timeout)
+
+    # -- the KeepConnected loop ------------------------------------------
+
+    def _keep_connected_loop(self) -> None:
+        i = 0
+        while not self._stop.is_set():
+            master = self.masters[i % len(self.masters)]
+            i += 1
+            try:
+                self._stream_from(master)
+            except grpc.RpcError:
+                pass
+            self._connected.clear()
+            self._stop.wait(0.5)
+
+    def _stream_from(self, master: str) -> None:
+        stub = rpclib.master_stub(master)
+
+        def requests():
+            yield master_pb2.KeepConnectedRequest(
+                name=self.name, grpc_port=self.grpc_port
+            )
+            # keep the stream open until stopped
+            while not self._stop.wait(1.0):
+                pass
+
+        for loc in stub.KeepConnected(requests()):
+            if self._stop.is_set():
+                return
+            self.current_master = master
+            self._connected.set()
+            self._apply(loc)
+            if loc.leader and not loc.leader.endswith(master.rsplit(":", 1)[1]):
+                # leader moved: reconnect there next round
+                pass
+
+    def _apply(self, loc: master_pb2.VolumeLocation) -> None:
+        location = Location(url=loc.url, public_url=loc.public_url or loc.url)
+        for vid in loc.new_vids:
+            self.vid_map.add_location(vid, location)
+        for vid in loc.deleted_vids:
+            self.vid_map.delete_location(vid, loc.url)
+
+    # -- lookups ----------------------------------------------------------
+
+    def lookup_volume(self, vid: int) -> list[Location]:
+        locs = self.vid_map.lookup(vid)
+        if locs:
+            return locs
+        # cache miss: ask a master directly
+        for master in self._master_order():
+            try:
+                resp = rpclib.master_stub(master, timeout=10).LookupVolume(
+                    master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)])
+                )
+            except grpc.RpcError:
+                continue
+            for vl in resp.volume_id_locations:
+                for l in vl.locations:
+                    self.vid_map.add_location(
+                        vid, Location(l.url, l.public_url or l.url)
+                    )
+            return self.vid_map.lookup(vid)
+        return []
+
+    def lookup_file_id(self, fid: str) -> list[str]:
+        """-> public urls serving this file id."""
+        vid = int(fid.split(",", 1)[0])
+        return [
+            f"http://{l.public_url or l.url}/{fid}"
+            for l in self.lookup_volume(vid)
+        ]
+
+    def _master_order(self) -> list[str]:
+        if self.current_master:
+            rest = [m for m in self.masters if m != self.current_master]
+            return [self.current_master, *rest]
+        return list(self.masters)

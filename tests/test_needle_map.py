@@ -21,7 +21,7 @@ def test_put_get_delete_with_merges():
     assert 101 not in nm
     # overwrite: accounting moves old bytes to deleted
     nm.put(50, 8000, 500)
-    assert nm.get(50) == nm.get(50)
+    assert nm.get(50).offset == 8000
     assert nm.get(50).size == 500
     assert nm.deleted_count == 1
     assert nm.deleted_bytes == 149

@@ -42,10 +42,11 @@ def test_snowflake_monotonic_and_range_start():
     assert (first >> 12) & 0x3FF == 7
 
 
-def test_snowflake_overflow_waits_for_real_clock():
+def test_snowflake_overflow_advances_monotonically():
     s = SnowflakeSequencer(node_id=1)
-    # exhaust a millisecond's 4096-id space; generator must roll into a
-    # *real* later millisecond, never a fabricated one that could repeat
+    # exhaust a millisecond's 4096-id space; the generator advances to the
+    # next logical millisecond and clamps _last_ms monotonically, so the
+    # bumped millisecond can never be re-issued even if the wall clock lags
     ids = [s.next_file_id(512) for _ in range(20)]
     assert ids == sorted(ids)
     assert len(set(ids)) == 20
