@@ -21,6 +21,8 @@ def cmd_master(args) -> None:
         volume_size_limit_mb=args.volumeSizeLimitMB,
         default_replication=args.defaultReplication,
         maintenance_interval=args.maintenanceInterval,
+        metrics_port=args.metricsPort,
+        jwt_signing_key=args.jwtKey,
     )
     m.start()
     print(f"master listening http={args.port} grpc={m.grpc_port}")
@@ -41,6 +43,9 @@ def cmd_volume(args) -> None:
         rack=args.rack,
         codec_name=getattr(args, "ec_codec", "cpu"),
         max_volume_count=args.max,
+        metrics_port=args.metricsPort,
+        jwt_signing_key=args.jwtKey,
+        whitelist=args.whiteList.split(",") if args.whiteList else None,
     )
     v.start()
     print(f"volume server http={args.port} grpc={v.grpc_port} dirs={args.dir}")
@@ -73,6 +78,8 @@ def cmd_filer(args) -> None:
         ip=args.ip,
         port=args.port,
         store_path=args.store,
+        max_mb=args.maxMB,
+        metrics_port=args.metricsPort,
     )
     f.start()
     print(f"filer http={args.port} grpc={f.grpc_port}")
@@ -159,6 +166,8 @@ def main(argv=None) -> None:
     m.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
     m.add_argument("-defaultReplication", default="000")
     m.add_argument("-maintenanceInterval", type=float, default=0.0)
+    m.add_argument("-metricsPort", type=int, default=0)
+    m.add_argument("-jwtKey", default="")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume")
@@ -171,6 +180,9 @@ def main(argv=None) -> None:
     v.add_argument("-max", type=int, default=7)
     v.add_argument("-ec.codec", dest="ec_codec", default="cpu",
                    choices=["cpu", "tpu", "tpu_xor", "tpu_mxu"])
+    v.add_argument("-metricsPort", type=int, default=0)
+    v.add_argument("-jwtKey", default="")
+    v.add_argument("-whiteList", default="")
     v.set_defaults(fn=cmd_volume)
 
     s = sub.add_parser("server")
@@ -186,6 +198,8 @@ def main(argv=None) -> None:
     f.add_argument("-ip", default="127.0.0.1")
     f.add_argument("-port", type=int, default=8888)
     f.add_argument("-store", default="./filer.db")
+    f.add_argument("-maxMB", type=int, default=4)
+    f.add_argument("-metricsPort", type=int, default=0)
     f.set_defaults(fn=cmd_filer)
 
     sh = sub.add_parser("shell")

@@ -96,11 +96,13 @@ class FilerGrpcService:
     # -- cluster proxies ---------------------------------------------------
 
     def AssignVolume(self, request, context):
+        collection = request.collection or self.filer.bucket_collection(
+            request.path
+        )
         try:
             result = self.fs.assign(
                 count=request.count or 1,
-                collection=request.collection
-                or self.filer.bucket_collection(request.path),
+                collection=collection,
                 replication=request.replication,
                 ttl_sec=request.ttl_sec,
                 data_center=request.data_center,
@@ -114,7 +116,7 @@ class FilerGrpcService:
             public_url=result.public_url,
             count=result.count,
             auth=result.auth,
-            collection=request.collection,
+            collection=collection,
             replication=request.replication,
         )
 

@@ -36,10 +36,12 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
     def _send(self, code: int, body: bytes = b"",
               content_type: str = "application/json",
               extra: dict | None = None):
+        extra = extra or {}
         self.send_response(code)
         self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(body)))
-        for k, v in (extra or {}).items():
+        if "Content-Length" not in extra:
+            self.send_header("Content-Length", str(len(body)))
+        for k, v in extra.items():
             self.send_header(k, v)
         self.end_headers()
         if body and self.command != "HEAD":
@@ -51,6 +53,9 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
     # -- read / list -------------------------------------------------------
 
     def do_GET(self):
+        from ..stats.metrics import REQUEST_COUNTER
+
+        REQUEST_COUNTER.labels("filer", "get").inc()
         u = urllib.parse.urlparse(self.path)
         path = urllib.parse.unquote(u.path)
         q = urllib.parse.parse_qs(u.query)
@@ -125,6 +130,9 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
         self._upload()
 
     def _upload(self):
+        from ..stats.metrics import REQUEST_COUNTER
+
+        REQUEST_COUNTER.labels("filer", "post").inc()
         u = urllib.parse.urlparse(self.path)
         path = urllib.parse.unquote(u.path)
         q = urllib.parse.parse_qs(u.query)

@@ -62,16 +62,24 @@ class FilerServer:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
+        from ..stats.metrics import serve_metrics
+        from ..util import glog
+
         self.master_client.start()
         self._grpc_server = rpclib.serve(
             [(rpclib.FILER, FilerGrpcService(self))], self.grpc_port
         )
         self._httpd = serve_http(self, "0.0.0.0", self.port)
+        if self.metrics_port:
+            self._metricsd = serve_metrics(self.metrics_port)
+        glog.info("filer started http=%d grpc=%d", self.port, self.grpc_port)
 
     def stop(self) -> None:
         self.master_client.stop()
         if self._httpd:
             self._httpd.shutdown()
+        if getattr(self, "_metricsd", None):
+            self._metricsd.shutdown()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
         self.filer.close()

@@ -16,6 +16,11 @@ from typing import Iterator
 from ...pb import filer_pb2
 from ..filerstore import FilerStore, register_store
 
+def _glob_escape(s: str) -> str:
+    """Escape GLOB metacharacters so path text matches literally."""
+    return s.replace("[", "[[]").replace("*", "[*]").replace("?", "[?]")
+
+
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS filemeta (
     directory TEXT NOT NULL,
@@ -75,7 +80,7 @@ class SqliteStore(FilerStore):
         with self._lock:
             self._conn.execute(
                 "DELETE FROM filemeta WHERE directory=? OR directory GLOB ?",
-                (directory, prefix.replace("[", "[[]") + "*"),
+                (directory, _glob_escape(prefix) + "*"),
             )
             self._conn.commit()
 
@@ -96,7 +101,7 @@ class SqliteStore(FilerStore):
         params: list = [directory, start_from]
         if prefix:
             sql += "AND name GLOB ? "
-            params.append(prefix.replace("[", "[[]") + "*")
+            params.append(_glob_escape(prefix) + "*")
         sql += "ORDER BY name LIMIT ?"
         params.append(limit)
         with self._lock:

@@ -113,7 +113,8 @@ class MasterGrpcService:
         except Exception as e:
             return master_pb2.AssignResponse(error=str(e))
         return master_pb2.AssignResponse(
-            fid=fid, url=url, public_url=public_url, count=count
+            fid=fid, url=url, public_url=public_url, count=count,
+            auth=self.master.sign_fid(fid),
         )
 
     def LookupVolume(self, request, context):

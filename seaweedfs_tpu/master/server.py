@@ -21,7 +21,9 @@ import grpc
 from ..pb import master_pb2
 from ..pb import rpc as rpclib
 from ..pb import volume_server_pb2 as vs
+from ..stats.metrics import REQUEST_COUNTER, serve_metrics
 from ..storage.replica_placement import ReplicaPlacement
+from ..util import glog
 from ..topology.placement import Candidate, pick_nodes_for_write
 from ..topology.topology import Topology
 from ..topology.volume_layout import VolumeLayout
@@ -42,6 +44,8 @@ class MasterServer:
         sequencer: str = "memory",
         garbage_threshold: float = 0.3,
         maintenance_interval: float = 0.0,  # seconds; 0 disables
+        metrics_port: int = 0,
+        jwt_signing_key: bytes | str = b"",
     ):
         self.ip = ip
         self.port = port
@@ -63,6 +67,12 @@ class MasterServer:
         self._stop = threading.Event()
         self._grpc_server = None
         self._httpd = None
+        self._metricsd = None
+        self.metrics_port = metrics_port
+        self.jwt_signing_key = (
+            jwt_signing_key.encode() if isinstance(jwt_signing_key, str)
+            else jwt_signing_key
+        )
         self._rng = random.Random()
 
     # -- lifecycle --------------------------------------------------------
@@ -72,14 +82,19 @@ class MasterServer:
             [(rpclib.MASTER, MasterGrpcService(self))], self.grpc_port
         )
         self._httpd = _serve_http(self, "0.0.0.0", self.port)
+        if self.metrics_port:
+            self._metricsd = serve_metrics(self.metrics_port)
         threading.Thread(target=self._liveness_loop, daemon=True).start()
         if self.maintenance_interval > 0:
             threading.Thread(target=self._maintenance_loop, daemon=True).start()
+        glog.info("master started http=%d grpc=%d", self.port, self.grpc_port)
 
     def stop(self) -> None:
         self._stop.set()
         if self._httpd:
             self._httpd.shutdown()
+        if self._metricsd:
+            self._metricsd.shutdown()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
 
@@ -121,8 +136,18 @@ class MasterServer:
 
     # -- assign -----------------------------------------------------------
 
+    def sign_fid(self, fid: str) -> str:
+        """Write JWT for an assigned fid (security/jwt.go GenJwt); empty
+        when the cluster runs without a signing key."""
+        if not self.jwt_signing_key:
+            return ""
+        from ..security.jwt import gen_write_jwt
+
+        return gen_write_jwt(self.jwt_signing_key, fid)
+
     def assign(self, count: int, collection: str, replication: str,
                ttl: str, data_center: str = "", rack: str = "") -> tuple[str, str, str, int]:
+        REQUEST_COUNTER.labels("master", "assign").inc()
         layout = self.get_layout(collection, replication, ttl)
         try:
             vid, node_ids = layout.pick_for_write()
@@ -146,6 +171,8 @@ class MasterServer:
         # grow several volumes for write concurrency, like the reference's
         # automatic growth defaults (volume_growth.go)
         n_grow = target_count or max(1, 7 // rp.copy_count() // 2)
+        glog.info("growing %d volume(s) collection=%r replication=%s",
+                  n_grow, collection, replication)
         grown: list[int] = []
         for _ in range(n_grow):
             with self.topo.lock:
@@ -352,10 +379,14 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
                     data_center=qget("dataCenter"),
                     rack=qget("rack"),
                 )
-                return self._json(200, {
+                out = {
                     "fid": fid, "url": url, "publicUrl": public_url,
                     "count": count,
-                })
+                }
+                auth = self.master.sign_fid(fid)
+                if auth:
+                    out["auth"] = auth
+                return self._json(200, out)
             except Exception as e:
                 return self._json(500, {"error": str(e)})
         if u.path == "/dir/lookup":

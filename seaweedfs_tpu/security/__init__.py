@@ -1,0 +1,11 @@
+"""Security: JWT write tokens, IP whitelist guard, TLS config.
+
+Reference surface: weed/security (jwt.go, guard.go, tls.go).
+"""
+
+from .jwt import decode_jwt, encode_jwt, gen_write_jwt, verify_write_jwt
+from .guard import Guard
+
+__all__ = [
+    "encode_jwt", "decode_jwt", "gen_write_jwt", "verify_write_jwt", "Guard",
+]

@@ -1,0 +1,17 @@
+"""Prometheus-format metrics registry + exposition endpoint.
+
+Reference surface: weed/stats/metrics.go:25-123.
+"""
+
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    REGISTRY,
+    serve_metrics,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "REGISTRY", "serve_metrics",
+]

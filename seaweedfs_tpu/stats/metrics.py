@@ -1,0 +1,264 @@
+"""A small Prometheus client: counters, gauges, histograms with labels,
+text exposition on a /metrics HTTP endpoint.
+
+Reference: weed/stats/metrics.go — the same metric families (request
+counters + latency histograms per server/operation, volume/EC-shard
+gauges), exposed on -metricsPort or pushed to a gateway.  No external
+prometheus_client dependency: the exposition format is a stable text
+protocol worth owning.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_DEFAULT_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class Metric:
+    def __init__(self, name: str, help_: str, label_names: tuple[str, ...]):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._children: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values: str):
+        key = tuple(str(v) for v in values)
+        if len(key) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: want {len(self.label_names)} labels, got {len(key)}"
+            )
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child()
+                self._children[key] = child
+            return child
+
+    def _label_str(self, key: tuple) -> str:
+        if not key:
+            return ""
+        pairs = ",".join(
+            f'{n}="{v}"' for n, v in zip(self.label_names, key)
+        )
+        return "{" + pairs + "}"
+
+
+class _CounterChild:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def _make_child(self):
+        return _CounterChild()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.labels().inc(amount)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = list(self._children.items())
+        for key, child in items:
+            out.append(f"{self.name}{self._label_str(key)} {child.value}")
+        return out
+
+
+class _GaugeChild:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self.value = float(v)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def _make_child(self):
+        return _GaugeChild()
+
+    def set(self, v: float) -> None:
+        self.labels().set(v)
+
+
+class _HistogramChild:
+    __slots__ = ("buckets", "counts", "total", "count", "_lock")
+
+    def __init__(self, buckets):
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)
+        self.total = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self.total += v
+            self.count += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+
+    def time(self):
+        return _Timer(self)
+
+
+class _Timer:
+    def __init__(self, hist):
+        self._hist = hist
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._hist.observe(time.perf_counter() - self._t0)
+        return False
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_, label_names=(), buckets=_DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(buckets)
+
+    def _make_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, v: float) -> None:
+        self.labels().observe(v)
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            items = list(self._children.items())
+        for key, child in items:
+            base = dict(zip(self.label_names, key))
+            for b, c in zip(child.buckets, child.counts):
+                labels = {**base, "le": repr(b) if b != int(b) else str(b)}
+                pairs = ",".join(f'{n}="{v}"' for n, v in labels.items())
+                out.append(f"{self.name}_bucket{{{pairs}}} {c}")
+            inf_pairs = ",".join(
+                f'{n}="{v}"' for n, v in {**base, "le": "+Inf"}.items()
+            )
+            out.append(f"{self.name}_bucket{{{inf_pairs}}} {child.count}")
+            ls = self._label_str(key)
+            out.append(f"{self.name}_sum{ls} {child.total}")
+            out.append(f"{self.name}_count{ls} {child.count}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "", labels: tuple = ()) -> Counter:
+        return self._get_or_make(Counter, name, help_, tuple(labels))
+
+    def gauge(self, name: str, help_: str = "", labels: tuple = ()) -> Gauge:
+        return self._get_or_make(Gauge, name, help_, tuple(labels))
+
+    def histogram(self, name: str, help_: str = "", labels: tuple = (),
+                  buckets=_DEFAULT_BUCKETS) -> Histogram:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help_, tuple(labels), buckets)
+                self._metrics[name] = m
+            return m
+
+    def _get_or_make(self, cls, name, help_, labels):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help_, labels)
+                self._metrics[name] = m
+            return m
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()
+
+# the reference's metric families (stats/metrics.go:25-123)
+REQUEST_COUNTER = REGISTRY.counter(
+    "seaweedfs_request_total", "requests by server type and operation",
+    labels=("type", "op"),
+)
+REQUEST_HISTOGRAM = REGISTRY.histogram(
+    "seaweedfs_request_seconds", "request latency", labels=("type", "op"),
+)
+VOLUME_GAUGE = REGISTRY.gauge(
+    "seaweedfs_volumes", "volumes hosted, by collection and kind",
+    labels=("collection", "type"),
+)
+DISK_SIZE_GAUGE = REGISTRY.gauge(
+    "seaweedfs_disk_size_bytes", "stored bytes by collection and kind",
+    labels=("collection", "type"),
+)
+
+
+def serve_metrics(port: int, registry: Registry = REGISTRY,
+                  host: str = "0.0.0.0") -> ThreadingHTTPServer:
+    """Expose GET /metrics in Prometheus text format."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            if self.path.split("?")[0] != "/metrics":
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            body = registry.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd

@@ -197,6 +197,20 @@ class Store:
             raise KeyError(f"volume {vid} not found")
         return v.delete_needle(needle_id)
 
+    def delete_ec_needle(self, vid: int, needle_id: int) -> int:
+        """Tombstone a needle in a local EC volume (.ecx in place + .ecj).
+        Returns the needle's stored size (0 when already gone).
+        Reference: store_ec_delete.go DeleteEcShardNeedle local half."""
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            raise KeyError(f"ec volume {vid} not found")
+        try:
+            _offset, size = ev.find_needle_from_ecx(needle_id)
+        except KeyError:
+            return 0
+        ev.delete_needle(needle_id)
+        return max(size, 0)
+
     # -- vacuum -----------------------------------------------------------
 
     def check_compact_volume(self, vid: int) -> float:

@@ -1,0 +1,4 @@
+"""Operator tools: load benchmark, offline volume fix/export.
+
+Reference surface: weed/command/benchmark.go, fix.go, export.go.
+"""

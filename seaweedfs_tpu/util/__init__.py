@@ -1,0 +1,5 @@
+"""Cross-cutting utilities: logging, compression, cipher, caching,
+throttling, config.
+
+Reference surface: weed/glog, weed/util.
+"""

@@ -15,6 +15,8 @@ import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..security.jwt import token_from_header, verify_write_jwt
+from ..stats.metrics import REQUEST_COUNTER, REQUEST_HISTOGRAM
 from ..storage.file_id import FileId
 from ..storage.needle import FLAG_HAS_MIME, FLAG_HAS_NAME, Needle
 
@@ -33,6 +35,29 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
     def store(self):
         return self.volume_server.store
 
+    def handle_one_request(self):
+        # IP whitelist guard (security/guard.go:43)
+        guard = self.volume_server.guard
+        if guard.networks and not guard.allows(self.client_address[0]):
+            try:
+                self.raw_requestline = self.rfile.readline(65537)
+                if self.raw_requestline and self.parse_request():
+                    self._send_json(403, {"error": "ip not in whitelist"})
+            except Exception:
+                pass
+            self.close_connection = True
+            return
+        super().handle_one_request()
+
+    def _check_write_jwt(self, fid_str: str) -> bool:
+        """JWT write-token verification when the cluster is keyed
+        (security/jwt.go ValidateJwt)."""
+        key = self.volume_server.jwt_signing_key
+        if not key:
+            return True
+        token = token_from_header(self.headers.get("Authorization"))
+        return verify_write_jwt(key, token, fid_str)
+
     def _send(self, code: int, body: bytes = b"", content_type: str = "application/json", extra: dict | None = None):
         self.send_response(code)
         self.send_header("Content-Type", content_type)
@@ -49,6 +74,16 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
     # -- read -------------------------------------------------------------
 
     def do_GET(self):
+        REQUEST_COUNTER.labels("volumeServer", "get").inc()
+        t0 = time.perf_counter()
+        try:
+            self._do_get()
+        finally:
+            REQUEST_HISTOGRAM.labels("volumeServer", "get").observe(
+                time.perf_counter() - t0
+            )
+
+    def _do_get(self):
         path = urllib.parse.urlparse(self.path)
         if path.path in ("/status", "/healthz"):
             return self._send_json(200, {"Version": "seaweedfs-tpu", **self.store.status()})
@@ -107,12 +142,24 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
     # -- write ------------------------------------------------------------
 
     def do_POST(self):
+        REQUEST_COUNTER.labels("volumeServer", "post").inc()
+        t0 = time.perf_counter()
+        try:
+            self._do_post()
+        finally:
+            REQUEST_HISTOGRAM.labels("volumeServer", "post").observe(
+                time.perf_counter() - t0
+            )
+
+    def _do_post(self):
         path = urllib.parse.urlparse(self.path)
         qs = urllib.parse.parse_qs(path.query)
         try:
             fid = FileId.parse(path.path.lstrip("/"))
         except ValueError:
             return self._send_json(400, {"error": "invalid file id"})
+        if not self._check_write_jwt(path.path.lstrip("/")):
+            return self._send_json(401, {"error": "missing or invalid jwt"})
         length = int(self.headers.get("Content-Length", 0))
         body = self.rfile.read(length)
         ctype = self.headers.get("Content-Type", "")
@@ -148,12 +195,30 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
     # -- delete -----------------------------------------------------------
 
     def do_DELETE(self):
+        REQUEST_COUNTER.labels("volumeServer", "delete").inc()
         path = urllib.parse.urlparse(self.path)
         qs = urllib.parse.parse_qs(path.query)
         try:
             fid = FileId.parse(path.path.lstrip("/"))
         except ValueError:
             return self._send_json(400, {"error": "invalid file id"})
+        if not self._check_write_jwt(path.path.lstrip("/")):
+            return self._send_json(401, {"error": "missing or invalid jwt"})
+        # EC volumes: tombstone + distributed fan-out to all shard holders
+        if (
+            self.store.find_volume(fid.volume_id) is None
+            and self.store.find_ec_volume(fid.volume_id) is not None
+        ):
+            try:
+                n = self.store.read_needle(fid.volume_id, fid.key)
+                if n.cookie != fid.cookie:
+                    return self._send_json(404, {"error": "cookie mismatch"})
+            except KeyError:
+                return self._send_json(404, {"error": "not found"})
+            size = self.volume_server.delete_ec_needle_distributed(
+                fid.volume_id, fid.key
+            )
+            return self._send_json(202, {"size": int(size)})
         try:
             n = self.store.read_needle(fid.volume_id, fid.key)
             if n.cookie != fid.cookie:
@@ -162,7 +227,9 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
         except KeyError:
             return self._send_json(404, {"error": "not found"})
         if "replicate" not in qs.get("type", []):
-            self.volume_server.replicate_delete(fid, self.path)
+            self.volume_server.replicate_delete(
+                fid, self.path, self.headers.get("Authorization") or ""
+            )
         self._send_json(202, {"size": int(size)})
 
 

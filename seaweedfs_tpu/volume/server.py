@@ -14,7 +14,14 @@ import grpc
 
 from ..pb import master_pb2
 from ..pb import rpc as rpclib
+from ..security import Guard
+from ..stats.metrics import (
+    DISK_SIZE_GAUGE,
+    VOLUME_GAUGE,
+    serve_metrics,
+)
 from ..storage.store import Store
+from ..util import glog
 from .grpc_handlers import VolumeGrpcService
 from .http_handlers import serve_http
 
@@ -34,6 +41,9 @@ class VolumeServer:
         codec_name: str = "cpu",
         pulse_seconds: float = 3.0,
         max_volume_count: int | None = None,
+        metrics_port: int = 0,
+        jwt_signing_key: bytes | str = b"",
+        whitelist: list[str] | None = None,
     ):
         self.ip = ip
         self.port = port
@@ -54,8 +64,15 @@ class VolumeServer:
                 loc.max_volume_count = max_volume_count
             self.store.max_volume_counts = {"": max_volume_count * len(self.store.locations)}
         self.current_leader: str | None = None
+        self.metrics_port = metrics_port
+        self.jwt_signing_key = (
+            jwt_signing_key.encode() if isinstance(jwt_signing_key, str)
+            else jwt_signing_key
+        )
+        self.guard = Guard(whitelist)
         self._stop = threading.Event()
         self._httpd = None
+        self._metricsd = None
         self._grpc_server = None
         self._hb_thread: threading.Thread | None = None
 
@@ -70,16 +87,53 @@ class VolumeServer:
         self._grpc_server = rpclib.serve(
             [(rpclib.VOLUME_SERVER, VolumeGrpcService(self))], self.grpc_port
         )
+        if self.metrics_port:
+            self._metricsd = serve_metrics(self.metrics_port)
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
         self._hb_thread.start()
+        glog.info("volume server started http=%d grpc=%d dirs=%s",
+                  self.port, self.grpc_port,
+                  ",".join(loc.directory for loc in self.store.locations))
 
     def stop(self) -> None:
         self._stop.set()
         if self._httpd:
             self._httpd.shutdown()
+        if self._metricsd:
+            self._metricsd.shutdown()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
         self.store.close()
+
+    def update_gauges(self) -> None:
+        """Refresh volume/EC gauges from the store (stats/metrics.go
+        volume counts incl. the ec_shards label)."""
+        by_collection: dict[str, int] = {}
+        ec_by_collection: dict[str, int] = {}
+        size_by_collection: dict[str, int] = {}
+        # zero every child first so deleted collections don't report stale
+        # values on later scrapes
+        for metric in (VOLUME_GAUGE, DISK_SIZE_GAUGE):
+            with metric._lock:
+                children = list(metric._children.values())
+            for child in children:
+                child.set(0)
+        for loc in self.store.locations:
+            for v in loc.volumes.values():
+                by_collection[v.collection] = by_collection.get(v.collection, 0) + 1
+                size_by_collection[v.collection] = (
+                    size_by_collection.get(v.collection, 0) + v.content_size()
+                )
+            for ev in loc.ec_volumes.values():
+                ec_by_collection[ev.collection] = (
+                    ec_by_collection.get(ev.collection, 0) + len(ev.shards)
+                )
+        for coll, n in by_collection.items():
+            VOLUME_GAUGE.labels(coll, "volume").set(n)
+        for coll, n in ec_by_collection.items():
+            VOLUME_GAUGE.labels(coll, "ec_shards").set(n)
+        for coll, n in size_by_collection.items():
+            DISK_SIZE_GAUGE.labels(coll, "normal").set(n)
 
     def stop_heartbeat(self) -> None:
         self._stop.set()
@@ -122,6 +176,7 @@ class VolumeServer:
                     )
                 if time.monotonic() - last_full >= self.pulse_seconds:
                     last_full = time.monotonic()
+                    self.update_gauges()
                     yield self.store.collect_heartbeat()
 
         for resp in stub.SendHeartbeat(requests()):
@@ -183,6 +238,41 @@ class VolumeServer:
 
         return fetch
 
+    def delete_ec_needle_distributed(self, vid: int, needle_id: int) -> int:
+        """Tombstone an EC needle locally, then fan VolumeEcBlobDelete out to
+        every other shard-holding server so the delete survives degraded
+        reads anywhere (store_ec_delete.go:15-33 + :35).  Returns the
+        needle's size from the local .ecx."""
+        from ..pb import volume_server_pb2 as vs
+
+        size = self.store.delete_ec_needle(vid, needle_id)
+        master = self.current_leader or self.master_addresses[0]
+        try:
+            resp = rpclib.master_stub(master, timeout=5).LookupEcVolume(
+                master_pb2.LookupEcVolumeRequest(volume_id=vid)
+            )
+        except grpc.RpcError:
+            return size
+        me = f"{self.ip}:{self.port}"
+        peers = {
+            loc.url
+            for e in resp.shard_id_locations
+            for loc in e.locations
+            if loc.url != me
+        }
+        for url in peers:
+            host, port = url.rsplit(":", 1)
+            grpc_addr = f"{host}:{int(port) + GRPC_PORT_OFFSET}"
+            try:
+                rpclib.volume_server_stub(grpc_addr, timeout=10).VolumeEcBlobDelete(
+                    vs.VolumeEcBlobDeleteRequest(
+                        volume_id=vid, file_key=needle_id
+                    )
+                )
+            except grpc.RpcError:
+                pass
+        return size
+
     def lookup_volume_url(self, vid: int) -> str | None:
         """Public URL of some server holding vid (for read redirects)."""
         master = self.current_leader or self.master_addresses[0]
@@ -228,6 +318,9 @@ class VolumeServer:
             ct = headers.get("Content-Type")
             if ct:
                 req.add_header("Content-Type", ct)
+            auth = headers.get("Authorization")
+            if auth:  # write jwt travels with the replica fan-out
+                req.add_header("Authorization", auth)
             try:
                 with urllib.request.urlopen(req, timeout=10) as r:
                     if r.status >= 300:
@@ -236,7 +329,7 @@ class VolumeServer:
                 return f"peer {peer}: {e}"
         return None
 
-    def replicate_delete(self, fid, path: str) -> None:
+    def replicate_delete(self, fid, path: str, auth: str = "") -> None:
         v = self.store.find_volume(fid.volume_id)
         if v is None or v.super_block.replica_placement.copy_count() <= 1:
             return
@@ -244,6 +337,8 @@ class VolumeServer:
         for peer in self.other_replica_locations(fid.volume_id):
             url = f"http://{peer}{path}{sep}type=replicate"
             req = urllib.request.Request(url, method="DELETE")
+            if auth:
+                req.add_header("Authorization", auth)
             try:
                 urllib.request.urlopen(req, timeout=10)
             except OSError:

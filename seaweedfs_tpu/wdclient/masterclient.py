@@ -26,6 +26,7 @@ class MasterClient:
         self.grpc_port = grpc_port
         self.vid_map = VidMap()
         self.current_master = ""
+        self._leader_hint = ""
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._connected = threading.Event()
@@ -49,8 +50,12 @@ class MasterClient:
     def _keep_connected_loop(self) -> None:
         i = 0
         while not self._stop.is_set():
-            master = self.masters[i % len(self.masters)]
-            i += 1
+            if self._leader_hint and self._leader_hint in self.masters:
+                master = self._leader_hint
+                self._leader_hint = ""
+            else:
+                master = self.masters[i % len(self.masters)]
+                i += 1
             try:
                 self._stream_from(master)
             except grpc.RpcError:
@@ -75,9 +80,14 @@ class MasterClient:
             self.current_master = master
             self._connected.set()
             self._apply(loc)
-            if loc.leader and not loc.leader.endswith(master.rsplit(":", 1)[1]):
-                # leader moved: reconnect there next round
-                pass
+            if loc.leader:
+                # leader hints carry the HTTP address; grpc = port + 10000
+                host, port = loc.leader.rsplit(":", 1)
+                leader_grpc = f"{host}:{int(port) + 10000}"
+                if leader_grpc != master and leader_grpc in self.masters:
+                    # leader moved: break the stream and reconnect there
+                    self._leader_hint = leader_grpc
+                    return
 
     def _apply(self, loc: master_pb2.VolumeLocation) -> None:
         location = Location(url=loc.url, public_url=loc.public_url or loc.url)

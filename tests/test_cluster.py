@@ -227,3 +227,40 @@ def test_shell_volume_list(cluster):
     env = CommandEnv(f"127.0.0.1:{master.grpc_port}")
     out = run_command(env, "volume.list")
     assert "rack" in out
+
+
+def test_ec_delete_fanout(cluster):
+    """Encode → HTTP DELETE on one holder → 404 from EVERY shard holder.
+
+    Reference behavior: store_ec_delete.go:15-33 fans VolumeEcBlobDelete to
+    all shard-holding servers so deleted EC blobs cannot resurrect from a
+    degraded read on another holder."""
+    master, servers = cluster
+    fids = []
+    for i in range(8):
+        a = _assign(master, collection="ecdel")
+        payload = (f"ecdel-{i}-".encode() * 100)[:900]
+        code, _ = _http("POST", f"http://{a['url']}/{a['fid']}", payload)
+        assert code == 201
+        fids.append(a["fid"])
+    vid = int(fids[0].split(",")[0])
+    env = CommandEnv(f"127.0.0.1:{master.grpc_port}")
+    run_command(env, f"ec.encode -volumeId={vid} -collection=ecdel")
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if len(master.topo.lookup_ec_shards(vid)) == 14:
+            break
+        time.sleep(0.2)
+    holders = [s for s in servers if s.store.find_ec_volume(vid)]
+    assert len(holders) >= 2, "shards should be spread across servers"
+    victim_fid = fids[0]
+    # delete through ONE holder's public HTTP surface
+    code, body = _http("DELETE", f"http://127.0.0.1:{holders[0].port}/{victim_fid}")
+    assert code == 202, body
+    # every holder answers 404 now (tombstone fanned out, no resurrection)
+    for s in holders:
+        code, _ = _http("GET", f"http://127.0.0.1:{s.port}/{victim_fid}")
+        assert code == 404, f"holder {s.port} still serves deleted EC needle"
+    # other needles still readable
+    code, _ = _http("GET", f"http://127.0.0.1:{holders[0].port}/{fids[1]}")
+    assert code == 200
