@@ -246,12 +246,13 @@ def test_ec_delete_fanout(cluster):
     vid = int(fids[0].split(",")[0])
     env = CommandEnv(f"127.0.0.1:{master.grpc_port}")
     run_command(env, f"ec.encode -volumeId={vid} -collection=ecdel")
-    deadline = time.time() + 15
+    deadline = time.time() + 30
+    holders = []
     while time.time() < deadline:
-        if len(master.topo.lookup_ec_shards(vid)) == 14:
+        holders = [s for s in servers if s.store.find_ec_volume(vid)]
+        if len(master.topo.lookup_ec_shards(vid)) == 14 and len(holders) >= 2:
             break
         time.sleep(0.2)
-    holders = [s for s in servers if s.store.find_ec_volume(vid)]
     assert len(holders) >= 2, "shards should be spread across servers"
     victim_fid = fids[0]
     # delete through ONE holder's public HTTP surface
