@@ -154,6 +154,29 @@ class ReedSolomonTPU:
         """(data_shards, B) uint8 on device -> (parity_shards, B) parity."""
         return _impl_fn(self._parity_rows, self.impl)(data)
 
+    def encode_device_u32(self, d32: jax.Array) -> jax.Array | None:
+        """(data_shards, B/4) uint32 -> (parity_shards, B/4) parity words.
+
+        Zero-relayout entry for bulk pipelines: the host views its uint8
+        buffers as little-endian uint32 (free) and the kernel works on packed
+        words directly — no device-side bitcast.  Returns None when the
+        active impl has no packed entry (caller falls back to uint8).
+        """
+        fn = _impl_fn(self._parity_rows, self.impl)
+        as_u32 = getattr(fn, "as_u32", None)
+        return None if as_u32 is None else as_u32(d32)
+
+    def encode_device_u32_3d(self, d3: jax.Array) -> jax.Array | None:
+        """(data_shards, R, 128) uint32 lane tiles -> (parity_shards, R, 128).
+
+        The zero-reshape bulk entry (rs_pallas apply32_3d): the jitted
+        program is exactly the kernel, so XLA cannot choose a transposed
+        parameter layout that pads the shard dim 10->128 in HBM.
+        """
+        fn = _impl_fn(self._parity_rows, self.impl)
+        as_3d = getattr(fn, "as_u32_3d", None)
+        return None if as_3d is None else as_3d(d3)
+
     def apply_rows_device(self, rows: np.ndarray, inputs: jax.Array) -> jax.Array:
         """Arbitrary GF matrix application (used for decode/rebuild)."""
         return apply_matrix(rows, inputs, self.impl)

@@ -94,21 +94,13 @@ def make_apply_pallas(
     kernel = functools.partial(_kernel_body, rows)
     word_bytes = LANES * BYTES_PER_LANE  # 512 bytes per (row of) lane tile
 
-    def _run(d32: jax.Array) -> jax.Array:
-        """(S, W) u32, W % LANES == 0 -> (n_out, W) u32."""
-        w = d32.shape[1]
-        rows_total = w // LANES
-        tile_rows = min(SUBLANES, rows_total)
-        grid = -(-rows_total // tile_rows)
-        if rows_total % tile_rows:
-            extra = grid * tile_rows - rows_total
-            d32 = jnp.pad(d32, ((0, 0), (0, extra * LANES)))
-            rows_total = grid * tile_rows
-        d3 = d32.reshape(s, rows_total, LANES)
-        out32 = pl.pallas_call(
+    def _call_tiles(d3: jax.Array, rows_total: int, tile_rows: int) -> jax.Array:
+        """(s, rows_total, LANES) u32, rows_total % tile_rows == 0 ->
+        (n_out, rows_total, LANES); the one place the pallas_call is built."""
+        return pl.pallas_call(
             kernel,
             out_shape=jax.ShapeDtypeStruct((n_out, rows_total, LANES), jnp.uint32),
-            grid=(grid,),
+            grid=(rows_total // tile_rows,),
             in_specs=[
                 pl.BlockSpec(
                     (s, tile_rows, LANES),
@@ -123,6 +115,19 @@ def make_apply_pallas(
             ),
             interpret=interpret,
         )(d3)
+
+    def _run(d32: jax.Array) -> jax.Array:
+        """(S, W) u32, W % LANES == 0 -> (n_out, W) u32."""
+        w = d32.shape[1]
+        rows_total = w // LANES
+        tile_rows = min(SUBLANES, rows_total)
+        grid = -(-rows_total // tile_rows)
+        if rows_total % tile_rows:
+            extra = grid * tile_rows - rows_total
+            d32 = jnp.pad(d32, ((0, 0), (0, extra * LANES)))
+            rows_total = grid * tile_rows
+        d3 = d32.reshape(s, rows_total, LANES)
+        out32 = _call_tiles(d3, rows_total, tile_rows)
         return out32.reshape(n_out, rows_total * LANES)[:, : w]
 
     @jax.jit
@@ -133,7 +138,12 @@ def make_apply_pallas(
         (free) and use this entry — no device-side bitcast/copy at all.
         """
         assert d32.dtype == jnp.uint32 and d32.shape[0] == s
-        return _run(d32)
+        w = d32.shape[1]
+        padded = -(-w // LANES) * LANES
+        if padded != w:
+            d32 = jnp.pad(d32, ((0, 0), (0, padded - w)))
+        out = _run(d32)
+        return out[:, :w] if padded != w else out
 
     @jax.jit
     def apply(data: jax.Array) -> jax.Array:
@@ -153,7 +163,24 @@ def make_apply_pallas(
         ).reshape(n_out, padded)
         return out[:, :b] if padded != b else out
 
+    @jax.jit
+    def apply32_3d(d3: jax.Array) -> jax.Array:
+        """(S, R, 128) u32 with R % min(SUBLANES, R) == 0 -> (n_out, R, 128).
+
+        The fully pre-packed entry: the host views bytes as uint32 and
+        reshapes to lane tiles itself, so the jitted program is EXACTLY the
+        pallas_call — no reshape/pad ops whose layout assignment could
+        materialise a transposed (shard-dim-minormost) copy in HBM.
+        """
+        assert d3.dtype == jnp.uint32 and d3.ndim == 3
+        assert d3.shape[0] == s and d3.shape[2] == LANES
+        rows_total = d3.shape[1]
+        tile_rows = min(SUBLANES, rows_total)
+        assert rows_total % tile_rows == 0, (rows_total, tile_rows)
+        return _call_tiles(d3, rows_total, tile_rows)
+
     apply.as_u32 = apply32  # type: ignore[attr-defined]
+    apply.as_u32_3d = apply32_3d  # type: ignore[attr-defined]
     return apply
 
 
