@@ -86,6 +86,20 @@ def cmd_filer(args) -> None:
     _wait()
 
 
+def cmd_s3(args) -> None:
+    from .s3api.server import S3ApiServer
+
+    s = S3ApiServer(
+        filer=args.filer,
+        port=args.port,
+        config_path=args.config,
+        domain=args.domainName,
+    )
+    s.start()
+    print(f"s3 gateway http={args.port} filer={args.filer}")
+    _wait()
+
+
 def cmd_shell(args) -> None:
     from .shell.commands import CommandEnv, run_command
 
@@ -201,6 +215,14 @@ def main(argv=None) -> None:
     f.add_argument("-maxMB", type=int, default=4)
     f.add_argument("-metricsPort", type=int, default=0)
     f.set_defaults(fn=cmd_filer)
+
+    s3p = sub.add_parser("s3")
+    s3p.add_argument("-filer", default="127.0.0.1:8888")
+    s3p.add_argument("-port", type=int, default=8333)
+    s3p.add_argument("-config", default="",
+                     help="s3 identities json (empty = auth disabled)")
+    s3p.add_argument("-domainName", default="")
+    s3p.set_defaults(fn=cmd_s3)
 
     sh = sub.add_parser("shell")
     sh.add_argument("-master", default="127.0.0.1:9333")

@@ -1,0 +1,11 @@
+"""S3-compatible gateway over the filer plane.
+
+Reference: weed/s3api/ (s3api_server.go:44 router, auth_signature_v4.go,
+filer_multipart.go).  Buckets are directories under /buckets/<name>;
+objects are filer entries; multipart uploads splice chunk lists without
+copying data.
+"""
+
+from .server import S3ApiServer
+
+__all__ = ["S3ApiServer"]

@@ -1,0 +1,441 @@
+"""S3 request authentication: AWS Signature V4 (header + presigned),
+legacy V2, and the aws-chunked streaming payload decoder.
+
+Reference behavior: weed/s3api/auth_signature_v4.go (canonical request /
+string-to-sign / signing-key chain, seed signature for streaming uploads),
+auth_signature_v2.go, and auth_credentials.go (identities + actions from
+the s3 config json; anonymous access when no identities are configured).
+
+Implemented from the public AWS SigV4 specification; the signing primitive
+is pinned against the documented AWS example vector in tests/test_s3.py.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import json
+import urllib.parse
+from dataclasses import dataclass, field
+
+ACTION_ADMIN = "Admin"
+ACTION_READ = "Read"
+ACTION_WRITE = "Write"
+ACTION_LIST = "List"
+ACTION_TAGGING = "Tagging"
+
+UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
+STREAMING_PAYLOAD = "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"
+
+_EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+class AuthError(Exception):
+    """Maps to an S3 error code + HTTP status."""
+
+    def __init__(self, code: str, message: str, status: int = 403):
+        super().__init__(message)
+        self.code = code
+        self.status = status
+
+
+# -- signing primitives ------------------------------------------------------
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def signing_key(secret: str, date: str, region: str, service: str) -> bytes:
+    """AWS4 signing-key derivation chain (date is YYYYMMDD)."""
+    k = _hmac(("AWS4" + secret).encode(), date)
+    k = _hmac(k, region)
+    k = _hmac(k, service)
+    return _hmac(k, "aws4_request")
+
+
+def _uri_encode(s: str, encode_slash: bool = True) -> str:
+    safe = "-._~" if encode_slash else "-._~/"
+    return urllib.parse.quote(s, safe=safe)
+
+
+def canonical_query(query: str, drop: set[str] = frozenset()) -> str:
+    """Sorted, URI-encoded query string (values re-encoded per the spec)."""
+    pairs = []
+    for part in query.split("&"):
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        k = urllib.parse.unquote_plus(k)
+        v = urllib.parse.unquote_plus(v)
+        if k in drop:
+            continue
+        pairs.append((_uri_encode(k), _uri_encode(v)))
+    pairs.sort()
+    return "&".join(f"{k}={v}" for k, v in pairs)
+
+
+def canonical_request(
+    method: str,
+    raw_path: str,
+    query: str,
+    headers: dict[str, str],
+    signed_headers: list[str],
+    payload_hash: str,
+    drop_query: set[str] = frozenset(),
+) -> str:
+    canon_headers = "".join(
+        f"{h}:{' '.join(headers.get(h, '').split())}\n" for h in signed_headers
+    )
+    return "\n".join(
+        [
+            method,
+            _uri_encode(urllib.parse.unquote(raw_path), encode_slash=False) or "/",
+            canonical_query(query, drop_query),
+            canon_headers,
+            ";".join(signed_headers),
+            payload_hash,
+        ]
+    )
+
+
+def string_to_sign(amz_date: str, scope: str, canon_req: str) -> str:
+    return "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amz_date,
+            scope,
+            hashlib.sha256(canon_req.encode()).hexdigest(),
+        ]
+    )
+
+
+def sign_v4(secret: str, date: str, region: str, service: str,
+            amz_date: str, canon_req: str) -> str:
+    scope = f"{date}/{region}/{service}/aws4_request"
+    sts = string_to_sign(amz_date, scope, canon_req)
+    return hmac.new(
+        signing_key(secret, date, region, service), sts.encode(), hashlib.sha256
+    ).hexdigest()
+
+
+# -- identities --------------------------------------------------------------
+
+
+@dataclass
+class Identity:
+    name: str
+    credentials: list[tuple[str, str]] = field(default_factory=list)
+    actions: list[str] = field(default_factory=list)
+
+    def secret_for(self, access_key: str) -> str | None:
+        for ak, sk in self.credentials:
+            if ak == access_key:
+                return sk
+        return None
+
+    def can_do(self, action: str, bucket: str) -> bool:
+        if ACTION_ADMIN in self.actions:
+            return True
+        for a in self.actions:
+            base, _, scope = a.partition(":")
+            if base != action:
+                continue
+            if not scope or scope == bucket:
+                return True
+        return False
+
+
+class IdentityAccessManagement:
+    """Access-key registry + per-request authentication/authorization.
+
+    When no identities are configured, every request is allowed (the
+    reference's behavior without an s3 config: auth disabled).
+    """
+
+    def __init__(self, config_path: str = "", domain: str = ""):
+        self.domain = domain
+        self.identities: list[Identity] = []
+        if config_path:
+            self.load_config_file(config_path)
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.identities)
+
+    def load_config_file(self, path: str) -> None:
+        with open(path) as f:
+            self.load_config(json.load(f))
+
+    def load_config(self, conf: dict) -> None:
+        self.identities = []
+        for ident in conf.get("identities", []):
+            self.identities.append(
+                Identity(
+                    name=ident.get("name", ""),
+                    credentials=[
+                        (c["accessKey"], c["secretKey"])
+                        for c in ident.get("credentials", [])
+                    ],
+                    actions=list(ident.get("actions", [])),
+                )
+            )
+
+    def lookup(self, access_key: str) -> tuple[Identity, str] | None:
+        for ident in self.identities:
+            secret = ident.secret_for(access_key)
+            if secret is not None:
+                return ident, secret
+        return None
+
+    # -- request authentication ---------------------------------------------
+
+    def authenticate(self, req: "S3HttpRequest") -> Identity | None:
+        """Raises AuthError on bad signatures; returns the Identity (or None
+        when auth is disabled / anonymous)."""
+        if not self.enabled:
+            return None
+        auth_header = req.headers.get("authorization", "")
+        if auth_header.startswith("AWS4-HMAC-SHA256"):
+            return self._auth_v4_header(req, auth_header)
+        if auth_header.startswith("AWS "):
+            return self._auth_v2_header(req, auth_header)
+        q = req.query_params
+        if q.get("X-Amz-Algorithm") == "AWS4-HMAC-SHA256":
+            return self._auth_v4_presigned(req)
+        if "Signature" in q and "AWSAccessKeyId" in q:
+            raise AuthError("AccessDenied", "presigned v2 not supported")
+        raise AuthError("AccessDenied", "no credentials provided")
+
+    def _auth_v4_header(self, req: "S3HttpRequest", header: str) -> Identity:
+        fields: dict[str, str] = {}
+        for item in header[len("AWS4-HMAC-SHA256"):].split(","):
+            k, _, v = item.strip().partition("=")
+            fields[k] = v
+        try:
+            cred_parts = fields["Credential"].split("/")
+            access_key, date, region, service, terminal = cred_parts
+            signed_headers = fields["SignedHeaders"].split(";")
+            got_sig = fields["Signature"]
+        except (KeyError, ValueError):
+            raise AuthError("AuthorizationHeaderMalformed", "bad v4 header")
+        if terminal != "aws4_request" or service != "s3":
+            raise AuthError("AuthorizationHeaderMalformed", "bad scope")
+        found = self.lookup(access_key)
+        if not found:
+            raise AuthError("InvalidAccessKeyId", f"unknown key {access_key}")
+        ident, secret = found
+        amz_date = req.headers.get("x-amz-date") or req.headers.get("date", "")
+        self._check_freshness(amz_date)
+        payload_hash = req.headers.get("x-amz-content-sha256") or _EMPTY_SHA256
+        canon = canonical_request(
+            req.method, req.raw_path, req.raw_query, req.headers,
+            signed_headers, payload_hash,
+        )
+        want = sign_v4(secret, date, region, "s3", amz_date, canon)
+        if not hmac.compare_digest(want, got_sig):
+            raise AuthError("SignatureDoesNotMatch",
+                            "the computed signature does not match")
+        req.seed_signature = got_sig
+        req.sig_date, req.sig_region, req.sig_secret = date, region, secret
+        req.sig_amz_date = amz_date
+        if len(payload_hash) == 64:
+            # a concrete content hash was signed: the body handler MUST
+            # verify it, or signed bodies are swappable in flight
+            req.expected_sha256 = payload_hash
+        return ident
+
+    @staticmethod
+    def _check_freshness(amz_date: str, window_s: int = 900) -> None:
+        """Reject requests whose signed timestamp is >15min from now —
+        bounds the replay window of a captured signed request."""
+        try:
+            t0 = datetime.datetime.strptime(
+                amz_date, "%Y%m%dT%H%M%SZ"
+            ).replace(tzinfo=datetime.timezone.utc)
+        except ValueError:
+            raise AuthError("AccessDenied", "bad x-amz-date")
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if abs((now - t0).total_seconds()) > window_s:
+            raise AuthError("RequestTimeTooSkewed",
+                            "request timestamp too far from server time")
+
+    def _auth_v4_presigned(self, req: "S3HttpRequest") -> Identity:
+        q = req.query_params
+        try:
+            access_key, date, region, service, terminal = q[
+                "X-Amz-Credential"
+            ].split("/")
+            signed_headers = q["X-Amz-SignedHeaders"].split(";")
+            got_sig = q["X-Amz-Signature"]
+            amz_date = q["X-Amz-Date"]
+            expires = int(q.get("X-Amz-Expires", "604800"))
+        except (KeyError, ValueError):
+            raise AuthError("AuthorizationQueryParametersError", "bad presign")
+        if terminal != "aws4_request" or service != "s3":
+            raise AuthError("AuthorizationQueryParametersError", "bad scope")
+        t0 = datetime.datetime.strptime(amz_date, "%Y%m%dT%H%M%SZ").replace(
+            tzinfo=datetime.timezone.utc
+        )
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if now > t0 + datetime.timedelta(seconds=expires):
+            raise AuthError("AccessDenied", "request has expired")
+        found = self.lookup(access_key)
+        if not found:
+            raise AuthError("InvalidAccessKeyId", f"unknown key {access_key}")
+        ident, secret = found
+        canon = canonical_request(
+            req.method, req.raw_path, req.raw_query, req.headers,
+            signed_headers, UNSIGNED_PAYLOAD,
+            drop_query={"X-Amz-Signature"},
+        )
+        want = sign_v4(secret, date, region, "s3", amz_date, canon)
+        if not hmac.compare_digest(want, got_sig):
+            raise AuthError("SignatureDoesNotMatch",
+                            "the computed signature does not match")
+        return ident
+
+    def _auth_v2_header(self, req: "S3HttpRequest", header: str) -> Identity:
+        try:
+            access_key, got_sig = header[len("AWS "):].split(":", 1)
+        except ValueError:
+            raise AuthError("AuthorizationHeaderMalformed", "bad v2 header")
+        found = self.lookup(access_key)
+        if not found:
+            raise AuthError("InvalidAccessKeyId", f"unknown key {access_key}")
+        ident, secret = found
+        sts = self._v2_string_to_sign(req)
+        want = hmac.new(secret.encode(), sts.encode(), hashlib.sha1).digest()
+        import base64
+
+        if not hmac.compare_digest(base64.b64encode(want).decode(), got_sig):
+            raise AuthError("SignatureDoesNotMatch", "v2 signature mismatch")
+        return ident
+
+    _V2_SUBRESOURCES = (
+        "acl", "delete", "lifecycle", "location", "logging", "notification",
+        "partNumber", "policy", "requestPayment", "tagging", "torrent",
+        "uploadId", "uploads", "versionId", "versioning", "versions",
+        "website",
+    )
+
+    def _v2_string_to_sign(self, req: "S3HttpRequest") -> str:
+        amz_headers = sorted(
+            (k, v) for k, v in req.headers.items() if k.startswith("x-amz-")
+        )
+        canon_amz = "".join(f"{k}:{v}\n" for k, v in amz_headers)
+        sub = [
+            f"{k}={v}" if v else k
+            for k, v in sorted(req.query_params.items())
+            if k in self._V2_SUBRESOURCES
+        ]
+        resource = urllib.parse.unquote(req.raw_path)
+        if sub:
+            resource += "?" + "&".join(sub)
+        return "\n".join(
+            [
+                req.method,
+                req.headers.get("content-md5", ""),
+                req.headers.get("content-type", ""),
+                req.headers.get("date", ""),
+                canon_amz + resource,
+            ]
+        )
+
+    # -- authorization -------------------------------------------------------
+
+    def authorize(self, ident: Identity | None, action: str, bucket: str) -> None:
+        if not self.enabled:
+            return
+        if ident is None or not ident.can_do(action, bucket):
+            raise AuthError("AccessDenied", f"not allowed to {action} {bucket}")
+
+
+@dataclass
+class S3HttpRequest:
+    """The subset of the HTTP request the authenticator needs.
+
+    headers keys must be lower-cased; raw_path/raw_query are as received
+    (still percent-encoded).
+    """
+
+    method: str
+    raw_path: str
+    raw_query: str
+    headers: dict[str, str]
+    seed_signature: str = ""
+    sig_date: str = ""
+    sig_region: str = ""
+    sig_secret: str = ""
+    sig_amz_date: str = ""
+    expected_sha256: str = ""  # signed content hash the body must match
+
+    @property
+    def query_params(self) -> dict[str, str]:
+        return {
+            k: v[0]
+            for k, v in urllib.parse.parse_qs(
+                self.raw_query, keep_blank_values=True
+            ).items()
+        }
+
+
+# -- aws-chunked streaming payload -------------------------------------------
+
+
+def decode_streaming_body(body: bytes, req: S3HttpRequest | None = None) -> bytes:
+    """Decode (and when req carries a seed signature, verify) an
+    aws-chunked body: hex-size;chunk-signature=sig CRLF data CRLF ...
+
+    Verification follows the spec: each chunk signature signs
+    AWS4-HMAC-SHA256-PAYLOAD / date / scope / prev-sig / sha256("") /
+    sha256(chunk-data), chained from the seed (header) signature.
+    """
+    out = bytearray()
+    pos = 0
+    prev_sig = req.seed_signature if req else ""
+    verify = bool(req and req.seed_signature and req.sig_secret)
+    while pos < len(body):
+        nl = body.find(b"\r\n", pos)
+        if nl < 0:
+            raise AuthError("IncompleteBody", "bad chunk header", status=400)
+        header = body[pos:nl].decode("latin-1")
+        size_hex, _, ext = header.partition(";")
+        try:
+            size = int(size_hex, 16)
+        except ValueError:
+            raise AuthError("IncompleteBody", "bad chunk size", status=400)
+        data = body[nl + 2 : nl + 2 + size]
+        if len(data) != size:
+            raise AuthError("IncompleteBody", "short chunk", status=400)
+        if verify:
+            sig = ""
+            for kv in ext.split(";"):
+                k, _, v = kv.partition("=")
+                if k == "chunk-signature":
+                    sig = v
+            scope = f"{req.sig_date}/{req.sig_region}/s3/aws4_request"
+            sts = "\n".join(
+                [
+                    "AWS4-HMAC-SHA256-PAYLOAD",
+                    req.sig_amz_date,
+                    scope,
+                    prev_sig,
+                    _EMPTY_SHA256,
+                    hashlib.sha256(bytes(data)).hexdigest(),
+                ]
+            )
+            want = hmac.new(
+                signing_key(req.sig_secret, req.sig_date, req.sig_region, "s3"),
+                sts.encode(),
+                hashlib.sha256,
+            ).hexdigest()
+            if not hmac.compare_digest(want, sig):
+                raise AuthError("SignatureDoesNotMatch", "bad chunk signature")
+            prev_sig = sig
+        out += data
+        pos = nl + 2 + size + 2  # skip trailing CRLF
+        if size == 0:
+            break
+    return bytes(out)

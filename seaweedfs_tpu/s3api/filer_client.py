@@ -1,0 +1,174 @@
+"""Gateway-side filer access: gRPC for metadata, filer HTTP for bytes.
+
+Reference shape: weed/s3api/s3api_handlers.go (WithFilerClient) +
+s3api_object_handlers.go putToFiler/proxy-to-filer — the s3 process keeps
+no object state of its own; everything lives in the filer.
+"""
+
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import grpc
+
+from ..pb import filer_pb2
+from ..pb import rpc as rpclib
+
+GRPC_PORT_OFFSET = 10000
+
+
+class FilerUnavailable(IOError):
+    """The filer could not be reached / errored — NOT a missing entry.
+
+    Callers must surface this as a 5xx, never as NoSuchKey: a sync client
+    that sees 404 for an outage will happily delete its local copies."""
+
+
+class FilerClient:
+    def __init__(self, filer_http_address: str):
+        self.http_address = filer_http_address
+        host, _, port = filer_http_address.partition(":")
+        self.grpc_address = f"{host}:{int(port) + GRPC_PORT_OFFSET}"
+
+    def stub(self, timeout: float = 30.0) -> rpclib.Stub:
+        return rpclib.filer_stub(self.grpc_address, timeout=timeout)
+
+    # -- metadata ------------------------------------------------------------
+
+    def find_entry(self, directory: str, name: str) -> filer_pb2.Entry | None:
+        try:
+            resp = self.stub().LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=directory, name=name
+                )
+            )
+            return resp.entry
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                return None
+            raise FilerUnavailable(f"filer lookup failed: {e.code()}")
+
+    def list_entries(
+        self,
+        directory: str,
+        prefix: str = "",
+        start_from: str = "",
+        inclusive: bool = False,
+        limit: int = 1024,
+    ) -> list[filer_pb2.Entry]:
+        try:
+            return [
+                r.entry
+                for r in self.stub(timeout=60).ListEntries(
+                    filer_pb2.ListEntriesRequest(
+                        directory=directory,
+                        prefix=prefix,
+                        start_from_file_name=start_from,
+                        inclusive_start_from=inclusive,
+                        limit=limit,
+                    )
+                )
+            ]
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                return []
+            raise FilerUnavailable(f"filer list failed: {e.code()}")
+
+    def create_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
+        resp = self.stub().CreateEntry(
+            filer_pb2.CreateEntryRequest(directory=directory, entry=entry)
+        )
+        if resp.error:
+            raise IOError(resp.error)
+
+    def update_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
+        self.stub().UpdateEntry(
+            filer_pb2.UpdateEntryRequest(directory=directory, entry=entry)
+        )
+
+    def mkdir(self, directory: str, name: str, mode: int = 0o777) -> None:
+        entry = filer_pb2.Entry(name=name, is_directory=True)
+        entry.attributes.file_mode = mode | 0o40000
+        entry.attributes.mtime = int(time.time())
+        entry.attributes.crtime = int(time.time())
+        self.create_entry(directory, entry)
+
+    def delete_entry(
+        self,
+        directory: str,
+        name: str,
+        is_delete_data: bool = True,
+        is_recursive: bool = False,
+    ) -> str:
+        try:
+            resp = self.stub(timeout=60).DeleteEntry(
+                filer_pb2.DeleteEntryRequest(
+                    directory=directory,
+                    name=name,
+                    is_delete_data=is_delete_data,
+                    is_recursive=is_recursive,
+                    ignore_recursive_error=True,
+                )
+            )
+            return resp.error
+        except Exception as e:
+            return str(e)
+
+    # -- bytes ---------------------------------------------------------------
+
+    def put_object(self, path: str, data: bytes, mime: str = "") -> None:
+        req = urllib.request.Request(
+            f"http://{self.http_address}{urllib.parse.quote(path)}",
+            data=data,
+            method="PUT",
+            headers={"Content-Type": mime or "application/octet-stream"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            r.read()
+
+    def put_object_stream(self, path: str, reader, length: int,
+                          mime: str = "") -> None:
+        """PUT from a file-like reader without buffering the whole body
+        (http.client streams objects that expose .read)."""
+        req = urllib.request.Request(
+            f"http://{self.http_address}{urllib.parse.quote(path)}",
+            data=reader,
+            method="PUT",
+            headers={
+                "Content-Type": mime or "application/octet-stream",
+                "Content-Length": str(length),
+            },
+        )
+        with urllib.request.urlopen(req, timeout=600) as r:
+            r.read()
+
+    def open_object(self, path: str, range_header: str = ""):
+        """Streaming GET: returns the live HTTP response (file-like with
+        .status/.headers) — caller must close it.  Raises HTTPError on
+        non-2xx so callers branch on .code."""
+        headers = {}
+        if range_header:
+            headers["Range"] = range_header
+        req = urllib.request.Request(
+            f"http://{self.http_address}{urllib.parse.quote(path)}",
+            headers=headers,
+        )
+        return urllib.request.urlopen(req, timeout=600)
+
+    def get_object(self, path: str, range_header: str = "") -> tuple[int, dict, bytes]:
+        """-> (status, headers, body); raises on network failure only."""
+        headers = {}
+        if range_header:
+            headers["Range"] = range_header
+        req = urllib.request.Request(
+            f"http://{self.http_address}{urllib.parse.quote(path)}",
+            headers=headers,
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return r.status, dict(r.headers), r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers), e.read()

@@ -1,0 +1,947 @@
+"""S3-compatible REST gateway backed by the filer.
+
+Reference: weed/s3api/s3api_server.go:44 (router), s3api_bucket_handlers.go,
+s3api_object_handlers.go (put/get proxy through the filer),
+s3api_objects_list_handlers.go (V1/V2 listing over the directory tree),
+filer_multipart.go (multipart complete = chunk-list splice, no data copy),
+s3api_object_tagging_handlers.go (tags in entry.extended).
+
+Buckets are directories under /buckets/<name>; object keys map to nested
+directories; multipart uploads stage parts under
+/buckets/<bucket>/.uploads/<uploadId>/.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+from email.utils import formatdate
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import shutil
+import urllib.error
+
+from ..pb import filer_pb2
+from .auth import (
+    ACTION_ADMIN,
+    ACTION_LIST,
+    ACTION_READ,
+    ACTION_TAGGING,
+    ACTION_WRITE,
+    STREAMING_PAYLOAD,
+    AuthError,
+    IdentityAccessManagement,
+    S3HttpRequest,
+    decode_streaming_body,
+)
+from .filer_client import FilerClient, FilerUnavailable
+
+XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+BUCKETS_DIR = "/buckets"
+UPLOADS_DIR = ".uploads"
+TAG_PREFIX = "Seaweed-X-Amz-Tagging-"
+META_PREFIX = "X-Amz-Meta-"
+ETAG_KEY = "Seaweed-ETag"
+OWNER_ID = "seaweedfs-tpu"
+MAX_DIR_PAGE = 10000
+
+
+class S3ApiServer:
+    def __init__(
+        self,
+        filer: str = "127.0.0.1:8888",
+        port: int = 8333,
+        config_path: str = "",
+        domain: str = "",
+    ):
+        self.port = port
+        self.client = FilerClient(filer)
+        self.iam = IdentityAccessManagement(config_path, domain)
+        self._httpd: ThreadingHTTPServer | None = None
+
+    def start(self) -> None:
+        from ..util import glog
+
+        handler = type("BoundS3Handler", (S3Handler,), {"s3": self})
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        glog.info("s3 gateway started port=%d filer=%s auth=%s",
+                  self.port, self.client.http_address, self.iam.enabled)
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+
+    # -- path helpers --------------------------------------------------------
+
+    def bucket_dir(self, bucket: str) -> str:
+        return f"{BUCKETS_DIR}/{bucket}"
+
+    def object_path(self, bucket: str, key: str) -> str:
+        return f"{BUCKETS_DIR}/{bucket}/{key}"
+
+
+# -- XML helpers --------------------------------------------------------------
+
+
+def _el(parent, tag: str, text: str | None = None):
+    e = ET.SubElement(parent, tag)
+    if text is not None:
+        e.text = text
+    return e
+
+
+def _xml_bytes(root: ET.Element) -> bytes:
+    return b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
+
+
+def _error_xml(code: str, message: str, resource: str) -> bytes:
+    root = ET.Element("Error")
+    _el(root, "Code", code)
+    _el(root, "Message", message)
+    _el(root, "Resource", resource)
+    _el(root, "RequestId", "")
+    return _xml_bytes(root)
+
+
+def _iso(ts: int) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts or 0))
+
+
+class S3Error(Exception):
+    def __init__(self, status: int, code: str, message: str):
+        super().__init__(message)
+        self.status = status
+        self.code = code
+
+
+NO_SUCH_BUCKET = ("NoSuchBucket", "the specified bucket does not exist", 404)
+NO_SUCH_KEY = ("NoSuchKey", "the specified key does not exist", 404)
+
+
+class _TeeReader:
+    """File-like over a source stream, limited to ``length`` bytes, feeding
+    md5 (the ETag) and sha256 (signed-payload verification) as it goes —
+    lets object bodies stream gateway-through without buffering."""
+
+    def __init__(self, src, length: int):
+        self.src = src
+        self.remaining = length
+        self.md5 = hashlib.md5()
+        self.sha = hashlib.sha256()
+
+    def read(self, n: int = -1) -> bytes:
+        if self.remaining <= 0:
+            return b""
+        n = self.remaining if n is None or n < 0 else min(n, self.remaining)
+        b = self.src.read(n)
+        self.remaining -= len(b)
+        self.md5.update(b)
+        self.sha.update(b)
+        return b
+
+
+class S3Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "seaweedfs-tpu-s3"
+    s3: S3ApiServer = None  # injected
+
+    def log_message(self, fmt, *args):
+        pass
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _send(self, status: int, body: bytes = b"",
+              content_type: str = "application/xml",
+              extra: dict | None = None):
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header("x-amz-request-id", "")
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        if body and self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _send_error(self, status: int, code: str, message: str):
+        self._send(status, _error_xml(code, message, self.path))
+
+    def _read_body(self) -> bytes:
+        te = (self.headers.get("Transfer-Encoding") or "").lower()
+        if "chunked" in te:
+            out = bytearray()
+            while True:
+                line = self.rfile.readline().strip()
+                size = int(line.split(b";")[0], 16)
+                if size == 0:
+                    self.rfile.readline()
+                    break
+                out += self.rfile.read(size)
+                self.rfile.read(2)
+            return bytes(out)
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def _route(self):
+        from ..stats.metrics import REQUEST_COUNTER
+
+        u = urllib.parse.urlsplit(self.path)
+        path = urllib.parse.unquote(u.path)
+        self.query = {
+            k: v[0]
+            for k, v in urllib.parse.parse_qs(
+                u.query, keep_blank_values=True
+            ).items()
+        }
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = parts[1] if len(parts) > 1 else ""
+        self.auth_req = S3HttpRequest(
+            method=self.command,
+            raw_path=u.path,
+            raw_query=u.query,
+            headers={k.lower(): v for k, v in self.headers.items()},
+        )
+        REQUEST_COUNTER.labels("s3", self.command.lower()).inc()
+        try:
+            self.identity = self.s3.iam.authenticate(self.auth_req)
+            self._dispatch(bucket, key)
+        except AuthError as e:
+            self._send(e.status, _error_xml(e.code, str(e), self.path))
+        except S3Error as e:
+            self._send_error(e.status, e.code, str(e))
+        except FilerUnavailable as e:
+            # never report an outage as NoSuchKey — sync clients would
+            # mirror the "deletion"
+            self._send_error(503, "ServiceUnavailable", str(e))
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # internal
+            self._send_error(500, "InternalError", f"{type(e).__name__}: {e}")
+
+    do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _route
+
+    def _authz(self, action: str, bucket: str) -> None:
+        self.s3.iam.authorize(self.identity, action, bucket)
+
+    def _dispatch(self, bucket: str, key: str) -> None:
+        m, q = self.command, self.query
+        if not bucket:
+            if m in ("GET", "HEAD"):
+                return self.list_buckets()
+            raise S3Error(405, "MethodNotAllowed", "bad root request")
+        if not key:
+            if m == "GET":
+                if "uploads" in q:
+                    return self.list_multipart_uploads(bucket)
+                if "location" in q:
+                    return self.bucket_location(bucket)
+                if "acl" in q:
+                    return self.canned_acl(bucket)
+                if "versioning" in q:
+                    return self.bucket_versioning(bucket)
+                if "lifecycle" in q:
+                    raise S3Error(404, "NoSuchLifecycleConfiguration",
+                                  "no lifecycle configured")
+                if "policy" in q:
+                    raise S3Error(404, "NoSuchBucketPolicy", "no policy")
+                if "tagging" in q:
+                    raise S3Error(404, "NoSuchTagSet", "no tags")
+                return self.list_objects(bucket, v2="list-type" in q)
+            if m == "HEAD":
+                return self.head_bucket(bucket)
+            if m == "PUT":
+                return self.put_bucket(bucket)
+            if m == "DELETE":
+                return self.delete_bucket(bucket)
+            if m == "POST":
+                if "delete" in q:
+                    return self.delete_multiple(bucket)
+                raise S3Error(501, "NotImplemented", "POST uploads unsupported")
+            raise S3Error(405, "MethodNotAllowed", m)
+        # object-level
+        if m == "GET":
+            if "uploadId" in q:
+                return self.list_parts(bucket, key)
+            if "tagging" in q:
+                return self.get_tagging(bucket, key)
+            if "acl" in q:
+                return self.canned_acl(bucket)
+            return self.get_object(bucket, key)
+        if m == "HEAD":
+            return self.head_object(bucket, key)
+        if m == "PUT":
+            if "partNumber" in q and "uploadId" in q:
+                return self.upload_part(bucket, key)
+            if "tagging" in q:
+                return self.put_tagging(bucket, key)
+            if "acl" in q:
+                self._authz(ACTION_WRITE, bucket)
+                return self._send(200)
+            if self.headers.get("x-amz-copy-source"):
+                return self.copy_object(bucket, key)
+            return self.put_object(bucket, key)
+        if m == "POST":
+            if "uploads" in q:
+                return self.create_multipart(bucket, key)
+            if "uploadId" in q:
+                return self.complete_multipart(bucket, key)
+            raise S3Error(501, "NotImplemented", "bad object POST")
+        if m == "DELETE":
+            if "uploadId" in q:
+                return self.abort_multipart(bucket, key)
+            if "tagging" in q:
+                return self.delete_tagging(bucket, key)
+            return self.delete_object(bucket, key)
+        raise S3Error(405, "MethodNotAllowed", m)
+
+    # -- service / bucket ----------------------------------------------------
+
+    def list_buckets(self):
+        client = self.s3.client
+        root = ET.Element("ListAllMyBucketsResult", xmlns=XMLNS)
+        owner = _el(root, "Owner")
+        _el(owner, "ID", OWNER_ID)
+        _el(owner, "DisplayName", OWNER_ID)
+        buckets = _el(root, "Buckets")
+        for e in client.list_entries(BUCKETS_DIR, limit=MAX_DIR_PAGE):
+            if not e.is_directory:
+                continue
+            if self.s3.iam.enabled and self.identity and not any(
+                self.identity.can_do(a, e.name)
+                for a in (ACTION_ADMIN, ACTION_READ, ACTION_LIST)
+            ):
+                continue
+            b = _el(buckets, "Bucket")
+            _el(b, "Name", e.name)
+            _el(b, "CreationDate", _iso(e.attributes.crtime))
+        self._send(200, _xml_bytes(root))
+
+    def _require_bucket(self, bucket: str) -> filer_pb2.Entry:
+        entry = self.s3.client.find_entry(BUCKETS_DIR, bucket)
+        if entry is None or not entry.is_directory:
+            raise S3Error(NO_SUCH_BUCKET[2], NO_SUCH_BUCKET[0], NO_SUCH_BUCKET[1])
+        return entry
+
+    def put_bucket(self, bucket: str):
+        self._authz(ACTION_ADMIN, bucket)
+        if self.s3.client.find_entry(BUCKETS_DIR, bucket) is not None:
+            raise S3Error(409, "BucketAlreadyExists", "duplicate bucket")
+        self.s3.client.mkdir(BUCKETS_DIR, bucket)
+        self._send(200, extra={"Location": f"/{bucket}"})
+
+    def delete_bucket(self, bucket: str):
+        self._authz(ACTION_ADMIN, bucket)
+        self._require_bucket(bucket)
+        entries = [
+            e for e in self.s3.client.list_entries(
+                self.s3.bucket_dir(bucket), limit=3
+            )
+            if e.name != UPLOADS_DIR
+        ]
+        if entries:
+            raise S3Error(409, "BucketNotEmpty", "the bucket is not empty")
+        err = self.s3.client.delete_entry(
+            BUCKETS_DIR, bucket, is_delete_data=True, is_recursive=True
+        )
+        if err:
+            raise S3Error(500, "InternalError", err)
+        self._send(204)
+
+    def head_bucket(self, bucket: str):
+        self._authz(ACTION_READ, bucket)
+        self._require_bucket(bucket)
+        self._send(200)
+
+    def bucket_location(self, bucket: str):
+        self._require_bucket(bucket)
+        root = ET.Element("LocationConstraint", xmlns=XMLNS)
+        self._send(200, _xml_bytes(root))
+
+    def bucket_versioning(self, bucket: str):
+        self._require_bucket(bucket)
+        self._send(200, _xml_bytes(ET.Element("VersioningConfiguration",
+                                              xmlns=XMLNS)))
+
+    def canned_acl(self, bucket: str):
+        self._authz(ACTION_READ, bucket)
+        root = ET.Element("AccessControlPolicy", xmlns=XMLNS)
+        owner = _el(root, "Owner")
+        _el(owner, "ID", OWNER_ID)
+        acl = _el(root, "AccessControlList")
+        grant = _el(acl, "Grant")
+        grantee = _el(grant, "Grantee")
+        grantee.set("xmlns:xsi", "http://www.w3.org/2001/XMLSchema-instance")
+        grantee.set("xsi:type", "CanonicalUser")
+        _el(grantee, "ID", OWNER_ID)
+        _el(grant, "Permission", "FULL_CONTROL")
+        self._send(200, _xml_bytes(root))
+
+    # -- listing -------------------------------------------------------------
+
+    def list_objects(self, bucket: str, v2: bool):
+        self._authz(ACTION_LIST, bucket)
+        self._require_bucket(bucket)
+        q = self.query
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = min(int(q.get("max-keys", "1000") or "1000"), 1000)
+        if v2:
+            marker = q.get("continuation-token") or q.get("start-after", "")
+        else:
+            marker = q.get("marker", "")
+        contents, prefixes, truncated, next_marker = self._list(
+            bucket, prefix, delimiter, marker, max_keys
+        )
+        tag = "ListBucketResult"
+        root = ET.Element(tag, xmlns=XMLNS)
+        _el(root, "Name", bucket)
+        _el(root, "Prefix", prefix)
+        if delimiter:
+            _el(root, "Delimiter", delimiter)
+        _el(root, "MaxKeys", str(max_keys))
+        _el(root, "IsTruncated", "true" if truncated else "false")
+        if v2:
+            _el(root, "KeyCount", str(len(contents)))
+            if truncated:
+                _el(root, "NextContinuationToken", next_marker)
+            if q.get("continuation-token"):
+                _el(root, "ContinuationToken", q["continuation-token"])
+        else:
+            _el(root, "Marker", marker)
+            if truncated and delimiter:
+                _el(root, "NextMarker", next_marker)
+        for key, entry in contents:
+            c = _el(root, "Contents")
+            _el(c, "Key", key)
+            _el(c, "LastModified", _iso(entry.attributes.mtime))
+            _el(c, "ETag", f'"{_entry_etag(entry)}"')
+            _el(c, "Size", str(_entry_size(entry)))
+            _el(c, "StorageClass", "STANDARD")
+            owner = _el(c, "Owner")
+            _el(owner, "ID", OWNER_ID)
+        for p in prefixes:
+            cp = _el(root, "CommonPrefixes")
+            _el(cp, "Prefix", p)
+        self._send(200, _xml_bytes(root))
+
+    def _list(self, bucket: str, prefix: str, delimiter: str,
+              marker: str, max_keys: int):
+        """-> (contents, common_prefixes, is_truncated, next_marker).
+
+        delimiter "/" lists one directory level (dirs -> CommonPrefixes);
+        empty delimiter walks the tree recursively in key order
+        (s3api_objects_list_handlers.go).
+        """
+        client = self.s3.client
+        base = self.s3.bucket_dir(bucket)
+        contents: list[tuple[str, filer_pb2.Entry]] = []
+        prefixes: list[str] = []
+
+        if delimiter == "/":
+            dir_part, _, name_prefix = prefix.rpartition("/")
+            directory = f"{base}/{dir_part}" if dir_part else base
+            start = ""
+            if marker.startswith(dir_part):
+                start = marker[len(dir_part):].lstrip("/").split("/", 1)[0]
+            entries = client.list_entries(
+                directory, prefix=name_prefix, start_from=start,
+                limit=max_keys + 2,
+            )
+            for e in entries:
+                if e.name == UPLOADS_DIR and not dir_part:
+                    continue
+                rel = f"{dir_part}/{e.name}" if dir_part else e.name
+                if rel <= marker.rstrip("/") and not e.is_directory:
+                    continue
+                if len(contents) + len(prefixes) >= max_keys:
+                    last = (contents[-1][0] if contents else "")
+                    lastp = prefixes[-1] if prefixes else ""
+                    return contents, prefixes, True, max(last, lastp)
+                if e.is_directory:
+                    if rel + "/" > marker:
+                        prefixes.append(rel + "/")
+                else:
+                    contents.append((rel, e))
+            return contents, prefixes, False, ""
+
+        # recursive walk (no delimiter, or a non-"/" delimiter grouped below)
+        truncated = [False]
+
+        def walk(directory: str, rel: str, after: str):
+            head = after.split("/", 1)[0] if after else ""
+            entries = client.list_entries(
+                directory, start_from=head, inclusive=True,
+                limit=MAX_DIR_PAGE,
+            )
+            for e in entries:
+                if e.name == UPLOADS_DIR and not rel:
+                    continue
+                key = f"{rel}{e.name}"
+                full_prefix = prefix
+                if e.is_directory:
+                    subtree = key + "/"
+                    # prune subtrees that cannot contain the prefix
+                    if not (subtree.startswith(full_prefix)
+                            or full_prefix.startswith(subtree)):
+                        continue
+                    sub_after = ""
+                    if head and e.name == head and "/" in after:
+                        sub_after = after.split("/", 1)[1]
+                    yield from walk(f"{directory}/{e.name}", subtree, sub_after)
+                else:
+                    if not key.startswith(full_prefix):
+                        continue
+                    if key <= marker:
+                        continue
+                    yield key, e
+
+        gen = walk(base, "", marker)
+        for key, e in gen:
+            if len(contents) >= max_keys:
+                truncated[0] = True
+                break
+            contents.append((key, e))
+        next_marker = contents[-1][0] if contents else ""
+        if delimiter and delimiter != "/":
+            grouped: dict[str, None] = {}
+            kept = []
+            for key, e in contents:
+                tail = key[len(prefix):]
+                if delimiter in tail:
+                    grouped[prefix + tail.split(delimiter, 1)[0] + delimiter] = None
+                else:
+                    kept.append((key, e))
+            contents, prefixes = kept, list(grouped)
+        return contents, prefixes, truncated[0], next_marker
+
+    # -- objects -------------------------------------------------------------
+
+    def _save_meta(self, directory: str, name: str, etag: str,
+                   extra: dict[str, str] | None = None):
+        client = self.s3.client
+        entry = client.find_entry(directory, name)
+        if entry is None:
+            # the object was just written; losing the ETag/meta silently
+            # would break client integrity checks later
+            raise S3Error(500, "InternalError",
+                          f"{directory}/{name} vanished after write")
+        entry.extended[ETAG_KEY] = etag.encode()
+        for hk, hv in self.headers.items():
+            if hk.lower().startswith("x-amz-meta-"):
+                entry.extended[META_PREFIX + hk[len("x-amz-meta-"):].lower()] = hv.encode()
+        for k, v in (extra or {}).items():
+            entry.extended[k] = v.encode()
+        client.update_entry(directory, entry)
+
+    def put_object(self, bucket: str, key: str):
+        self._authz(ACTION_WRITE, bucket)
+        self._require_bucket(bucket)
+        path = self.s3.object_path(bucket, key)
+        etag = self._put_body_to(path, self.headers.get("Content-Type", ""))
+        directory, name = path.rsplit("/", 1)
+        self._save_meta(directory, name, etag)
+        self._send(200, extra={"ETag": f'"{etag}"'})
+
+    def _put_body_to(self, path: str, mime: str = "") -> str:
+        """Write the request body to the filer, streaming when possible;
+        returns the content md5 (the ETag).  Verifies the signed
+        x-amz-content-sha256 — after upload on the streamed path (the
+        object is removed again on mismatch, like AWS rejects the write)."""
+        te = (self.headers.get("Transfer-Encoding") or "").lower()
+        aws_chunked = (
+            self.auth_req.headers.get("x-amz-content-sha256")
+            == STREAMING_PAYLOAD
+        )
+        expected = self.auth_req.expected_sha256
+        if "chunked" in te or aws_chunked:
+            body = self._read_body()
+            if aws_chunked:
+                body = decode_streaming_body(body, self.auth_req)
+            if expected and hashlib.sha256(body).hexdigest() != expected:
+                raise AuthError("XAmzContentSHA256Mismatch",
+                                "payload hash mismatch", status=400)
+            self.s3.client.put_object(path, body, mime=mime)
+            return hashlib.md5(body).hexdigest()
+        length = int(self.headers.get("Content-Length") or 0)
+        reader = _TeeReader(self.rfile, length)
+        self.s3.client.put_object_stream(path, reader, length, mime=mime)
+        if expected and reader.sha.hexdigest() != expected:
+            directory, name = path.rsplit("/", 1)
+            self.s3.client.delete_entry(directory, name, is_delete_data=True)
+            raise AuthError("XAmzContentSHA256Mismatch",
+                            "payload hash mismatch", status=400)
+        return reader.md5.hexdigest()
+
+    def _find_object(self, bucket: str, key: str) -> filer_pb2.Entry:
+        path = self.s3.object_path(bucket, key)
+        directory, name = path.rsplit("/", 1)
+        entry = self.s3.client.find_entry(directory, name)
+        if entry is None or entry.is_directory:
+            raise S3Error(NO_SUCH_KEY[2], NO_SUCH_KEY[0], NO_SUCH_KEY[1])
+        return entry
+
+    def _object_headers(self, entry: filer_pb2.Entry) -> dict:
+        h = {
+            "ETag": f'"{_entry_etag(entry)}"',
+            "Last-Modified": formatdate(entry.attributes.mtime, usegmt=True),
+            "Accept-Ranges": "bytes",
+        }
+        for k, v in entry.extended.items():
+            if k.startswith(META_PREFIX):
+                h["x-amz-meta-" + k[len(META_PREFIX):]] = v.decode()
+        return h
+
+    def get_object(self, bucket: str, key: str):
+        self._authz(ACTION_READ, bucket)
+        entry = self._find_object(bucket, key)
+        try:
+            resp = self.s3.client.open_object(
+                self.s3.object_path(bucket, key),
+                range_header=self.headers.get("Range", ""),
+            )
+        except urllib.error.HTTPError as e:
+            e.read()
+            raise S3Error(e.code, "InvalidRange" if e.code == 416 else
+                          "InternalError", "read failed")
+        with resp:
+            self.send_response(resp.status)
+            self.send_header(
+                "Content-Type",
+                entry.attributes.mime
+                or resp.headers.get("Content-Type", "application/octet-stream"),
+            )
+            self.send_header("Content-Length",
+                             resp.headers.get("Content-Length", "0"))
+            if resp.headers.get("Content-Range"):
+                self.send_header("Content-Range", resp.headers["Content-Range"])
+            for k, v in self._object_headers(entry).items():
+                self.send_header(k, v)
+            self.send_header("x-amz-request-id", "")
+            self.end_headers()
+            # stream filer -> client; no gateway-side buffering
+            shutil.copyfileobj(resp, self.wfile, 256 * 1024)
+
+    def head_object(self, bucket: str, key: str):
+        self._authz(ACTION_READ, bucket)
+        entry = self._find_object(bucket, key)
+        extra = self._object_headers(entry)
+        extra["Content-Length"] = str(_entry_size(entry))
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         entry.attributes.mime or "application/octet-stream")
+        for k, v in extra.items():
+            self.send_header(k, v)
+        self.end_headers()
+
+    def delete_object(self, bucket: str, key: str):
+        self._authz(ACTION_WRITE, bucket)
+        path = self.s3.object_path(bucket, key)
+        directory, name = path.rsplit("/", 1)
+        self.s3.client.delete_entry(directory, name, is_delete_data=True,
+                                    is_recursive=True)
+        self._send(204)
+
+    def delete_multiple(self, bucket: str):
+        self._authz(ACTION_WRITE, bucket)
+        self._require_bucket(bucket)
+        body = self._read_body()
+        try:
+            tree = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error(400, "MalformedXML", "bad delete request")
+        quiet = (
+            tree.findtext("Quiet") or tree.findtext(f"{{{XMLNS}}}Quiet") or ""
+        ).lower() == "true"
+        root = ET.Element("DeleteResult", xmlns=XMLNS)
+        for obj in tree.iter():
+            if not obj.tag.endswith("Object"):
+                continue
+            key = obj.findtext("Key") or obj.findtext(
+                f"{{{XMLNS}}}Key"
+            )
+            if not key:
+                continue
+            path = self.s3.object_path(bucket, key)
+            directory, name = path.rsplit("/", 1)
+            err = self.s3.client.delete_entry(
+                directory, name, is_delete_data=True, is_recursive=True
+            )
+            if err and "not found" not in err:
+                e = _el(root, "Error")
+                _el(e, "Key", key)
+                _el(e, "Code", "InternalError")
+                _el(e, "Message", err)
+            elif not quiet:
+                d = _el(root, "Deleted")
+                _el(d, "Key", key)
+        self._send(200, _xml_bytes(root))
+
+    def copy_object(self, bucket: str, key: str):
+        self._authz(ACTION_WRITE, bucket)
+        src = urllib.parse.unquote(self.headers["x-amz-copy-source"])
+        src_bucket, _, src_key = src.lstrip("/").partition("/")
+        self._authz(ACTION_READ, src_bucket)
+        src_entry = self._find_object(src_bucket, src_key)
+        dst = self.s3.object_path(bucket, key)
+        try:
+            resp = self.s3.client.open_object(
+                self.s3.object_path(src_bucket, src_key)
+            )
+        except urllib.error.HTTPError as e:
+            e.read()
+            raise S3Error(e.code, "NoSuchKey", "source unreadable")
+        with resp:  # stream source -> destination through the gateway
+            length = int(resp.headers.get("Content-Length") or 0)
+            reader = _TeeReader(resp, length)
+            self.s3.client.put_object_stream(
+                dst, reader, length, mime=src_entry.attributes.mime
+            )
+        etag = reader.md5.hexdigest()
+        directory, name = dst.rsplit("/", 1)
+        meta = {
+            k: v.decode()
+            for k, v in src_entry.extended.items()
+            if k.startswith(META_PREFIX)
+        }
+        self._save_meta(directory, name, etag, extra=meta)
+        root = ET.Element("CopyObjectResult", xmlns=XMLNS)
+        _el(root, "ETag", f'"{etag}"')
+        _el(root, "LastModified", _iso(int(time.time())))
+        self._send(200, _xml_bytes(root))
+
+    # -- multipart -----------------------------------------------------------
+
+    def _uploads_dir(self, bucket: str) -> str:
+        return f"{self.s3.bucket_dir(bucket)}/{UPLOADS_DIR}"
+
+    def create_multipart(self, bucket: str, key: str):
+        self._authz(ACTION_WRITE, bucket)
+        self._require_bucket(bucket)
+        upload_id = os.urandom(16).hex()
+        client = self.s3.client
+        if client.find_entry(self.s3.bucket_dir(bucket), UPLOADS_DIR) is None:
+            client.mkdir(self.s3.bucket_dir(bucket), UPLOADS_DIR)
+        entry = filer_pb2.Entry(name=upload_id, is_directory=True)
+        entry.attributes.file_mode = 0o40777
+        entry.attributes.mtime = int(time.time())
+        entry.extended["key"] = key.encode()
+        entry.extended["Content-Type"] = (
+            self.headers.get("Content-Type") or ""
+        ).encode()
+        for hk, hv in self.headers.items():
+            if hk.lower().startswith("x-amz-meta-"):
+                entry.extended[META_PREFIX + hk[len("x-amz-meta-"):].lower()] = hv.encode()
+        client.create_entry(self._uploads_dir(bucket), entry)
+        root = ET.Element("InitiateMultipartUploadResult", xmlns=XMLNS)
+        _el(root, "Bucket", bucket)
+        _el(root, "Key", key)
+        _el(root, "UploadId", upload_id)
+        self._send(200, _xml_bytes(root))
+
+    def _upload_entry(self, bucket: str, upload_id: str) -> filer_pb2.Entry:
+        entry = self.s3.client.find_entry(self._uploads_dir(bucket), upload_id)
+        if entry is None:
+            raise S3Error(404, "NoSuchUpload", "upload id not found")
+        return entry
+
+    def upload_part(self, bucket: str, key: str):
+        self._authz(ACTION_WRITE, bucket)
+        upload_id = self.query["uploadId"]
+        part_num = int(self.query["partNumber"])
+        self._upload_entry(bucket, upload_id)
+        part_name = f"{part_num:04d}.part"
+        path = f"{self._uploads_dir(bucket)}/{upload_id}/{part_name}"
+        etag = self._put_body_to(path)
+        directory, name = path.rsplit("/", 1)
+        self._save_meta(directory, name, etag)
+        self._send(200, extra={"ETag": f'"{etag}"'})
+
+    def complete_multipart(self, bucket: str, key: str):
+        self._authz(ACTION_WRITE, bucket)
+        upload_id = self.query["uploadId"]
+        upload_entry = self._upload_entry(bucket, upload_id)
+        body = self._read_body()
+        wanted: list[tuple[int, str]] = []
+        if body:
+            try:
+                tree = ET.fromstring(body)
+                for part in tree.iter():
+                    if not part.tag.endswith("Part"):
+                        continue
+                    num = part.findtext("PartNumber") or part.findtext(
+                        f"{{{XMLNS}}}PartNumber"
+                    )
+                    tag = part.findtext("ETag") or part.findtext(
+                        f"{{{XMLNS}}}ETag"
+                    ) or ""
+                    wanted.append((int(num), tag.strip('"')))
+            except ET.ParseError:
+                raise S3Error(400, "MalformedXML", "bad complete request")
+        updir = f"{self._uploads_dir(bucket)}/{upload_id}"
+        parts = {
+            int(e.name.split(".", 1)[0]): e
+            for e in self.s3.client.list_entries(updir, limit=MAX_DIR_PAGE)
+            if e.name.endswith(".part")
+        }
+        if not wanted:
+            wanted = [(n, "") for n in sorted(parts)]
+        chunks: list[filer_pb2.FileChunk] = []
+        offset = 0
+        digests = b""
+        for num, want_etag in sorted(wanted):
+            part = parts.get(num)
+            if part is None:
+                raise S3Error(400, "InvalidPart", f"part {num} missing")
+            etag = _entry_etag(part)
+            if want_etag and etag != want_etag:
+                raise S3Error(400, "InvalidPart", f"part {num} etag mismatch")
+            digests += bytes.fromhex(etag) if len(etag) == 32 else b""
+            for c in part.chunks:
+                nc = filer_pb2.FileChunk()
+                nc.CopyFrom(c)
+                nc.offset = offset + c.offset
+                chunks.append(nc)
+            offset += _entry_size(part)
+        final_etag = f"{hashlib.md5(digests).hexdigest()}-{len(wanted)}"
+        path = self.s3.object_path(bucket, key)
+        directory, name = path.rsplit("/", 1)
+        entry = filer_pb2.Entry(name=name)
+        entry.chunks.extend(chunks)
+        entry.attributes.file_size = offset
+        entry.attributes.mime = (
+            upload_entry.extended.get("Content-Type", b"").decode()
+        )
+        entry.attributes.mtime = int(time.time())
+        entry.attributes.crtime = int(time.time())
+        entry.attributes.file_mode = 0o644
+        entry.extended[ETAG_KEY] = final_etag.encode()
+        for k, v in upload_entry.extended.items():
+            if k.startswith(META_PREFIX):
+                entry.extended[k] = v
+        # the filer's create_entry mkdir -p's the ancestor chain
+        self.s3.client.create_entry(directory, entry)
+        # parts' chunks now belong to the object: delete metadata only
+        self.s3.client.delete_entry(
+            self._uploads_dir(bucket), upload_id,
+            is_delete_data=False, is_recursive=True,
+        )
+        root = ET.Element("CompleteMultipartUploadResult", xmlns=XMLNS)
+        _el(root, "Location", f"/{bucket}/{key}")
+        _el(root, "Bucket", bucket)
+        _el(root, "Key", key)
+        _el(root, "ETag", f'"{final_etag}"')
+        self._send(200, _xml_bytes(root))
+
+    def abort_multipart(self, bucket: str, key: str):
+        self._authz(ACTION_WRITE, bucket)
+        upload_id = self.query["uploadId"]
+        self.s3.client.delete_entry(
+            self._uploads_dir(bucket), upload_id,
+            is_delete_data=True, is_recursive=True,
+        )
+        self._send(204)
+
+    def list_multipart_uploads(self, bucket: str):
+        self._authz(ACTION_LIST, bucket)
+        self._require_bucket(bucket)
+        root = ET.Element("ListMultipartUploadsResult", xmlns=XMLNS)
+        _el(root, "Bucket", bucket)
+        _el(root, "IsTruncated", "false")
+        for e in self.s3.client.list_entries(self._uploads_dir(bucket),
+                                             limit=MAX_DIR_PAGE):
+            if not e.is_directory:
+                continue
+            u = _el(root, "Upload")
+            _el(u, "Key", e.extended.get("key", b"").decode())
+            _el(u, "UploadId", e.name)
+            _el(u, "Initiated", _iso(e.attributes.mtime))
+        self._send(200, _xml_bytes(root))
+
+    def list_parts(self, bucket: str, key: str):
+        self._authz(ACTION_LIST, bucket)
+        upload_id = self.query["uploadId"]
+        self._upload_entry(bucket, upload_id)
+        updir = f"{self._uploads_dir(bucket)}/{upload_id}"
+        root = ET.Element("ListPartsResult", xmlns=XMLNS)
+        _el(root, "Bucket", bucket)
+        _el(root, "Key", key)
+        _el(root, "UploadId", upload_id)
+        _el(root, "IsTruncated", "false")
+        for e in self.s3.client.list_entries(updir, limit=MAX_DIR_PAGE):
+            if not e.name.endswith(".part"):
+                continue
+            p = _el(root, "Part")
+            _el(p, "PartNumber", str(int(e.name.split(".", 1)[0])))
+            _el(p, "LastModified", _iso(e.attributes.mtime))
+            _el(p, "ETag", f'"{_entry_etag(e)}"')
+            _el(p, "Size", str(_entry_size(e)))
+        self._send(200, _xml_bytes(root))
+
+    # -- tagging -------------------------------------------------------------
+
+    def put_tagging(self, bucket: str, key: str):
+        self._authz(ACTION_TAGGING, bucket)
+        entry = self._find_object(bucket, key)
+        try:
+            tree = ET.fromstring(self._read_body())
+        except ET.ParseError:
+            raise S3Error(400, "MalformedXML", "bad tagging request")
+        for k in list(entry.extended):
+            if k.startswith(TAG_PREFIX):
+                del entry.extended[k]
+        for tag in tree.iter():
+            if not tag.tag.endswith("Tag"):
+                continue
+            k = tag.findtext("Key") or tag.findtext(f"{{{XMLNS}}}Key")
+            v = tag.findtext("Value") or tag.findtext(f"{{{XMLNS}}}Value") or ""
+            if k:
+                entry.extended[TAG_PREFIX + k] = v.encode()
+        directory, _ = self.s3.object_path(bucket, key).rsplit("/", 1)
+        self.s3.client.update_entry(directory, entry)
+        self._send(200)
+
+    def get_tagging(self, bucket: str, key: str):
+        self._authz(ACTION_READ, bucket)
+        entry = self._find_object(bucket, key)
+        root = ET.Element("Tagging", xmlns=XMLNS)
+        tagset = _el(root, "TagSet")
+        for k, v in entry.extended.items():
+            if k.startswith(TAG_PREFIX):
+                t = _el(tagset, "Tag")
+                _el(t, "Key", k[len(TAG_PREFIX):])
+                _el(t, "Value", v.decode())
+        self._send(200, _xml_bytes(root))
+
+    def delete_tagging(self, bucket: str, key: str):
+        self._authz(ACTION_TAGGING, bucket)
+        entry = self._find_object(bucket, key)
+        for k in list(entry.extended):
+            if k.startswith(TAG_PREFIX):
+                del entry.extended[k]
+        directory, _ = self.s3.object_path(bucket, key).rsplit("/", 1)
+        self.s3.client.update_entry(directory, entry)
+        self._send(204)
+
+
+# -- entry helpers ------------------------------------------------------------
+
+
+def _entry_size(entry: filer_pb2.Entry) -> int:
+    size = 0
+    for c in entry.chunks:
+        size = max(size, c.offset + c.size)
+    return size or entry.attributes.file_size or len(entry.content)
+
+
+def _entry_etag(entry: filer_pb2.Entry) -> str:
+    stored = entry.extended.get(ETAG_KEY)
+    if stored:
+        return stored.decode()
+    ids = ",".join(c.file_id for c in entry.chunks)
+    return hashlib.md5(ids.encode()).hexdigest()

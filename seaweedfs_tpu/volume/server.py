@@ -122,7 +122,7 @@ class VolumeServer:
             for v in loc.volumes.values():
                 by_collection[v.collection] = by_collection.get(v.collection, 0) + 1
                 size_by_collection[v.collection] = (
-                    size_by_collection.get(v.collection, 0) + v.content_size()
+                    size_by_collection.get(v.collection, 0) + v.content_size
                 )
             for ev in loc.ec_volumes.values():
                 ec_by_collection[ev.collection] = (
