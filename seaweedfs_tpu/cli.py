@@ -23,6 +23,8 @@ def cmd_master(args) -> None:
         maintenance_interval=args.maintenanceInterval,
         metrics_port=args.metricsPort,
         jwt_signing_key=args.jwtKey,
+        peers=args.peers.split(",") if args.peers else None,
+        raft_state_dir=args.raftDir,
     )
     m.start()
     print(f"master listening http={args.port} grpc={m.grpc_port}")
@@ -182,6 +184,10 @@ def main(argv=None) -> None:
     m.add_argument("-maintenanceInterval", type=float, default=0.0)
     m.add_argument("-metricsPort", type=int, default=0)
     m.add_argument("-jwtKey", default="")
+    m.add_argument("-peers", default="",
+                   help="comma-separated master quorum ip:port list (raft)")
+    m.add_argument("-raftDir", default=".",
+                   help="directory for persisted raft state")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume")

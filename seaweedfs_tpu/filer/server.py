@@ -78,8 +78,10 @@ class FilerServer:
         self.master_client.stop()
         if self._httpd:
             self._httpd.shutdown()
+            self._httpd.server_close()
         if getattr(self, "_metricsd", None):
             self._metricsd.shutdown()
+            self._metricsd.server_close()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
         self.filer.close()

@@ -22,9 +22,26 @@ class MasterGrpcService:
         self.master = master  # MasterServer
         self.topo = master.topo
 
+    def _require_leader(self, context) -> None:
+        """Followers refuse stateful rpcs; the error names the leader so
+        clients re-aim (master_grpc_server.go leader checks)."""
+        if not self.master.is_leader():
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"not the leader; leader is {self.master.leader_grpc()}",
+            )
+
     # -- heartbeat ingest (bidi) -----------------------------------------
 
     def SendHeartbeat(self, request_iterator, context):
+        if not self.master.is_leader():
+            # answer once with the leader hint, then end the stream — the
+            # volume server reconnects there (volume_grpc_client_to_master)
+            yield master_pb2.HeartbeatResponse(
+                leader=self.master.leader(),
+                leader_grpc=self.master.leader_grpc(),
+            )
+            return
         node: DataNode | None = None
         try:
             for hb in request_iterator:
@@ -74,6 +91,10 @@ class MasterGrpcService:
     # -- location pub/sub -------------------------------------------------
 
     def KeepConnected(self, request_iterator, context):
+        if not self.master.is_leader():
+            # one leader-hint message, then end: clients re-subscribe there
+            yield master_pb2.VolumeLocation(leader=self.master.leader())
+            return
         q: queue.Queue = queue.Queue()
         self.master.subscribe(q)
         try:
@@ -90,6 +111,13 @@ class MasterGrpcService:
                         data_center=n.data_center,
                     )
             while context.is_active():
+                if not self.master.is_leader():
+                    # deposed mid-stream: hand subscribers the new leader
+                    # and end, or they'd sit on a silent queue forever
+                    yield master_pb2.VolumeLocation(
+                        leader=self.master.leader()
+                    )
+                    return
                 try:
                     loc = q.get(timeout=1.0)
                 except queue.Empty:
@@ -101,6 +129,7 @@ class MasterGrpcService:
     # -- assign / lookup --------------------------------------------------
 
     def Assign(self, request, context):
+        self._require_leader(context)
         try:
             fid, url, public_url, count = self.master.assign(
                 count=max(int(request.count), 1),
@@ -118,6 +147,7 @@ class MasterGrpcService:
         )
 
     def LookupVolume(self, request, context):
+        self._require_leader(context)
         resp = master_pb2.LookupVolumeResponse()
         for vof in request.volume_or_file_ids:
             entry = resp.volume_id_locations.add(volume_or_file_id=vof)
@@ -135,6 +165,7 @@ class MasterGrpcService:
         return resp
 
     def LookupEcVolume(self, request, context):
+        self._require_leader(context)
         shard_map = self.topo.lookup_ec_shards(request.volume_id)
         if not shard_map:
             context.abort(
@@ -178,6 +209,7 @@ class MasterGrpcService:
         return resp
 
     def CollectionDelete(self, request, context):
+        self._require_leader(context)
         from ..pb import rpc as rpclib
         from ..pb import volume_server_pb2 as vs
 
@@ -203,12 +235,14 @@ class MasterGrpcService:
         return master_pb2.ListMasterClientsResponse()
 
     def VacuumVolume(self, request, context):
+        self._require_leader(context)
         self.master.vacuum(request.garbage_threshold or 0.3)
         return master_pb2.VacuumVolumeResponse()
 
     # -- admin lock -------------------------------------------------------
 
     def LeaseAdminToken(self, request, context):
+        self._require_leader(context)
         token = self.master.lease_admin_token(
             request.lock_name, request.previous_token
         )

@@ -1,0 +1,438 @@
+"""Raft consensus for the master quorum.
+
+Reference: weed/server/raft_server.go:21-46 (chrislusf/raft over the master
+HTTP port, state machine = MaxVolumeId only) and topology/cluster_commands.go
+(the MaxVolumeIdCommand).  Re-implemented from the Raft paper rather than
+ported: leader election with randomized timeouts, log replication with the
+commit-only-current-term rule, and the election restriction on log
+up-to-dateness.  The applied state is a small key->int map (op "max_vid"),
+so the log stays tiny (one entry per volume growth) and no snapshot/
+InstallSnapshot machinery is needed at master scale.
+
+Transport is pluggable: tests inject an in-memory send function; the
+MasterServer wires an HTTP JSON POST to each peer's /cluster/raft endpoint
+(the reference also multiplexes raft onto the master HTTP listener).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+@dataclass
+class LogEntry:
+    term: int
+    command: dict
+
+    def to_json(self) -> dict:
+        return {"term": self.term, "command": self.command}
+
+    @staticmethod
+    def from_json(d: dict) -> "LogEntry":
+        return LogEntry(term=d["term"], command=d["command"])
+
+
+@dataclass
+class Progress:
+    next_index: int = 1
+    match_index: int = 0
+
+
+class RaftNode:
+    """One consensus participant.  Thread-safe; all RPC handlers are pure
+    state transitions under the node lock; timers run in daemon threads.
+
+    ``send(peer_id, message: dict) -> dict | None`` is the transport;
+    ``apply_fn(command: dict)`` is called exactly once per committed entry,
+    in log order, on every node.
+    """
+
+    def __init__(
+        self,
+        node_id: str,
+        peers: list[str],
+        send,
+        apply_fn=None,
+        state_path: str = "",
+        election_timeout: tuple[float, float] = (0.4, 0.8),
+        heartbeat_interval: float = 0.12,
+    ):
+        self.id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.send = send
+        self.apply_fn = apply_fn or (lambda cmd: None)
+        self.state_path = state_path
+
+        self.lock = threading.RLock()
+        self.term = 0
+        self.voted_for: str | None = None
+        self.log: list[LogEntry] = []  # log[i] has index i+1
+        self.commit_index = 0
+        self.last_applied = 0
+        self.role = FOLLOWER
+        self.leader_id: str | None = None
+        self.progress: dict[str, Progress] = {}
+        self.apply_results: dict[int, object] = {}  # log index -> apply value
+
+        self._election_timeout = election_timeout
+        self._heartbeat_interval = heartbeat_interval
+        self._last_heard = time.monotonic()
+        self._stop = threading.Event()
+        self._commit_cv = threading.Condition(self.lock)
+        # parallel peer RPC pool: one slow/dead peer must never serialize an
+        # election or heartbeat round (it livelocks two live candidates)
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(2 * len(self.peers), 1),
+            thread_name_prefix=f"raft-rpc-{node_id}",
+        )
+        self._load_state()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        threading.Thread(target=self._election_loop, daemon=True,
+                         name=f"raft-elect-{self.id}").start()
+        threading.Thread(target=self._leader_loop, daemon=True,
+                         name=f"raft-lead-{self.id}").start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self.lock:
+            self._commit_cv.notify_all()
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    # -- persistence ---------------------------------------------------------
+
+    def _load_state(self) -> None:
+        if not self.state_path or not os.path.exists(self.state_path):
+            return
+        try:
+            with open(self.state_path) as f:
+                d = json.load(f)
+            self.term = d.get("term", 0)
+            self.voted_for = d.get("voted_for")
+            self.log = [LogEntry.from_json(e) for e in d.get("log", [])]
+        except (OSError, ValueError, KeyError):
+            pass
+
+    def _persist(self) -> None:
+        if not self.state_path:
+            return
+        tmp = self.state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "term": self.term,
+                    "voted_for": self.voted_for,
+                    "log": [e.to_json() for e in self.log],
+                },
+                f,
+            )
+        os.replace(tmp, self.state_path)
+
+    # -- log helpers ---------------------------------------------------------
+
+    def _last_index(self) -> int:
+        return len(self.log)
+
+    def _term_at(self, index: int) -> int:
+        if index == 0:
+            return 0
+        return self.log[index - 1].term
+
+    # -- RPC handlers (called by the transport layer) ------------------------
+
+    def handle(self, msg: dict) -> dict:
+        kind = msg.get("type")
+        if kind == "vote":
+            return self.handle_request_vote(msg)
+        if kind == "append":
+            return self.handle_append_entries(msg)
+        return {"error": f"unknown raft message {kind!r}"}
+
+    def handle_request_vote(self, msg: dict) -> dict:
+        with self.lock:
+            term = msg["term"]
+            if term > self.term:
+                self._become_follower(term)
+            granted = False
+            if term == self.term and self.voted_for in (None, msg["candidate"]):
+                # election restriction: candidate log must be >= ours
+                up_to_date = (
+                    msg["last_log_term"] > self._term_at(self._last_index())
+                    or (
+                        msg["last_log_term"] == self._term_at(self._last_index())
+                        and msg["last_log_index"] >= self._last_index()
+                    )
+                )
+                if up_to_date:
+                    granted = True
+                    self.voted_for = msg["candidate"]
+                    self._last_heard = time.monotonic()
+                    self._persist()
+            return {"term": self.term, "granted": granted}
+
+    def handle_append_entries(self, msg: dict) -> dict:
+        with self.lock:
+            term = msg["term"]
+            if term < self.term:
+                return {"term": self.term, "success": False}
+            if term > self.term or self.role != FOLLOWER:
+                self._become_follower(term)
+            self.leader_id = msg["leader"]
+            self._last_heard = time.monotonic()
+            prev_index = msg["prev_log_index"]
+            if prev_index > self._last_index() or (
+                prev_index > 0
+                and self._term_at(prev_index) != msg["prev_log_term"]
+            ):
+                return {"term": self.term, "success": False,
+                        "hint": min(prev_index, self._last_index() + 1)}
+            entries = [LogEntry.from_json(e) for e in msg.get("entries", [])]
+            idx = prev_index
+            changed = False
+            for e in entries:
+                idx += 1
+                if idx <= self._last_index():
+                    if self._term_at(idx) != e.term:
+                        del self.log[idx - 1 :]  # conflict: truncate
+                        self.log.append(e)
+                        changed = True
+                else:
+                    self.log.append(e)
+                    changed = True
+            if changed:
+                self._persist()
+            if msg["leader_commit"] > self.commit_index:
+                self.commit_index = min(msg["leader_commit"], self._last_index())
+                self._apply_committed()
+            return {"term": self.term, "success": True,
+                    "match": prev_index + len(entries)}
+
+    # -- state transitions ---------------------------------------------------
+
+    def _become_follower(self, term: int) -> None:
+        self.term = term
+        self.role = FOLLOWER
+        self.voted_for = None
+        self._persist()
+
+    def _become_leader(self) -> None:
+        self.role = LEADER
+        self.leader_id = self.id
+        self.progress = {
+            p: Progress(next_index=self._last_index() + 1) for p in self.peers
+        }
+        # replicate a no-op so entries from prior terms can commit
+        # (Raft §5.4.2 commit-only-current-term rule needs a current entry)
+        self.log.append(LogEntry(self.term, {"op": "noop"}))
+        self._persist()
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            cmd = self.log[self.last_applied - 1].command
+            if cmd.get("op") != "noop":
+                try:
+                    result = self.apply_fn(cmd)
+                    # keep recent results so propose_and_get can read the
+                    # value its own entry produced (bounded window)
+                    self.apply_results[self.last_applied] = result
+                    if len(self.apply_results) > 1024:
+                        for k in sorted(self.apply_results)[:-512]:
+                            del self.apply_results[k]
+                except Exception:
+                    pass
+        self._commit_cv.notify_all()
+
+    # -- election ------------------------------------------------------------
+
+    def _election_deadline(self) -> float:
+        lo, hi = self._election_timeout
+        return random.uniform(lo, hi)
+
+    def _election_loop(self) -> None:
+        deadline = self._election_deadline()
+        while not self._stop.is_set():
+            time.sleep(0.02)
+            with self.lock:
+                if self.role == LEADER:
+                    self._last_heard = time.monotonic()
+                    continue
+                waited = time.monotonic() - self._last_heard
+            if waited >= deadline:
+                self._run_election()
+                deadline = self._election_deadline()
+
+    def _run_election(self) -> None:
+        with self.lock:
+            self.role = CANDIDATE
+            self.term += 1
+            self.voted_for = self.id
+            self.leader_id = None
+            self._persist()
+            term = self.term
+            req = {
+                "type": "vote",
+                "term": term,
+                "candidate": self.id,
+                "last_log_index": self._last_index(),
+                "last_log_term": self._term_at(self._last_index()),
+            }
+            self._last_heard = time.monotonic()
+        quorum = (len(self.peers) + 1) // 2 + 1
+        votes = 1
+        futures = [
+            self._pool.submit(self._send_to, p, req) for p in self.peers
+        ]
+        try:
+            for fut in concurrent.futures.as_completed(futures, timeout=2.0):
+                resp = fut.result()
+                if resp is None:
+                    continue
+                with self.lock:
+                    if resp.get("term", 0) > self.term:
+                        self._become_follower(resp["term"])
+                        return
+                    if self.term != term or self.role != CANDIDATE:
+                        return  # stale election
+                if resp.get("granted"):
+                    votes += 1
+                if votes >= quorum:
+                    break  # don't wait for stragglers/dead peers
+        except concurrent.futures.TimeoutError:
+            pass
+        with self.lock:
+            if self.role == CANDIDATE and self.term == term and votes >= quorum:
+                self._become_leader()
+
+    # -- leader replication ---------------------------------------------------
+
+    def _leader_loop(self) -> None:
+        while not self._stop.is_set():
+            with self.lock:
+                is_leader = self.role == LEADER
+            if is_leader:
+                self._replicate_once()
+                time.sleep(self._heartbeat_interval)
+            else:
+                time.sleep(0.02)
+
+    def _replicate_once(self) -> None:
+        with self.lock:
+            if self.role != LEADER:
+                return
+            term = self.term
+            reqs = {}
+            for p in self.peers:
+                prog = self.progress[p]
+                prev = prog.next_index - 1
+                entries = [
+                    e.to_json() for e in self.log[prog.next_index - 1 :]
+                ]
+                reqs[p] = {
+                    "type": "append",
+                    "term": term,
+                    "leader": self.id,
+                    "prev_log_index": prev,
+                    "prev_log_term": self._term_at(prev),
+                    "entries": entries,
+                    "leader_commit": self.commit_index,
+                }
+        futures = {
+            self._pool.submit(self._send_to, p, req): p
+            for p, req in reqs.items()
+        }
+        try:
+            for fut in concurrent.futures.as_completed(futures, timeout=2.0):
+                p = futures[fut]
+                resp = fut.result()
+                if resp is None:
+                    continue
+                with self.lock:
+                    if resp.get("term", 0) > self.term:
+                        self._become_follower(resp["term"])
+                        return
+                    if self.role != LEADER or self.term != term:
+                        return
+                    prog = self.progress[p]
+                    if resp.get("success"):
+                        prog.match_index = max(
+                            prog.match_index, resp.get("match", 0)
+                        )
+                        prog.next_index = prog.match_index + 1
+                    else:
+                        prog.next_index = max(1, resp.get(
+                            "hint", prog.next_index - 1
+                        ))
+                self._advance_commit()
+        except concurrent.futures.TimeoutError:
+            pass
+
+    def _advance_commit(self) -> None:
+        with self.lock:
+            if self.role != LEADER:
+                return
+            for n in range(self._last_index(), self.commit_index, -1):
+                if self._term_at(n) != self.term:
+                    break  # only commit entries from the current term
+                count = 1 + sum(
+                    1 for p in self.peers if self.progress[p].match_index >= n
+                )
+                if count >= (len(self.peers) + 1) // 2 + 1:
+                    self.commit_index = n
+                    self._apply_committed()
+                    break
+
+    def _send_to(self, peer: str, msg: dict) -> dict | None:
+        try:
+            return self.send(peer, msg)
+        except Exception:
+            return None
+
+    # -- client API ----------------------------------------------------------
+
+    def is_leader(self) -> bool:
+        with self.lock:
+            return self.role == LEADER
+
+    def propose(self, command: dict, timeout: float = 5.0) -> bool:
+        """Leader-only: append, replicate, wait for commit+apply."""
+        ok, _ = self.propose_and_get(command, timeout)
+        return ok
+
+    def propose_and_get(self, command: dict,
+                        timeout: float = 5.0) -> tuple[bool, object]:
+        """Like propose, but returns (ok, value-returned-by-apply_fn).
+
+        Commands whose outcome depends on prior state (e.g. "increment the
+        max volume id") MUST compute it inside apply_fn — apply runs in log
+        order on every replica, so a freshly elected leader that hasn't yet
+        applied the old leader's tail cannot hand out a stale value."""
+        with self.lock:
+            if self.role != LEADER:
+                return False, None
+            self.log.append(LogEntry(self.term, command))
+            self._persist()
+            index = self._last_index()
+        self._replicate_once()
+        deadline = time.monotonic() + timeout
+        with self.lock:
+            while self.commit_index < index:
+                if self.role != LEADER or self._stop.is_set():
+                    return False, None
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False, None
+                self._commit_cv.wait(min(remaining, 0.05))
+            return True, self.apply_results.get(index)

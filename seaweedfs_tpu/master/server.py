@@ -46,6 +46,8 @@ class MasterServer:
         maintenance_interval: float = 0.0,  # seconds; 0 disables
         metrics_port: int = 0,
         jwt_signing_key: bytes | str = b"",
+        peers: list[str] | None = None,  # master quorum (ip:port HTTP addrs)
+        raft_state_dir: str = "",
     ):
         self.ip = ip
         self.port = port
@@ -74,6 +76,29 @@ class MasterServer:
             else jwt_signing_key
         )
         self._rng = random.Random()
+        # raft quorum (raft_server.go:21-46): multi-master when peers given
+        self.raft = None
+        addr = f"{ip}:{port}"
+        peer_list = [p.strip() for p in (peers or []) if p.strip()]
+        if peer_list:
+            if addr not in peer_list:
+                # silently falling back to single-master here would give
+                # every quorum member is_leader()=True -> split brain
+                raise ValueError(
+                    f"this master {addr!r} is not in -peers {peer_list}; "
+                    "include its own ip:port in the quorum list"
+                )
+            if len(peer_list) > 1:
+                from .raft import RaftNode
+
+                state_path = (
+                    f"{raft_state_dir}/raft-{port}.json"
+                    if raft_state_dir else ""
+                )
+                self.raft = RaftNode(
+                    addr, peer_list, self._raft_send,
+                    apply_fn=self._raft_apply, state_path=state_path,
+                )
 
     # -- lifecycle --------------------------------------------------------
 
@@ -87,22 +112,78 @@ class MasterServer:
         threading.Thread(target=self._liveness_loop, daemon=True).start()
         if self.maintenance_interval > 0:
             threading.Thread(target=self._maintenance_loop, daemon=True).start()
-        glog.info("master started http=%d grpc=%d", self.port, self.grpc_port)
+        if self.raft is not None:
+            self.raft.start()
+        glog.info("master started http=%d grpc=%d peers=%d",
+                  self.port, self.grpc_port,
+                  len(self.raft.peers) + 1 if self.raft else 1)
 
     def stop(self) -> None:
         self._stop.set()
+        if self.raft is not None:
+            self.raft.stop()
         if self._httpd:
             self._httpd.shutdown()
+            self._httpd.server_close()
         if self._metricsd:
             self._metricsd.shutdown()
+            self._metricsd.server_close()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
 
+    # -- raft plumbing ----------------------------------------------------
+
+    def _raft_send(self, peer: str, msg: dict) -> dict | None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://{peer}/cluster/raft",
+            data=json.dumps(msg).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=1.0) as r:
+            return json.loads(r.read())
+
+    def _raft_apply(self, cmd: dict):
+        """State machine: the reference's MaxVolumeIdCommand analogue.
+
+        "inc_vid" computes the new id HERE (in log order, identically on
+        every replica) — a fresh leader first applies the old leader's
+        tail, so it can never re-issue an id committed before failover."""
+        op = cmd.get("op")
+        if op == "inc_vid":
+            with self.topo.lock:
+                self.topo.max_volume_id += 1
+                return self.topo.max_volume_id
+        if op == "max_vid":  # older persisted logs
+            with self.topo.lock:
+                self.topo.max_volume_id = max(
+                    self.topo.max_volume_id, int(cmd["value"])
+                )
+                return self.topo.max_volume_id
+        return None
+
+    def is_leader(self) -> bool:
+        return self.raft is None or self.raft.is_leader()
+
+    def next_volume_id(self) -> int:
+        """Allocate a volume id; in quorum mode the increment commits
+        through raft before use (topology/cluster_commands.go)."""
+        if self.raft is None:
+            return self.topo.next_volume_id()
+        ok, vid = self.raft.propose_and_get({"op": "inc_vid"})
+        if not ok or vid is None:
+            raise RuntimeError("not the leader or quorum unavailable")
+        return int(vid)
+
     def leader(self) -> str:
+        if self.raft is not None and self.raft.leader_id:
+            return self.raft.leader_id
         return f"{self.ip}:{self.port}"
 
     def leader_grpc(self) -> str:
-        return f"{self.ip}:{self.grpc_port}"
+        host, _, port = self.leader().partition(":")
+        return f"{host}:{int(port) + GRPC_PORT_OFFSET}"
 
     # -- layouts ----------------------------------------------------------
 
@@ -189,7 +270,7 @@ class MasterServer:
                 if grown:
                     break
                 raise
-            vid = self.topo.next_volume_id()
+            vid = self.next_volume_id()
             ok = True
             for c in picked:
                 node = self.topo.nodes[c.node_id]
@@ -362,6 +443,17 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def do_POST(self):
+        u = urllib.parse.urlparse(self.path)
+        if u.path == "/cluster/raft" and self.master.raft is not None:
+            length = int(self.headers.get("Content-Length") or 0)
+            try:
+                msg = json.loads(self.rfile.read(length))
+                return self._json(200, self.master.raft.handle(msg))
+            except (ValueError, KeyError) as e:
+                return self._json(400, {"error": str(e)})
+        return self._json(404, {"error": f"unknown path {u.path}"})
+
     def do_GET(self):
         u = urllib.parse.urlparse(self.path)
         q = urllib.parse.parse_qs(u.query)
@@ -369,6 +461,18 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         def qget(name, default=""):
             return q.get(name, [default])[0]
 
+        if (u.path.startswith("/dir/") and u.path != "/dir/status"
+                and not self.master.is_leader()):
+            # followers hold no topology (volume servers heartbeat the
+            # leader only) — redirect like the reference's ProxyToLeader
+            leader = self.master.leader()
+            if leader == f"{self.master.ip}:{self.master.port}":
+                return self._json(503, {"error": "no leader elected yet"})
+            self.send_response(307)
+            self.send_header("Location", f"http://{leader}{self.path}")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
         if u.path == "/dir/assign":
             try:
                 fid, url, public_url, count = self.master.assign(
@@ -408,7 +512,7 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         if u.path in ("/cluster/status", "/dir/status"):
             with self.master.topo.lock:
                 return self._json(200, {
-                    "IsLeader": True,
+                    "IsLeader": self.master.is_leader(),
                     "Leader": self.master.leader(),
                     "MaxVolumeId": self.master.topo.max_volume_id,
                     "DataNodes": {

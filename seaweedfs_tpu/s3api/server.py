@@ -75,6 +75,7 @@ class S3ApiServer:
     def stop(self) -> None:
         if self._httpd:
             self._httpd.shutdown()
+            self._httpd.server_close()
 
     # -- path helpers --------------------------------------------------------
 

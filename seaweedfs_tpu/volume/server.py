@@ -99,8 +99,10 @@ class VolumeServer:
         self._stop.set()
         if self._httpd:
             self._httpd.shutdown()
+            self._httpd.server_close()
         if self._metricsd:
             self._metricsd.shutdown()
+            self._metricsd.server_close()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
         self.store.close()
@@ -148,11 +150,18 @@ class VolumeServer:
                 idx % len(self.master_addresses)
             ]
             idx += 1
+            was_leader_hint = master == self.current_leader
             try:
                 self._heartbeat_once(master)
-            except grpc.RpcError:
+                # clean return = follower ended the stream (no leader yet):
+                # back off instead of busy-spinning through the master list
                 time.sleep(min(self.pulse_seconds, 1.0))
-            except Exception:
+            except Exception:  # incl. grpc.RpcError
+                if was_leader_hint and self.current_leader == master:
+                    # the hinted leader died: fall back to seed rotation
+                    # instead of hammering a dead address forever (a fresh
+                    # hint set during this attempt is kept)
+                    self.current_leader = None
                 time.sleep(min(self.pulse_seconds, 1.0))
 
     def _heartbeat_once(self, master: str) -> None:
