@@ -292,9 +292,7 @@ class RaftNode:
             self._last_heard = time.monotonic()
         quorum = (len(self.peers) + 1) // 2 + 1
         votes = 1
-        futures = [
-            self._pool.submit(self._send_to, p, req) for p in self.peers
-        ]
+        futures = list(self._submit_sends({p: req for p in self.peers}))
         try:
             for fut in concurrent.futures.as_completed(futures, timeout=2.0):
                 resp = fut.result()
@@ -349,10 +347,7 @@ class RaftNode:
                     "entries": entries,
                     "leader_commit": self.commit_index,
                 }
-        futures = {
-            self._pool.submit(self._send_to, p, req): p
-            for p, req in reqs.items()
-        }
+        futures = self._submit_sends(reqs)
         try:
             for fut in concurrent.futures.as_completed(futures, timeout=2.0):
                 p = futures[fut]
@@ -400,6 +395,19 @@ class RaftNode:
         except Exception:
             return None
 
+    def _submit_sends(self, reqs: dict) -> dict:
+        """Submit parallel peer sends; {} once the node is stopping (the
+        pool rejects new futures after shutdown)."""
+        if self._stop.is_set():
+            return {}
+        try:
+            return {
+                self._pool.submit(self._send_to, p, req): p
+                for p, req in reqs.items()
+            }
+        except RuntimeError:  # pool shut down concurrently
+            return {}
+
     # -- client API ----------------------------------------------------------
 
     def is_leader(self) -> bool:
@@ -422,7 +430,8 @@ class RaftNode:
         with self.lock:
             if self.role != LEADER:
                 return False, None
-            self.log.append(LogEntry(self.term, command))
+            appended_term = self.term
+            self.log.append(LogEntry(appended_term, command))
             self._persist()
             index = self._last_index()
         self._replicate_once()
@@ -435,4 +444,10 @@ class RaftNode:
                 if remaining <= 0:
                     return False, None
                 self._commit_cv.wait(min(remaining, 0.05))
+            # the committed entry at our index must still be OURS: after a
+            # depose/re-elect cycle another leader's entry may occupy it,
+            # and returning its apply value would hand out duplicate state
+            if (index > self._last_index()
+                    or self._term_at(index) != appended_term):
+                return False, None
             return True, self.apply_results.get(index)

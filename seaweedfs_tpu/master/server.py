@@ -133,16 +133,35 @@ class MasterServer:
 
     # -- raft plumbing ----------------------------------------------------
 
+    def _raft_sig(self, payload: bytes) -> str:
+        import hashlib
+        import hmac
+
+        return hmac.new(
+            self.jwt_signing_key, payload, hashlib.sha256
+        ).hexdigest()
+
     def _raft_send(self, peer: str, msg: dict) -> dict | None:
         import urllib.request
 
+        payload = json.dumps(msg).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.jwt_signing_key:
+            # consensus messages forge cluster state; sign them with the
+            # same shared secret that protects writes (security/jwt.go)
+            headers["X-Raft-Signature"] = self._raft_sig(payload)
         req = urllib.request.Request(
-            f"http://{peer}/cluster/raft",
-            data=json.dumps(msg).encode(),
-            headers={"Content-Type": "application/json"},
+            f"http://{peer}/cluster/raft", data=payload, headers=headers
         )
         with urllib.request.urlopen(req, timeout=1.0) as r:
             return json.loads(r.read())
+
+    def verify_raft_request(self, payload: bytes, signature: str) -> bool:
+        import hmac
+
+        if not self.jwt_signing_key:
+            return True
+        return hmac.compare_digest(self._raft_sig(payload), signature or "")
 
     def _raft_apply(self, cmd: dict):
         """State machine: the reference's MaxVolumeIdCommand analogue.
@@ -447,8 +466,13 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         u = urllib.parse.urlparse(self.path)
         if u.path == "/cluster/raft" and self.master.raft is not None:
             length = int(self.headers.get("Content-Length") or 0)
+            payload = self.rfile.read(length)
+            if not self.master.verify_raft_request(
+                payload, self.headers.get("X-Raft-Signature", "")
+            ):
+                return self._json(403, {"error": "bad raft signature"})
             try:
-                msg = json.loads(self.rfile.read(length))
+                msg = json.loads(payload)
                 return self._json(200, self.master.raft.handle(msg))
             except (ValueError, KeyError) as e:
                 return self._json(400, {"error": str(e)})

@@ -209,6 +209,179 @@ def _pick_volume_on(topo, node_id: str):
     return None
 
 
+@register("volume.evacuate")
+def volume_evacuate(env: CommandEnv, args: list[str]) -> str:
+    """Move every volume and EC shard off a node, then tell it to leave
+    (command_volume_server_evacuate.go)."""
+    flags = _parse_flags(args)
+    node = flags["node"]  # ip:port (http)
+    topo = env.topology()
+    nodes = {dn.id: dn for _dc, _rack, dn in _iter_nodes(topo)}
+    if node not in nodes:
+        return f"volume.evacuate: node {node} not found"
+    targets = [
+        nid for nid in nodes
+        if nid != node and _free_slots(nodes[nid]) > 0
+    ]
+    if not targets:
+        return "volume.evacuate: no target nodes with free slots"
+    # a node already holding a replica of vid must not be picked as its
+    # target — VolumeCopy would overwrite it and the delete on the source
+    # would silently drop the cluster one replica short
+    holders: dict[int, set[str]] = {}
+    for _dc, _rack, dn in _iter_nodes(topo):
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                holders.setdefault(v.id, set()).add(dn.id)
+    moved, i = [], 0
+    for disk in nodes[node].disk_infos.values():
+        for v in disk.volume_infos:
+            eligible = [
+                t_ for t_ in targets if t_ not in holders.get(v.id, set())
+            ]
+            if not eligible:
+                moved.append(f"v{v.id} SKIPPED: every target holds a replica")
+                continue
+            target = eligible[i % len(eligible)]
+            i += 1
+            try:
+                volume_move(
+                    env,
+                    [f"-volumeId={v.id}", f"-source={_node_grpc(node)}",
+                     f"-target={_node_grpc(target)}"],
+                )
+                moved.append(f"v{v.id}->{target}")
+            except grpc.RpcError as e:
+                moved.append(f"v{v.id} FAILED: {e.code()}")
+        for ec in disk.ec_shard_infos:
+            target = targets[i % len(targets)]
+            i += 1
+            shard_ids = _bits_to_ids(ec.ec_index_bits)
+            try:
+                env.volume_server(_node_grpc(target)).VolumeEcShardsCopy(
+                    vs.VolumeEcShardsCopyRequest(
+                        volume_id=ec.id, collection=ec.collection,
+                        shard_ids=shard_ids, copy_ecx_file=True,
+                        copy_ecj_file=True, copy_vif_file=True,
+                        copy_from_data_node=_node_grpc(node),
+                    )
+                )
+                env.volume_server(_node_grpc(target)).VolumeEcShardsMount(
+                    vs.VolumeEcShardsMountRequest(
+                        volume_id=ec.id, collection=ec.collection,
+                        shard_ids=shard_ids,
+                    )
+                )
+                env.volume_server(_node_grpc(node)).VolumeEcShardsUnmount(
+                    vs.VolumeEcShardsUnmountRequest(
+                        volume_id=ec.id, shard_ids=shard_ids
+                    )
+                )
+                env.volume_server(_node_grpc(node)).VolumeEcShardsDelete(
+                    vs.VolumeEcShardsDeleteRequest(
+                        volume_id=ec.id, collection=ec.collection,
+                        shard_ids=shard_ids,
+                    )
+                )
+                moved.append(f"ec{ec.id}{shard_ids}->{target}")
+            except grpc.RpcError as e:
+                moved.append(f"ec{ec.id} FAILED: {e.code()}")
+    if flags.get("leave", "true") != "false":
+        try:
+            env.volume_server(_node_grpc(node)).VolumeServerLeave(
+                vs.VolumeServerLeaveRequest()
+            )
+        except grpc.RpcError:
+            pass
+    return f"volume.evacuate {node}: " + (", ".join(moved) or "nothing to move")
+
+
+def _bits_to_ids(bits: int) -> list[int]:
+    return [i for i in range(14) if bits & (1 << i)]
+
+
+def find_replica_divergence(statuses: dict[int, list[tuple[str, object]]]):
+    """Pure analysis: vid -> list of (node, file_count, dat_size) when
+    replicas disagree (command_volume_check_disk.go's comparison)."""
+    out = {}
+    for vid, pairs in statuses.items():
+        if len(pairs) < 2:
+            continue
+        counts = {(st.file_count, st.dat_file_size) for _n, st in pairs}
+        if len(counts) > 1:
+            out[vid] = [
+                (n, st.file_count, st.dat_file_size) for n, st in pairs
+            ]
+    return out
+
+
+def _collect_volume_statuses(env: CommandEnv, topo) -> dict:
+    statuses: dict[int, list] = {}
+    for _dc, _rack, dn in _iter_nodes(topo):
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                try:
+                    st = env.volume_server(_node_grpc(dn.id)).ReadVolumeFileStatus(
+                        vs.ReadVolumeFileStatusRequest(volume_id=v.id)
+                    )
+                    statuses.setdefault(v.id, []).append((dn.id, st))
+                except grpc.RpcError:
+                    continue
+    return statuses
+
+
+@register("volume.fsck")
+def volume_fsck(env: CommandEnv, args: list[str]) -> str:
+    """Report replicas whose file counts / sizes disagree
+    (command_volume_fsck.go's consistency sweep, metadata level)."""
+    topo = env.topology()
+    diverged = find_replica_divergence(_collect_volume_statuses(env, topo))
+    if not diverged:
+        return "volume.fsck: all replicas consistent"
+    lines = []
+    for vid, infos in sorted(diverged.items()):
+        detail = ", ".join(f"{n}: {fc} files/{sz}B" for n, fc, sz in infos)
+        lines.append(f"volume {vid} diverged: {detail}")
+    return "\n".join(lines)
+
+
+@register("volume.check.disk")
+def volume_check_disk(env: CommandEnv, args: list[str]) -> str:
+    """Repair diverged replicas by tail-syncing the smaller from the
+    larger (command_volume_check_disk.go)."""
+    flags = _parse_flags(args)
+    apply_changes = flags.get("force", "false") != "false"
+    topo = env.topology()
+    diverged = find_replica_divergence(_collect_volume_statuses(env, topo))
+    if not diverged:
+        return "volume.check.disk: all replicas consistent"
+    lines = []
+    for vid, infos in sorted(diverged.items()):
+        best = max(infos, key=lambda x: (x[1], x[2]))
+        for node, fc, sz in infos:
+            if node == best[0]:
+                continue
+            if not apply_changes:
+                lines.append(
+                    f"volume {vid}: {node} ({fc} files) behind "
+                    f"{best[0]} ({best[1]} files) — rerun with -force to sync"
+                )
+                continue
+            try:
+                env.volume_server(_node_grpc(node)).VolumeTailReceiver(
+                    vs.VolumeTailReceiverRequest(
+                        volume_id=vid,
+                        since_ns=0,
+                        idle_timeout_seconds=1,
+                        source_volume_server=best[0],
+                    )
+                )
+                lines.append(f"volume {vid}: synced {node} from {best[0]}")
+            except grpc.RpcError as e:
+                lines.append(f"volume {vid}: sync failed: {e.code()}")
+    return "\n".join(lines)
+
+
 @register("lock")
 def lock_cmd(env: CommandEnv, args: list[str]) -> str:
     return "locked" if env.acquire_lock() else "lock busy"

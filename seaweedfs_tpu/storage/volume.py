@@ -115,6 +115,13 @@ class Volume:
 
     # -- stats / lifecycle ------------------------------------------------
 
+    def flush(self) -> None:
+        """Fence buffered appends so other handles see consistent
+        .dat/.idx files (bulk copy streams them by path)."""
+        with self._lock:
+            self._dat.flush()
+            self._idx.flush()
+
     @property
     def content_size(self) -> int:
         self._dat.seek(0, os.SEEK_END)

@@ -30,6 +30,8 @@ def scan_dat_file(dat_path: str) -> Iterator[tuple[int, Needle]]:
     The reference's ScanVolumeFile walk (needle_read_write.go ReadNeedleHeader
     + body).  Tombstone records (size<0) are yielded too — callers decide.
     """
+    import struct
+
     with open(dat_path, "rb") as f:
         sb = SuperBlock.from_bytes(f.read(64))
         version = sb.version
@@ -44,6 +46,10 @@ def scan_dat_file(dat_path: str) -> Iterator[tuple[int, Needle]]:
             body = f.read(body_length(size, version))
             if size > 0:
                 n = Needle.from_bytes(header + body, version, verify=False)
+            elif version == 3 and len(body) >= 12:
+                # tombstone: checksum(4) + append_at_ns(8); consumers like
+                # incremental tail sync need deletion timestamps too
+                n.append_at_ns = struct.unpack(">Q", body[4:12])[0]
             yield offset, n
             offset += t.NEEDLE_HEADER_SIZE + len(body)
 

@@ -225,6 +225,7 @@ class VolumeGrpcService:
             v = self.store.find_volume(request.volume_id)
             if v is None:
                 context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+            v.flush()  # the on-disk .dat/.idx must include buffered appends
             base = v.file_name()
         path = base + request.ext
         if not os.path.exists(path):
@@ -369,6 +370,147 @@ class VolumeGrpcService:
         except KeyError as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
         return vs.VolumeEcShardsToVolumeResponse()
+
+    # -- replica catch-up: incremental copy + tail sync -------------------
+    # (reference: volume_grpc_copy_incremental.go, volume_grpc_tail.go)
+
+    def _offset_since(self, v, since_ns: int) -> int:
+        """First .dat offset whose record was appended after since_ns;
+        falls back to EOF when everything predates it."""
+        from ..tools.offline import scan_dat_file
+
+        v.flush()
+        if since_ns == 0:
+            return v.super_block.block_size()
+        for offset, n in scan_dat_file(v.file_name() + ".dat"):
+            if n.append_at_ns > since_ns:
+                return offset
+        return v.content_size
+
+    def VolumeIncrementalCopy(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        start = self._offset_since(v, request.since_ns)
+        end = v.content_size
+        with open(v.file_name() + ".dat", "rb") as f:
+            f.seek(start)
+            while start < end:
+                chunk = f.read(min(COPY_CHUNK, end - start))
+                if not chunk:
+                    break
+                yield vs.VolumeIncrementalCopyResponse(file_content=chunk)
+                start += len(chunk)
+
+    def VolumeTailSender(self, request, context):
+        """Stream needles appended after since_ns; keep watching for new
+        appends until idle_timeout_seconds passes without growth."""
+        import time as _time
+
+        from ..storage import types as _t
+        from ..storage.needle import body_length
+
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        pos = self._offset_since(v, request.since_ns)
+        idle_deadline = _time.monotonic() + (request.idle_timeout_seconds or 2)
+        dat_path = v.file_name() + ".dat"
+        while _time.monotonic() < idle_deadline and context.is_active():
+            v.flush()
+            end = v.content_size
+            if pos >= end:
+                _time.sleep(0.1)
+                continue
+            with open(dat_path, "rb") as f:
+                f.seek(pos)
+                while pos < end:
+                    header = f.read(_t.NEEDLE_HEADER_SIZE)
+                    if len(header) < _t.NEEDLE_HEADER_SIZE:
+                        break
+                    n = Needle.parse_header(header)
+                    body = f.read(
+                        body_length(n.size if n.size > 0 else 0, v.version)
+                    )
+                    yield vs.VolumeTailSenderResponse(
+                        needle_header=header, needle_body=body
+                    )
+                    pos += len(header) + len(body)
+            idle_deadline = _time.monotonic() + (
+                request.idle_timeout_seconds or 2
+            )
+        yield vs.VolumeTailSenderResponse(is_last_chunk=True)
+
+    def _last_append_ns(self, v) -> int:
+        """Max append_at_ns across the local .dat (incl. tombstones)."""
+        from ..tools.offline import scan_dat_file
+
+        v.flush()
+        last = 0
+        for _off, n in scan_dat_file(v.file_name() + ".dat"):
+            last = max(last, n.append_at_ns)
+        return last
+
+    def VolumeTailReceiver(self, request, context):
+        """Pull missing appends from a replica peer into the local volume
+        (volume_grpc_tail.go receiver side).  since_ns=0 means "from my own
+        last append" — re-streaming records the replica already holds would
+        duplicate them at EOF and balloon the .dat on every sync."""
+        from .server import GRPC_PORT_OFFSET
+
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        since_ns = request.since_ns or self._last_append_ns(v)
+        host, _, port = request.source_volume_server.partition(":")
+        source_grpc = f"{host}:{int(port) + GRPC_PORT_OFFSET}"
+        stub = rpclib.volume_server_stub(source_grpc, timeout=120)
+        for resp in stub.VolumeTailSender(
+            vs.VolumeTailSenderRequest(
+                volume_id=request.volume_id,
+                since_ns=since_ns,
+                idle_timeout_seconds=request.idle_timeout_seconds or 1,
+            )
+        ):
+            if resp.is_last_chunk:
+                break
+            if not resp.needle_header:
+                continue
+            n = Needle.parse_header(bytes(resp.needle_header))
+            if n.size > 0:
+                full = Needle.from_bytes(
+                    bytes(resp.needle_header) + bytes(resp.needle_body),
+                    v.version, verify=False,
+                )
+                v.append_needle(full)
+            else:
+                v.delete_needle(n.id)
+        return vs.VolumeTailReceiverResponse()
+
+    # -- server status / membership ---------------------------------------
+
+    def VolumeServerStatus(self, request, context):
+        resp = vs.VolumeServerStatusResponse()
+        for loc in self.store.locations:
+            st = os.statvfs(loc.directory)
+            all_b = st.f_blocks * st.f_frsize
+            free_b = st.f_bavail * st.f_frsize
+            used_b = all_b - free_b
+            resp.disk_statuses.add(
+                dir=loc.directory,
+                all=all_b,
+                used=used_b,
+                free=free_b,
+                percent_free=100.0 * free_b / all_b if all_b else 0.0,
+                percent_used=100.0 * used_b / all_b if all_b else 0.0,
+            )
+        return resp
+
+    def VolumeServerLeave(self, request, context):
+        """Graceful exit from the cluster: stop heartbeating so the master
+        unregisters this node (volume_server.proto:93)."""
+        self.server.stop_heartbeat()
+        return vs.VolumeServerLeaveResponse()
 
 
 def _write_stream(path: str, stream, drop_empty: bool = False) -> None:

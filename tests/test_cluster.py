@@ -267,3 +267,101 @@ def test_ec_delete_fanout(cluster):
     # other needles still readable
     code, _ = _http("GET", f"http://127.0.0.1:{holders[0].port}/{fids[1]}")
     assert code == 200
+
+
+def test_tail_receiver_replica_catchup(cluster):
+    """VolumeTailReceiver pulls appends (and tombstones) from a peer into
+    a local replica (volume_grpc_tail.go + volume_grpc_copy_incremental.go)."""
+    from seaweedfs_tpu.pb import rpc as rpclib
+    from seaweedfs_tpu.pb import volume_server_pb2 as vspb
+
+    master, servers = cluster
+    src, dst = servers[0], servers[1]
+    vid = 7001
+    for s in (src, dst):
+        rpclib.volume_server_stub(f"127.0.0.1:{s.grpc_port}").AllocateVolume(
+            vspb.AllocateVolumeRequest(volume_id=vid, collection="",
+                                       replication="000")
+        )
+    # write three needles + delete one, directly against the source
+    fids = []
+    for i in range(3):
+        fid = f"{vid},{i + 1:x}00000001"
+        code, _ = _http("POST", f"http://127.0.0.1:{src.port}/{fid}",
+                        f"tail-{i}".encode() * 50)
+        assert code == 201
+        fids.append(fid)
+    code, _ = _http("DELETE", f"http://127.0.0.1:{src.port}/{fids[2]}")
+    assert code == 202
+    # destination pulls the tail from the source
+    rpclib.volume_server_stub(f"127.0.0.1:{dst.grpc_port}").VolumeTailReceiver(
+        vspb.VolumeTailReceiverRequest(
+            volume_id=vid, since_ns=0, idle_timeout_seconds=1,
+            source_volume_server=f"127.0.0.1:{src.port}",
+        )
+    )
+    for fid in fids[:2]:
+        code, body = _http("GET", f"http://127.0.0.1:{dst.port}/{fid}")
+        assert code == 200, f"replica missing {fid}"
+    code, _ = _http("GET", f"http://127.0.0.1:{dst.port}/{fids[2]}")
+    assert code == 404, "tombstone did not propagate"
+    # incremental copy streams the raw .dat tail
+    stream = rpclib.volume_server_stub(
+        f"127.0.0.1:{src.grpc_port}"
+    ).VolumeIncrementalCopy(
+        vspb.VolumeIncrementalCopyRequest(volume_id=vid, since_ns=0)
+    )
+    data = b"".join(r.file_content for r in stream)
+    assert b"tail-0" in data and b"tail-1" in data
+
+
+def test_find_replica_divergence_pure():
+    from types import SimpleNamespace
+
+    from seaweedfs_tpu.shell.volume_commands import find_replica_divergence
+
+    st = lambda fc, sz: SimpleNamespace(file_count=fc, dat_file_size=sz)  # noqa
+    statuses = {
+        1: [("a", st(5, 100)), ("b", st(5, 100))],
+        2: [("a", st(5, 100)), ("b", st(3, 60))],
+        3: [("a", st(9, 10))],
+    }
+    out = find_replica_divergence(statuses)
+    assert 2 in out and 1 not in out and 3 not in out
+    assert {n for n, _fc, _sz in out[2]} == {"a", "b"}
+
+
+def test_volume_evacuate(cluster):
+    """Moves all volumes off a node and tells it to leave
+    (command_volume_server_evacuate.go).  Runs LAST: the evacuated node
+    stops heartbeating."""
+    master, servers = cluster
+    victim = servers[2]
+    node_id = f"127.0.0.1:{victim.port}"
+    # ensure the victim owns at least one volume
+    from seaweedfs_tpu.pb import rpc as rpclib
+    from seaweedfs_tpu.pb import volume_server_pb2 as vspb
+
+    vid = 7100
+    rpclib.volume_server_stub(f"127.0.0.1:{victim.grpc_port}").AllocateVolume(
+        vspb.AllocateVolumeRequest(volume_id=vid, collection="",
+                                   replication="000")
+    )
+    fid = f"{vid},1200000001"
+    code, _ = _http("POST", f"http://127.0.0.1:{victim.port}/{fid}", b"evac!")
+    assert code == 201
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        node = master.topo.nodes.get(node_id)
+        if node is not None and vid in node.volumes:
+            break
+        time.sleep(0.2)
+    env = CommandEnv(f"127.0.0.1:{master.grpc_port}")
+    out = run_command(env, f"volume.evacuate -node={node_id}")
+    assert f"v{vid}->" in out, out
+    # the volume now lives (readable) on another server
+    others = [s for s in servers if s is not victim]
+    assert any(s.store.find_volume(vid) for s in others)
+    target = next(s for s in others if s.store.find_volume(vid))
+    code, body = _http("GET", f"http://127.0.0.1:{target.port}/{fid}")
+    assert code == 200 and body == b"evac!"

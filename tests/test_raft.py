@@ -254,7 +254,15 @@ def test_master_quorum_failover(tmp_path):
             break
         time.sleep(0.05)
     assert leader is not None, "master quorum elected no leader"
-    # every master agrees on the leader address
+    # every master converges on the leader address (followers learn it from
+    # the next AppendEntries heartbeat, not instantly)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if all(m.leader() == f"127.0.0.1:{leader.port}" for m in masters):
+            break
+        if len([m for m in masters if m.is_leader()]) != 1:
+            leader = next((m for m in masters if m.is_leader()), leader)
+        time.sleep(0.05)
     for m in masters:
         assert m.leader() == f"127.0.0.1:{leader.port}"
     # cluster status endpoint reports raft state
@@ -279,7 +287,7 @@ def test_master_quorum_failover(tmp_path):
     # failover: stop the leader, a new one takes over with the state
     leader.stop()
     rest = [m for m in masters if m is not leader]
-    deadline = time.time() + 10
+    deadline = time.time() + 20  # loaded 1-vCPU host: elections are slow
     new_leader = None
     while time.time() < deadline:
         leaders = [m for m in rest if m.is_leader()]
@@ -293,3 +301,50 @@ def test_master_quorum_failover(tmp_path):
     assert vid2 > vid
     for m in rest:
         m.stop()
+
+
+def test_raft_transport_rejects_forged_messages(tmp_path):
+    """With a cluster secret set, unsigned /cluster/raft POSTs are refused
+    — forged append/vote messages must not corrupt the quorum."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from seaweedfs_tpu.master.server import MasterServer
+
+    ports = [_free_port() for _ in range(2)]
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    masters = [
+        MasterServer(ip="127.0.0.1", port=p, peers=peers,
+                     raft_state_dir=str(tmp_path), jwt_signing_key=b"sekrit")
+        for p in ports
+    ]
+    for m in masters:
+        m.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if any(m.is_leader() for m in masters):
+                break
+            time.sleep(0.05)
+        assert any(m.is_leader() for m in masters), \
+            "signed quorum failed to elect"
+        forged = json.dumps({
+            "type": "append", "term": 999, "leader": "evil",
+            "prev_log_index": 0, "prev_log_term": 0,
+            "entries": [{"term": 999, "command": {"op": "max_vid",
+                                                  "value": 4_000_000_000}}],
+            "leader_commit": 1,
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{ports[0]}/cluster/raft", data=forged,
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        assert ei.value.code == 403
+        assert masters[0].raft.term < 999
+        assert masters[0].topo.max_volume_id < 4_000_000_000
+    finally:
+        for m in masters:
+            m.stop()
