@@ -88,6 +88,83 @@ def cmd_filer(args) -> None:
     _wait()
 
 
+def cmd_msg_broker(args) -> None:
+    from .messaging.broker import MessageBrokerServer
+
+    b = MessageBrokerServer(
+        filer=args.filer,
+        ip=args.ip,
+        port=args.port,
+        peers=args.peers.split(",") if args.peers else None,
+    )
+    b.start()
+    print(f"message broker grpc={args.port} filer={args.filer}")
+    _wait()
+
+
+def cmd_filer_replicate(args) -> None:
+    from .replication import FilerSource, Replicator
+    from .replication.sink import FilerSink, LocalSink, S3Sink
+
+    if args.sink_type == "filer":
+        sink = FilerSink(args.sink)
+    elif args.sink_type == "s3":
+        endpoint, _, bucket = args.sink.partition("/")
+        sink = S3Sink(endpoint, bucket or "backup")
+    else:
+        sink = LocalSink(args.sink)
+    rep = Replicator(FilerSource(args.filer), sink, args.filerPath)
+    print(f"replicating {args.filer}{args.filerPath} -> "
+          f"{args.sink_type}:{args.sink}")
+    rep.run()
+
+
+def cmd_filer_backup(args) -> None:
+    from .replication import FilerSource, LocalSink, Replicator
+
+    rep = Replicator(FilerSource(args.filer), LocalSink(args.dir),
+                     args.filerPath)
+    print(f"backing up {args.filer}{args.filerPath} -> {args.dir}")
+    rep.run()
+
+
+def cmd_filer_meta_tail(args) -> None:
+    from .replication.source import subscribe_metadata
+
+    for resp in subscribe_metadata(args.filer, args.pathPrefix,
+                                   client_name="meta.tail"):
+        n = resp.event_notification
+        kind = ("delete" if not n.new_entry.name
+                else "create" if not n.old_entry.name else "update")
+        name = n.new_entry.name or n.old_entry.name
+        print(f"{resp.ts_ns} {kind} {resp.directory}/{name}")
+
+
+def cmd_filer_sync(args) -> None:
+    """Bidirectional sync between two filers.  Both directions share one
+    sync signature: every replayed mutation carries it, and each side's
+    subscription skips events so signed — writes cannot ping-pong
+    (command/filer_sync.go)."""
+    import random
+    import threading
+
+    from .replication import FilerSource, Replicator
+    from .replication.sink import FilerSink
+
+    a, b = args.a, args.b
+    sig = random.randint(1, 2**31 - 1)
+    ra = Replicator(FilerSource(a), FilerSink(b, signature=sig),
+                    args.filerPath, signature=sig)
+    rb = Replicator(FilerSource(b), FilerSink(a, signature=sig),
+                    args.filerPath, signature=sig)
+    ta = threading.Thread(target=ra.run, daemon=True)
+    tb = threading.Thread(target=rb.run, daemon=True)
+    ta.start()
+    tb.start()
+    print(f"filer.sync {a} <-> {b} prefix={args.filerPath}")
+    _wait()
+
+
 def cmd_s3(args) -> None:
     from .s3api.server import S3ApiServer
 
@@ -221,6 +298,41 @@ def main(argv=None) -> None:
     f.add_argument("-maxMB", type=int, default=4)
     f.add_argument("-metricsPort", type=int, default=0)
     f.set_defaults(fn=cmd_filer)
+
+    mb = sub.add_parser("msgBroker")
+    mb.add_argument("-filer", default="127.0.0.1:8888")
+    mb.add_argument("-ip", default="127.0.0.1")
+    mb.add_argument("-port", type=int, default=17777)
+    mb.add_argument("-peers", default="",
+                    help="comma-separated peer broker grpc addresses")
+    mb.set_defaults(fn=cmd_msg_broker)
+
+    fr = sub.add_parser("filer.replicate")
+    fr.add_argument("-filer", default="127.0.0.1:8888")
+    fr.add_argument("-filerPath", default="/")
+    fr.add_argument("-sink.type", dest="sink_type", default="local",
+                    choices=["local", "filer", "s3"])
+    fr.add_argument("-sink", required=True,
+                    help="local dir, target filer ip:port, or s3 "
+                         "endpoint/bucket")
+    fr.set_defaults(fn=cmd_filer_replicate)
+
+    fb = sub.add_parser("filer.backup")
+    fb.add_argument("-filer", default="127.0.0.1:8888")
+    fb.add_argument("-filerPath", default="/")
+    fb.add_argument("-dir", required=True)
+    fb.set_defaults(fn=cmd_filer_backup)
+
+    fmt = sub.add_parser("filer.meta.tail")
+    fmt.add_argument("-filer", default="127.0.0.1:8888")
+    fmt.add_argument("-pathPrefix", default="/")
+    fmt.set_defaults(fn=cmd_filer_meta_tail)
+
+    fsy = sub.add_parser("filer.sync")
+    fsy.add_argument("-a", required=True, help="filer A ip:port")
+    fsy.add_argument("-b", required=True, help="filer B ip:port")
+    fsy.add_argument("-filerPath", default="/")
+    fsy.set_defaults(fn=cmd_filer_sync)
 
     s3p = sub.add_parser("s3")
     s3p.add_argument("-filer", default="127.0.0.1:8888")

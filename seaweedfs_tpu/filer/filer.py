@@ -43,6 +43,7 @@ class Filer:
         None, chunk deletion is a no-op (offline/metadata-only use)."""
         self.store = store
         self.meta_log = MetaLogBuffer()
+        self._append_lock = threading.Lock()
         self._delete_fn = delete_chunks_fn
         self._deletion_q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
@@ -61,7 +62,7 @@ class Filer:
         old = self.store.find_entry(directory, entry.name)
         if old is not None and o_excl:
             raise FileExistsError(join_path(directory, entry.name))
-        self._ensure_parents(directory)
+        self._ensure_parents(directory, signatures=signatures)
         if not entry.attributes.crtime:
             entry.attributes.crtime = int(time.time())
         if not entry.attributes.mtime:
@@ -85,37 +86,43 @@ class Filer:
         self.meta_log.append(directory, old, entry, signatures=signatures)
 
     def append_chunks(self, directory: str, name: str, chunks) -> None:
-        entry = self.store.find_entry(directory, name)
-        if entry is None:
-            entry = filer_pb2.Entry(name=name)
-            entry.attributes.crtime = int(time.time())
-        offset = filechunks.total_size(entry.chunks)
-        for c in chunks:
-            c2 = filer_pb2.FileChunk()
-            c2.CopyFrom(c)
-            c2.offset = offset
-            offset += c2.size
-            entry.chunks.append(c2)
-        entry.attributes.mtime = int(time.time())
-        entry.attributes.file_size = offset
-        self.store.insert_entry(directory, entry)
-        self.meta_log.append(directory, None, entry)
+        # serialize the read-modify-write: two concurrent appenders would
+        # otherwise both read the same chunk list and one would lose chunks
+        with self._append_lock:
+            entry = self.store.find_entry(directory, name)
+            if entry is None:
+                self._ensure_parents(directory)
+                entry = filer_pb2.Entry(name=name)
+                entry.attributes.crtime = int(time.time())
+            offset = filechunks.total_size(entry.chunks)
+            for c in chunks:
+                c2 = filer_pb2.FileChunk()
+                c2.CopyFrom(c)
+                c2.offset = offset
+                offset += c2.size
+                entry.chunks.append(c2)
+            entry.attributes.mtime = int(time.time())
+            entry.attributes.file_size = offset
+            self.store.insert_entry(directory, entry)
+            self.meta_log.append(directory, None, entry)
 
-    def _ensure_parents(self, directory: str) -> None:
-        """mkdir -p the ancestor chain (filer.go ensures parent dirs)."""
+    def _ensure_parents(self, directory: str, signatures=None) -> None:
+        """mkdir -p the ancestor chain (filer.go ensures parent dirs).
+        The dir-creation events inherit the mutation's signatures so
+        bidirectional sync filters them like the triggering write."""
         if directory in ("/", ""):
             return
         parent, name = split_path(directory)
         existing = self.store.find_entry(parent, name)
         if existing is not None:
             return
-        self._ensure_parents(parent)
+        self._ensure_parents(parent, signatures=signatures)
         d = filer_pb2.Entry(name=name, is_directory=True)
         d.attributes.crtime = int(time.time())
         d.attributes.mtime = d.attributes.crtime
         d.attributes.file_mode = 0o40755  # dir bit
         self.store.insert_entry(parent, d)
-        self.meta_log.append(parent, None, d)
+        self.meta_log.append(parent, None, d, signatures=signatures)
 
     # -- read --------------------------------------------------------------
 

@@ -153,6 +153,18 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
             path = path + name_hint.decode(errors="replace")
         collection = q.get("collection", [""])[0] or self.filer.bucket_collection(path)
         ttl = q.get("ttl", [""])[0]
+        if q.get("op", [""])[0] == "append":
+            try:
+                entry = self.filer_server.append_file(
+                    path, body, mime=ctype, collection=collection,
+                    replication=q.get("replication", [""])[0], ttl=ttl,
+                )
+            except Exception as e:
+                return self._json(500, {"error": str(e)})
+            return self._json(201, {
+                "name": entry.name,
+                "size": filechunks.total_size(entry.chunks),
+            })
         try:
             entry = self.filer_server.write_file(
                 path, body,
@@ -160,6 +172,7 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
                 collection=collection,
                 replication=q.get("replication", [""])[0],
                 ttl=ttl,
+                signatures=_signatures(q),
             )
         except Exception as e:
             return self._json(500, {"error": str(e)})
@@ -180,12 +193,26 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
             self.filer.delete_entry(
                 directory, name, is_recursive=recursive,
                 ignore_recursive_error=q.get("ignoreRecursiveError", ["false"])[0] == "true",
+                signatures=_signatures(q),
             )
         except FileNotFoundError:
             return self._json(404, {"error": f"{path}: not found"})
         except IsADirectoryError as e:
             return self._json(400, {"error": str(e)})
         self._send(204)
+
+
+def _signatures(q: dict) -> list[int]:
+    """?signature=N (repeatable): mutation provenance markers so metadata
+    subscribers can skip events they caused themselves (filer.sync loop
+    prevention, command/filer_sync.go)."""
+    out = []
+    for v in q.get("signature", []):
+        try:
+            out.append(int(v))
+        except ValueError:
+            continue
+    return out
 
 
 def _entry_json(dir_path: str, e: filer_pb2.Entry) -> dict:

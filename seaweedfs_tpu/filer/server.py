@@ -38,6 +38,7 @@ class FilerServer:
         max_mb: int = 4,
         default_replication: str = "",
         metrics_port: int = 0,
+        notification=None,  # notification.Publisher, or None
     ):
         self.masters = list(masters)
         self.ip = ip
@@ -58,6 +59,17 @@ class FilerServer:
         self._grpc_server = None
         self._httpd = None
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+        self.notification = notification
+        if notification is not None:
+            # every metadata mutation fans out to the configured queue
+            # (filer_notify.go -> notification.Queue.SendMessage)
+            def _notify(resp):
+                name = (resp.event_notification.new_entry.name
+                        or resp.event_notification.old_entry.name)
+                key = f"{resp.directory.rstrip('/')}/{name}"
+                notification.publish(key, resp.event_notification)
+
+            self.filer.meta_log.add_listener(_notify)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -116,7 +128,8 @@ class FilerServer:
 
     def write_file(self, path: str, data: bytes, mime: str = "",
                    collection: str = "", replication: str = "",
-                   ttl: str = "") -> filer_pb2.Entry:
+                   ttl: str = "",
+                   signatures: list[int] | None = None) -> filer_pb2.Entry:
         """Auto-chunking upload: split, assign+upload each chunk, CreateEntry."""
         directory, name = split_path(path)
         chunk_size = self.max_mb << 20
@@ -141,7 +154,7 @@ class FilerServer:
         entry.attributes.collection = collection
         entry.attributes.replication = replication
         entry.attributes.ttl_sec = ttl_sec
-        self.filer.create_entry(directory, entry)
+        self.filer.create_entry(directory, entry, signatures=signatures)
         return entry
 
     def _upload_chunk(self, blob: bytes, offset: int, name: str, mime: str,
@@ -157,6 +170,19 @@ class FilerServer:
         return filechunks.make_chunk(
             result.fid, offset, len(blob), time.time_ns(), e_tag=up.etag
         )
+
+    def append_file(self, path: str, data: bytes, mime: str = "",
+                    collection: str = "", replication: str = "",
+                    ttl: str = "") -> filer_pb2.Entry:
+        """Append bytes as a new chunk (AppendToEntry semantics over HTTP;
+        used by log-style writers like the message broker)."""
+        directory, name = split_path(path)
+        chunk = self._upload_chunk(
+            data, 0, name, mime, collection or self.filer.bucket_collection(path),
+            replication, ttl,
+        )
+        self.filer.append_chunks(directory, name, [chunk])
+        return self.filer.store.find_entry(directory, name)
 
     # -- read path ---------------------------------------------------------
 

@@ -1,0 +1,10 @@
+"""Pub/sub message broker plane.
+
+Reference: weed/messaging/broker — topics persisted as filer log files,
+partition->broker assignment by consistent hashing, gRPC publish/subscribe
+streams (weed/pb/messaging.proto).
+"""
+
+from .broker import MessageBrokerServer
+
+__all__ = ["MessageBrokerServer"]

@@ -1,0 +1,330 @@
+"""Message broker: gRPC pub/sub with filer-backed topic persistence.
+
+Reference: weed/messaging/broker/broker_server.go:24 (broker process
+bound to a filer), broker_grpc_server_publish.go / _subscribe.go
+(client-stream publish, server-stream subscribe with ack),
+consistent_distribution.go (partition -> broker via consistent hashing),
+topic_manager.go (per-partition in-memory log + filer segment files under
+/topics/<namespace>/<topic>/).
+
+Persistence model: every partition appends length-prefixed serialized
+Messages to a filer file /topics/<ns>/<topic>/p<NN>.log (the reference's
+log-file segments).  On first open a partition replays its file into
+memory, so subscribers can start from EARLIEST across broker restarts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import grpc
+
+from ..pb import messaging_pb2 as mq
+from ..pb import rpc as rpclib
+from ..util import glog
+
+TOPICS_DIR = "/topics"
+
+
+def hash_ring_owner(brokers: list[str], key: str) -> str:
+    """Deterministic partition->broker assignment: highest-random-weight
+    (rendezvous) hashing — same distribution contract as the reference's
+    consistent-hash ring with simpler machinery."""
+    if not brokers:
+        raise ValueError("no brokers")
+    return max(
+        brokers,
+        key=lambda b: hashlib.sha256(f"{b}|{key}".encode()).digest(),
+    )
+
+
+class TopicPartition:
+    """One partition: in-memory message list + filer-backed log file."""
+
+    def __init__(self, namespace: str, topic: str, partition: int,
+                 filer_http: str = ""):
+        self.key = f"{namespace}/{topic}/{partition}"
+        self.filer_http = filer_http
+        self.filer_path = (
+            f"{TOPICS_DIR}/{namespace}/{topic}/p{partition:02d}.log"
+        )
+        self.messages: list[mq.Message] = []
+        self.cond = threading.Condition()
+        self._loaded = False
+        self._pending: list[bytes] = []  # serialized, not yet persisted
+
+    # -- persistence -------------------------------------------------------
+
+    def _load(self) -> None:
+        if self._loaded or not self.filer_http:
+            self._loaded = True
+            return
+        self._loaded = True
+        try:
+            url = (f"http://{self.filer_http}"
+                   f"{urllib.parse.quote(self.filer_path)}")
+            with urllib.request.urlopen(url, timeout=30) as r:
+                blob = r.read()
+        except (urllib.error.HTTPError, urllib.error.URLError):
+            return
+        pos = 0
+        while pos + 4 <= len(blob):
+            (ln,) = struct.unpack(">I", blob[pos : pos + 4])
+            pos += 4
+            if pos + ln > len(blob):
+                break
+            m = mq.Message()
+            try:
+                m.ParseFromString(blob[pos : pos + ln])
+            except Exception:
+                break
+            self.messages.append(m)
+            pos += ln
+
+    def flush(self) -> None:
+        """Write batched records to the filer log in ONE append — per-
+        message HTTP roundtrips would make publish latency a full filer
+        write and create one volume chunk per message."""
+        with self.cond:
+            pending, self._pending = self._pending, []
+        if not pending or not self.filer_http:
+            return
+        data = b"".join(pending)
+        url = (f"http://{self.filer_http}"
+               f"{urllib.parse.quote(self.filer_path)}?op=append")
+        req = urllib.request.Request(url, data=data, method="POST",
+                                     headers={"Content-Type":
+                                              "application/octet-stream"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+        except Exception as e:
+            glog.warning("broker: persist %s failed: %s", self.key, e)
+            with self.cond:  # retry on the next flush tick
+                self._pending = pending + self._pending
+
+    # -- pub/sub -----------------------------------------------------------
+
+    def publish(self, m: mq.Message) -> int:
+        blob = m.SerializeToString()
+        with self.cond:
+            self._load()
+            self.messages.append(m)
+            idx = len(self.messages) - 1
+            self._pending.append(struct.pack(">I", len(blob)) + blob)
+            self.cond.notify_all()
+        return idx
+
+    def start_index(self, init: mq.SubscriberMessage.InitMessage) -> int:
+        with self.cond:
+            self._load()
+            sp = init.startPosition
+            if sp == mq.SubscriberMessage.InitMessage.EARLIEST:
+                return 0
+            if sp == mq.SubscriberMessage.InitMessage.TIMESTAMP:
+                for i, m in enumerate(self.messages):
+                    if m.event_time_ns >= init.timestampNs:
+                        return i
+                return len(self.messages)
+            return len(self.messages)  # LATEST
+
+    def read_from(self, index: int, stop: threading.Event):
+        """Yield (index, message) from index onward; tails live."""
+        while not stop.is_set():
+            with self.cond:
+                self._load()
+                if index < len(self.messages):
+                    m = self.messages[index]
+                else:
+                    self.cond.wait(timeout=0.2)
+                    continue
+            yield index, m
+            index += 1
+
+
+class MessageBrokerGrpcService:
+    def __init__(self, server: "MessageBrokerServer"):
+        self.server = server
+
+    def _partition(self, ns: str, topic: str, p: int) -> TopicPartition:
+        return self.server.get_partition(ns, topic, p)
+
+    def Publish(self, request_iterator, context):
+        init = None
+        part: TopicPartition | None = None
+        for req in request_iterator:
+            if req.HasField("init"):
+                init = req.init
+                owner = self.server.owner_of(
+                    init.namespace, init.topic, init.partition
+                )
+                if owner != self.server.grpc_address:
+                    yield mq.PublishResponse(
+                        redirect=mq.PublishResponse.RedirectMessage(
+                            new_broker=owner
+                        )
+                    )
+                    return
+                part = self._partition(
+                    init.namespace, init.topic, init.partition
+                )
+                conf = self.server.topic_configuration(
+                    init.namespace, init.topic
+                )
+                yield mq.PublishResponse(
+                    config=mq.PublishResponse.ConfigMessage(
+                        partition_count=conf.partition_count or 1
+                    )
+                )
+                continue
+            if part is None:
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                              "publish before init")
+            if req.data.is_close:
+                break
+            part.publish(req.data)
+        yield mq.PublishResponse(is_closed=True)
+
+    def Subscribe(self, request_iterator, context):
+        it = iter(request_iterator)
+        first = next(it, None)
+        if first is None or not first.HasField("init"):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "first message must be init")
+        init = first.init
+        part = self._partition(init.namespace, init.topic, init.partition)
+        stop = threading.Event()
+        context.add_callback(stop.set)
+
+        def drain_acks():
+            try:
+                for req in it:
+                    if req.is_close:
+                        return
+            except Exception:
+                pass  # client went away; the context callback stops us
+            finally:
+                stop.set()
+
+        threading.Thread(target=drain_acks, daemon=True).start()
+        for _idx, m in part.read_from(part.start_index(init), stop):
+            yield mq.BrokerMessage(data=m)
+            if m.is_close:
+                return
+
+    def DeleteTopic(self, request, context):
+        self.server.delete_topic(request.namespace, request.topic)
+        return mq.DeleteTopicResponse()
+
+    def ConfigureTopic(self, request, context):
+        self.server.configure_topic(
+            request.namespace, request.topic, request.configuration
+        )
+        return mq.ConfigureTopicResponse()
+
+    def GetTopicConfiguration(self, request, context):
+        resp = mq.GetTopicConfigurationResponse()
+        resp.configuration.CopyFrom(
+            self.server.topic_configuration(request.namespace, request.topic)
+        )
+        return resp
+
+    def FindBroker(self, request, context):
+        owner = self.server.owner_of(
+            request.namespace, request.topic, request.parition
+        )
+        return mq.FindBrokerResponse(broker=owner)
+
+
+class MessageBrokerServer:
+    """`weed msgBroker` equivalent: one broker process bound to a filer."""
+
+    def __init__(self, filer: str = "", port: int = 17777,
+                 ip: str = "127.0.0.1", peers: list[str] | None = None):
+        self.ip = ip
+        self.port = port
+        self.grpc_address = f"{ip}:{port}"
+        self.filer_http = filer
+        # quorum of brokers for partition ownership; defaults to just us
+        self.brokers = sorted(set((peers or []) + [self.grpc_address]))
+        self._partitions: dict[str, TopicPartition] = {}
+        self._topic_conf: dict[str, mq.TopicConfiguration] = {}
+        self._lock = threading.Lock()
+        self._grpc_server = None
+        self._stop = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._grpc_server = rpclib.serve(
+            [(rpclib.MESSAGING, MessageBrokerGrpcService(self))], self.port
+        )
+        threading.Thread(target=self._flush_loop, daemon=True).start()
+        glog.info("message broker started grpc=%d filer=%s brokers=%s",
+                  self.port, self.filer_http, self.brokers)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.flush()
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.5)
+
+    def flush(self) -> None:
+        with self._lock:
+            parts = list(self._partitions.values())
+        for part in parts:
+            part.flush()
+
+    def _flush_loop(self, interval: float = 0.2) -> None:
+        while not self._stop.wait(interval):
+            self.flush()
+
+    # -- topics ------------------------------------------------------------
+
+    def get_partition(self, ns: str, topic: str, p: int) -> TopicPartition:
+        key = f"{ns}/{topic}/{p}"
+        with self._lock:
+            part = self._partitions.get(key)
+            if part is None:
+                part = TopicPartition(ns, topic, p, self.filer_http)
+                self._partitions[key] = part
+            return part
+
+    def owner_of(self, ns: str, topic: str, partition: int) -> str:
+        return hash_ring_owner(self.brokers, f"{ns}/{topic}/{partition}")
+
+    def topic_configuration(self, ns: str, topic: str) -> mq.TopicConfiguration:
+        with self._lock:
+            conf = self._topic_conf.get(f"{ns}/{topic}")
+            if conf is None:
+                conf = mq.TopicConfiguration(partition_count=1)
+            return conf
+
+    def configure_topic(self, ns: str, topic: str,
+                        conf: mq.TopicConfiguration) -> None:
+        stored = mq.TopicConfiguration()
+        stored.CopyFrom(conf)
+        with self._lock:
+            self._topic_conf[f"{ns}/{topic}"] = stored
+
+    def delete_topic(self, ns: str, topic: str) -> None:
+        prefix = f"{ns}/{topic}/"
+        with self._lock:
+            for key in [k for k in self._partitions if k.startswith(prefix)]:
+                del self._partitions[key]
+            self._topic_conf.pop(f"{ns}/{topic}", None)
+        if self.filer_http:
+            url = (f"http://{self.filer_http}"
+                   f"{urllib.parse.quote(f'{TOPICS_DIR}/{ns}/{topic}')}"
+                   "?recursive=true&ignoreRecursiveError=true")
+            req = urllib.request.Request(url, method="DELETE")
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    r.read()
+            except urllib.error.HTTPError:
+                pass

@@ -1,0 +1,30 @@
+"""Filer event notification: publish metadata mutations to message queues.
+
+Reference: weed/notification/configuration.go (a single configured
+Queue publisher receiving (key, EventNotification) for every filer
+mutation) with backends under weed/notification/{log,kafka,aws_sqs,
+google_pub_sub,gocdk_pub_sub}.
+
+Here: ``make_publisher(kind, **opts)`` returns a Publisher.  In-process
+backends (log, file, memory) are always available; network backends
+(kafka/sqs/pubsub) need client libraries this image doesn't ship, so they
+are registered but raise a clear ConfigurationError at construction.
+"""
+
+from .publishers import (
+    ConfigurationError,
+    FilePublisher,
+    LogPublisher,
+    MemoryPublisher,
+    Publisher,
+    make_publisher,
+)
+
+__all__ = [
+    "Publisher",
+    "LogPublisher",
+    "FilePublisher",
+    "MemoryPublisher",
+    "ConfigurationError",
+    "make_publisher",
+]

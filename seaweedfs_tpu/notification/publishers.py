@@ -1,0 +1,110 @@
+"""Notification publisher backends.
+
+Reference: weed/notification/log_queue (glog), aws_sqs, kafka,
+google_pub_sub, gocdk_pub_sub — all implement SendMessage(key, message).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import threading
+
+from ..pb import filer_pb2
+from ..util import glog
+
+
+class ConfigurationError(RuntimeError):
+    pass
+
+
+class Publisher:
+    """SendMessage(key, EventNotification) — the queue interface
+    (notification/configuration.go:12)."""
+
+    def publish(self, key: str, event: filer_pb2.EventNotification) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class LogPublisher(Publisher):
+    """Logs every event (notification/log/log_queue.go)."""
+
+    def publish(self, key: str, event: filer_pb2.EventNotification) -> None:
+        glog.info("notify %s: old=%s new=%s", key,
+                  event.old_entry.name, event.new_entry.name)
+
+
+class MemoryPublisher(Publisher):
+    """Collects events in memory — the test double."""
+
+    def __init__(self):
+        self.events: list[tuple[str, filer_pb2.EventNotification]] = []
+        self._lock = threading.Lock()
+
+    def publish(self, key: str, event: filer_pb2.EventNotification) -> None:
+        copied = filer_pb2.EventNotification()
+        copied.CopyFrom(event)
+        with self._lock:
+            self.events.append((key, copied))
+
+
+class FilePublisher(Publisher):
+    """Appends JSON lines to a local file — durable local queue analogue
+    of the gocdk file backend; each line carries the serialized event."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, "ab")
+        self._lock = threading.Lock()
+
+    def publish(self, key: str, event: filer_pb2.EventNotification) -> None:
+        line = json.dumps({
+            "key": key,
+            "event": base64.b64encode(event.SerializeToString()).decode(),
+        })
+        with self._lock:
+            self._f.write(line.encode() + b"\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+    @staticmethod
+    def read_events(path: str):
+        """-> [(key, EventNotification)] parsed back from the file."""
+        out = []
+        with open(path) as f:
+            for line in f:
+                d = json.loads(line)
+                ev = filer_pb2.EventNotification()
+                ev.ParseFromString(base64.b64decode(d["event"]))
+                out.append((d["key"], ev))
+        return out
+
+
+_GATED = {
+    "kafka": "kafka-python",
+    "aws_sqs": "boto3",
+    "google_pub_sub": "google-cloud-pubsub",
+    "gocdk_pub_sub": "gocloud",
+}
+
+
+def make_publisher(kind: str, **opts) -> Publisher:
+    if kind in ("log", ""):
+        return LogPublisher()
+    if kind == "memory":
+        return MemoryPublisher()
+    if kind == "file":
+        return FilePublisher(opts["path"])
+    if kind in _GATED:
+        raise ConfigurationError(
+            f"notification backend {kind!r} needs the {_GATED[kind]} client "
+            "library, which is not available in this deployment; use "
+            "'log' or 'file', or install the dependency"
+        )
+    raise ConfigurationError(f"unknown notification backend {kind!r}")

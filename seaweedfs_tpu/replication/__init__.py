@@ -1,0 +1,19 @@
+"""Cross-cluster replication: replay filer metadata events into sinks.
+
+Reference: weed/replication/replicator.go:18 (event -> sink op mapping),
+sink/{filersink,localsink,...}, source/filer_source.go, driven by
+`weed filer.replicate` / `filer.sync` / `filer.backup`
+(weed/command/filer_replicate.go, filer_sync.go, filer_backup.go).
+"""
+
+from .replicator import Replicator
+from .sink import FilerSink, LocalSink
+from .source import FilerSource, subscribe_metadata
+
+__all__ = [
+    "Replicator",
+    "FilerSink",
+    "LocalSink",
+    "FilerSource",
+    "subscribe_metadata",
+]

@@ -1,0 +1,68 @@
+"""Replicator: map a filer metadata event onto sink operations.
+
+Reference: weed/replication/replicator.go:18,36 — the create/delete/
+update/rename decision tree over (old_entry, new_entry, new_parent_path).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..pb import filer_pb2
+from ..util import glog
+from .sink import Sink
+from .source import FilerSource, subscribe_metadata
+
+
+class Replicator:
+    def __init__(self, source: FilerSource, sink: Sink,
+                 path_prefix: str = "/", signature: int = 0):
+        """``signature`` is passed to the metadata subscription so events
+        this replicator's own sink caused (carrying the same signature)
+        are filtered out — required for loop-free bidirectional sync."""
+        self.source = source
+        self.sink = sink
+        self.path_prefix = path_prefix
+        self.signature = signature
+        self.replicated = 0
+
+    def process_event(self, directory: str,
+                      event: filer_pb2.EventNotification) -> None:
+        """One event -> sink ops (replicator.go Replicate)."""
+        has_old = bool(event.old_entry.name)
+        has_new = bool(event.new_entry.name)
+        if not has_old and not has_new:
+            return
+        if has_old and not has_new:
+            self.sink.delete_entry(
+                directory, event.old_entry.name, event.old_entry.is_directory
+            )
+        elif has_new and not has_old:
+            data = self.source.read_entry_data(directory, event.new_entry)
+            self.sink.create_entry(directory, event.new_entry, data)
+        else:  # update or rename
+            new_dir = event.new_parent_path or directory
+            if (event.new_parent_path
+                    and event.new_parent_path != directory) or (
+                    event.old_entry.name != event.new_entry.name):
+                self.sink.delete_entry(
+                    directory, event.old_entry.name,
+                    event.old_entry.is_directory,
+                )
+            data = self.source.read_entry_data(new_dir, event.new_entry)
+            self.sink.create_entry(new_dir, event.new_entry, data)
+        self.replicated += 1
+
+    def run(self, stop_event: threading.Event | None = None,
+            since_ns: int = 0) -> None:
+        """Consume the source filer's metadata stream until stopped."""
+        for resp in subscribe_metadata(
+            self.source.filer_http, self.path_prefix, since_ns,
+            signature=self.signature,
+        ):
+            if stop_event is not None and stop_event.is_set():
+                return
+            try:
+                self.process_event(resp.directory, resp.event_notification)
+            except Exception as e:
+                glog.warning("replicate %s failed: %s", resp.directory, e)

@@ -1,0 +1,163 @@
+"""Replication sinks: where replayed filer events land.
+
+Reference: weed/replication/sink/ — ReplicationSink interface
+(CreateEntry/UpdateEntry/DeleteEntry) with filersink (another cluster),
+localsink (a directory), plus cloud sinks (S3/GCS/Azure/B2) that map to
+the same three ops.  The S3 sink here targets any S3 endpoint — including
+this framework's own gateway — over plain HTTP.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import urllib.error
+import urllib.parse
+import urllib.request
+
+from ..pb import filer_pb2
+
+
+class Sink:
+    def create_entry(self, directory: str, entry: filer_pb2.Entry,
+                     data: bytes) -> None:
+        raise NotImplementedError
+
+    def update_entry(self, directory: str, entry: filer_pb2.Entry,
+                     data: bytes) -> None:
+        self.create_entry(directory, entry, data)
+
+    def delete_entry(self, directory: str, name: str,
+                     is_directory: bool) -> None:
+        raise NotImplementedError
+
+
+class LocalSink(Sink):
+    """Mirror into a local directory tree (replication/sink/localsink)."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, directory: str, name: str = "") -> str:
+        rel = f"{directory.strip('/')}/{name}".strip("/")
+        return os.path.join(self.root, rel) if rel else self.root
+
+    def create_entry(self, directory, entry, data):
+        path = self._path(directory, entry.name)
+        if entry.is_directory:
+            os.makedirs(path, exist_ok=True)
+            return
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+
+    def delete_entry(self, directory, name, is_directory):
+        path = self._path(directory, name)
+        if is_directory:
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+
+class FilerSink(Sink):
+    """Replay into another filer cluster over its HTTP surface
+    (replication/sink/filersink; data is re-uploaded so the target
+    cluster owns its own chunks).
+
+    ``signature`` marks every mutation this sink performs, so a metadata
+    subscription on the TARGET filer with the same signature skips them —
+    the loop-prevention contract of bidirectional filer.sync
+    (command/filer_sync.go)."""
+
+    def __init__(self, filer_http: str, signature: int = 0):
+        self.filer_http = filer_http
+        self.signature = signature
+
+    def _url(self, directory: str, name: str = "",
+             extra_q: str = "") -> str:
+        path = f"{directory.rstrip('/')}/{name}" if name else directory
+        if not path.startswith("/"):
+            path = "/" + path
+        q = []
+        if self.signature:
+            q.append(f"signature={self.signature}")
+        if extra_q:
+            q.append(extra_q)
+        qs = ("?" + "&".join(q)) if q else ""
+        return f"http://{self.filer_http}{urllib.parse.quote(path)}{qs}"
+
+    def create_entry(self, directory, entry, data):
+        if entry.is_directory:
+            return  # target filer auto-creates parents on file writes
+        req = urllib.request.Request(
+            self._url(directory, entry.name),
+            data=data,
+            method="PUT",
+            headers={
+                "Content-Type": entry.attributes.mime
+                or "application/octet-stream"
+            },
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            r.read()
+
+    def delete_entry(self, directory, name, is_directory):
+        extra = "recursive=true&ignoreRecursiveError=true" if is_directory else ""
+        req = urllib.request.Request(
+            self._url(directory, name, extra), method="DELETE"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                r.read()
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
+class S3Sink(Sink):
+    """Replay into an S3 bucket over plain HTTP (replication/sink/s3sink).
+
+    Works unauthenticated against gateways with auth disabled (e.g. this
+    framework's own s3 server in its default dev mode); for signed access
+    front it with a proxy or extend with a signer.
+    """
+
+    def __init__(self, endpoint: str, bucket: str, prefix: str = ""):
+        self.endpoint = endpoint
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+
+    def _key(self, directory: str, name: str = "") -> str:
+        rel = f"{directory.strip('/')}/{name}".strip("/")
+        return f"{self.prefix}/{rel}".strip("/") if self.prefix else rel
+
+    def _url(self, key: str) -> str:
+        return (f"http://{self.endpoint}/{self.bucket}/"
+                f"{urllib.parse.quote(key)}")
+
+    def create_entry(self, directory, entry, data):
+        if entry.is_directory:
+            return
+        req = urllib.request.Request(
+            self._url(self._key(directory, entry.name)),
+            data=data,
+            method="PUT",
+            headers={
+                "Content-Type": entry.attributes.mime
+                or "application/octet-stream"
+            },
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            r.read()
+
+    def delete_entry(self, directory, name, is_directory):
+        req = urllib.request.Request(
+            self._url(self._key(directory, name)), method="DELETE"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                r.read()
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise

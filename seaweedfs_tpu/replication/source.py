@@ -1,0 +1,57 @@
+"""Replication source: a filer's metadata event stream + chunk reader.
+
+Reference: weed/replication/source/filer_source.go (lookup + read chunk
+data from the source cluster) and the SubscribeMetadata consumption loop
+in weed/command/filer_replicate.go.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+import urllib.request
+
+from ..pb import filer_pb2
+from ..pb import rpc as rpclib
+
+GRPC_PORT_OFFSET = 10000
+
+
+def _grpc_addr(http_addr: str) -> str:
+    host, _, port = http_addr.partition(":")
+    return f"{host}:{int(port) + GRPC_PORT_OFFSET}"
+
+
+def subscribe_metadata(filer_http: str, path_prefix: str = "/",
+                       since_ns: int = 0, client_name: str = "replicate",
+                       signature: int = 0):
+    """Yield SubscribeMetadataResponse events from a filer (filer.proto:20).
+
+    Blocking generator; the caller runs it in its own thread and stops by
+    closing the underlying channel / killing the thread.
+    """
+    stub = rpclib.filer_stub(_grpc_addr(filer_http))
+    yield from stub.SubscribeMetadata(
+        filer_pb2.SubscribeMetadataRequest(
+            client_name=client_name,
+            path_prefix=path_prefix,
+            since_ns=since_ns,
+            signature=signature,
+        )
+    )
+
+
+class FilerSource:
+    """Reads file content for replicated entries from the source filer."""
+
+    def __init__(self, filer_http: str):
+        self.filer_http = filer_http
+
+    def read_entry_data(self, directory: str, entry: filer_pb2.Entry) -> bytes:
+        if entry.content:
+            return bytes(entry.content)
+        if not entry.chunks:
+            return b""
+        path = f"{directory.rstrip('/')}/{entry.name}"
+        url = f"http://{self.filer_http}{urllib.parse.quote(path)}"
+        with urllib.request.urlopen(url, timeout=60) as r:
+            return r.read()
