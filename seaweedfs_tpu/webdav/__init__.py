@@ -1,0 +1,9 @@
+"""WebDAV gateway over the filer namespace.
+
+Reference: weed/server/webdav_server.go:45 (golang.org/x/net/webdav FS
+adapter over filer gRPC), `weed webdav` command.
+"""
+
+from .server import WebDavServer
+
+__all__ = ["WebDavServer"]
