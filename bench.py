@@ -181,55 +181,94 @@ def _cpu_rate(shard_bytes: int = 16 << 20, iters: int = 3) -> float:
     return (10 * shard_bytes * iters) / dt / 1e9
 
 
-def _e2e_in_subprocess(timeout_s: float = 420.0) -> dict:
-    """Run the e2e pipeline in a worker process with a hard deadline.
+def _stage_in_subprocess(
+    flag: str, timeout_s: float, attempts: int = 3, backoff_s: float = 15.0
+) -> dict:
+    """Run one TPU-touching bench stage in a worker process, retried.
 
-    The tunnel transport has been observed to wedge on large transfers; a
-    thread can't be killed, a subprocess can — the headline metric must
-    never hang the driver's bench run.
+    The tunnel transport has been observed to (a) refuse backend init
+    transiently ("Unable to initialize backend 'axon'") and (b) wedge on
+    large transfers.  A thread can't be killed, a subprocess can — and a
+    refused init one minute is often fine the next.  The headline metric
+    must never hang or rc!=0 the driver's bench run, so every TPU stage
+    lives behind this bounded retry loop.
     """
     import os
     import subprocess
     import sys
 
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--e2e-only"],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        return {"error": f"e2e timed out after {timeout_s:.0f}s"}
-    for line in reversed(proc.stdout.strip().splitlines()):
+    last = "no attempt ran"
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(backoff_s)
         try:
-            parsed = json.loads(line)
-        except (json.JSONDecodeError, ValueError):
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), flag],
+                capture_output=True,
+                text=True,
+                timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            last = f"{flag} timed out after {timeout_s:.0f}s"
             continue
-        if isinstance(parsed, dict):
-            return parsed
-    return {"error": f"e2e rc={proc.returncode}: {proc.stderr[-300:]}"}
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                parsed = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if isinstance(parsed, dict) and "error" not in parsed:
+                return parsed
+            if isinstance(parsed, dict):
+                last = parsed["error"]
+                break
+        else:
+            last = f"{flag} rc={proc.returncode}: {proc.stderr[-300:]}"
+    return {"error": last}
 
 
 def main() -> None:
     import sys
 
     if "--e2e-only" in sys.argv:
-        print(json.dumps(_e2e_rates()))
+        try:
+            print(json.dumps(_e2e_rates()))
+        except Exception as exc:  # noqa: BLE001 — must emit parseable JSON
+            print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
         return
-    tpu = _tpu_pallas_rate()
+    if "--kernel-only" in sys.argv:
+        try:
+            print(json.dumps(_tpu_pallas_rate()))
+        except Exception as exc:  # noqa: BLE001
+            print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
+        return
+
     cpu = _cpu_rate()
-    e2e = _e2e_in_subprocess()
-    out = {
-        "metric": "ec_encode_GBps",
-        "value": round(tpu["rate"], 2),
-        "unit": "GB/s",
-        "vs_baseline": round(tpu["rate"] / cpu, 1) if cpu else None,
-        "impl": "pallas_swar_u32",
-        "cpu_simd_GBps": round(cpu, 3),
-        "sweep_bytes": tpu["bytes"],
-        "seconds": round(tpu["seconds"], 4),
-    }
+    tpu = _stage_in_subprocess("--kernel-only", timeout_s=300.0)
+    e2e = _stage_in_subprocess("--e2e-only", timeout_s=420.0, attempts=2)
+    if "rate" in tpu:
+        out = {
+            "metric": "ec_encode_GBps",
+            "value": round(tpu["rate"], 2),
+            "unit": "GB/s",
+            "vs_baseline": round(tpu["rate"] / cpu, 1) if cpu else None,
+            "impl": "pallas_swar_u32",
+            "cpu_simd_GBps": round(cpu, 3),
+            "sweep_bytes": tpu["bytes"],
+            "seconds": round(tpu["seconds"], 4),
+        }
+    else:
+        # TPU unreachable after bounded retries: degrade to the host CPU
+        # SIMD codec so the driver still records a real measured number,
+        # with the failure visible in `error`.
+        out = {
+            "metric": "ec_encode_GBps",
+            "value": round(cpu, 3),
+            "unit": "GB/s",
+            "vs_baseline": 1.0,
+            "impl": "cpu_simd_fallback",
+            "cpu_simd_GBps": round(cpu, 3),
+            "error": (tpu.get("error") or "unknown")[:500],
+        }
     if "e2e_rate" in e2e:
         out["ec_encode_e2e_GBps"] = round(e2e["e2e_rate"], 2)
         out["ec_rebuild_GBps"] = round(e2e["rebuild_rate"], 2)

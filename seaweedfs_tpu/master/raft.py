@@ -138,7 +138,17 @@ class RaftNode:
                 },
                 f,
             )
+            # raft's stable-storage requirement: term/vote must survive a
+            # crash BEFORE any RPC response leaks them, or a node can vote
+            # twice in one term after power loss
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self.state_path)
+        dir_fd = os.open(os.path.dirname(self.state_path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
 
     # -- log helpers ---------------------------------------------------------
 

@@ -57,6 +57,10 @@ class TopicPartition:
         self.cond = threading.Condition()
         self._loaded = False
         self._pending: list[bytes] = []  # serialized, not yet persisted
+        # serializes whole take-pending-and-append sequences: flush() can be
+        # entered from both _flush_loop and stop(), and two in-flight appends
+        # could land out of publish order in the filer log
+        self._flush_lock = threading.Lock()
 
     # -- persistence -------------------------------------------------------
 
@@ -90,23 +94,24 @@ class TopicPartition:
         """Write batched records to the filer log in ONE append — per-
         message HTTP roundtrips would make publish latency a full filer
         write and create one volume chunk per message."""
-        with self.cond:
-            pending, self._pending = self._pending, []
-        if not pending or not self.filer_http:
-            return
-        data = b"".join(pending)
-        url = (f"http://{self.filer_http}"
-               f"{urllib.parse.quote(self.filer_path)}?op=append")
-        req = urllib.request.Request(url, data=data, method="POST",
-                                     headers={"Content-Type":
-                                              "application/octet-stream"})
-        try:
-            with urllib.request.urlopen(req, timeout=30) as r:
-                r.read()
-        except Exception as e:
-            glog.warning("broker: persist %s failed: %s", self.key, e)
-            with self.cond:  # retry on the next flush tick
-                self._pending = pending + self._pending
+        with self._flush_lock:
+            with self.cond:
+                pending, self._pending = self._pending, []
+            if not pending or not self.filer_http:
+                return
+            data = b"".join(pending)
+            url = (f"http://{self.filer_http}"
+                   f"{urllib.parse.quote(self.filer_path)}?op=append")
+            req = urllib.request.Request(url, data=data, method="POST",
+                                         headers={"Content-Type":
+                                                  "application/octet-stream"})
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    r.read()
+            except Exception as e:
+                glog.warning("broker: persist %s failed: %s", self.key, e)
+                with self.cond:  # retry on the next flush tick
+                    self._pending = pending + self._pending
 
     # -- pub/sub -----------------------------------------------------------
 

@@ -45,12 +45,10 @@ EXP_TABLE, LOG_TABLE = _generate_tables()
 @functools.cache
 def mul_table() -> np.ndarray:
     """Full 256x256 GF multiplication table (64KB), uint8."""
-    a = np.arange(256, dtype=np.int32)
-    la = LOG_TABLE[a]
-    t = np.zeros((256, 256), dtype=np.uint8)
+    la = LOG_TABLE[np.arange(256, dtype=np.int32)]
     # t[a, b] = exp[(log a + log b) % 255], 0 if either is 0
     s = (la[:, None] + la[None, :]) % 255
-    t = EXP_TABLE[s]
+    t = EXP_TABLE[s]  # fancy indexing allocates the fresh table
     t[0, :] = 0
     t[:, 0] = 0
     return t

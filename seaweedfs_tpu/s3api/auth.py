@@ -88,10 +88,14 @@ def canonical_request(
     canon_headers = "".join(
         f"{h}:{' '.join(headers.get(h, '').split())}\n" for h in signed_headers
     )
+    # S3 does NOT normalize paths: SDKs sign the raw (still percent-encoded)
+    # request path verbatim, so keys containing %2F etc. must reach the
+    # canonical request untouched (AWS SigV4 spec, "do not normalize URI
+    # paths for Amazon S3").
     return "\n".join(
         [
             method,
-            _uri_encode(urllib.parse.unquote(raw_path), encode_slash=False) or "/",
+            raw_path or "/",
             canonical_query(query, drop_query),
             canon_headers,
             ";".join(signed_headers),
@@ -301,6 +305,7 @@ class IdentityAccessManagement:
             access_key, got_sig = header[len("AWS "):].split(":", 1)
         except ValueError:
             raise AuthError("AuthorizationHeaderMalformed", "bad v2 header")
+        self._check_v2_freshness(req)
         found = self.lookup(access_key)
         if not found:
             raise AuthError("InvalidAccessKeyId", f"unknown key {access_key}")
@@ -312,6 +317,27 @@ class IdentityAccessManagement:
         if not hmac.compare_digest(base64.b64encode(want).decode(), got_sig):
             raise AuthError("SignatureDoesNotMatch", "v2 signature mismatch")
         return ident
+
+    @staticmethod
+    def _check_v2_freshness(req: "S3HttpRequest", window_s: int = 900) -> None:
+        """V2 replay bound: like V4's 15-minute skew window, a captured
+        V2-signed request must not verify forever.  x-amz-date overrides
+        Date when both are present (the signed one wins, per the V2 spec)."""
+        import email.utils
+
+        raw = req.headers.get("x-amz-date") or req.headers.get("date", "")
+        if not raw:
+            raise AuthError("AccessDenied", "v2 request missing Date")
+        try:
+            t0 = email.utils.parsedate_to_datetime(raw)
+        except (TypeError, ValueError):
+            raise AuthError("AccessDenied", "bad v2 Date header")
+        if t0.tzinfo is None:
+            t0 = t0.replace(tzinfo=datetime.timezone.utc)
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if abs((now - t0).total_seconds()) > window_s:
+            raise AuthError("RequestTimeTooSkewed",
+                            "request timestamp too far from server time")
 
     _V2_SUBRESOURCES = (
         "acl", "delete", "lifecycle", "location", "logging", "notification",
@@ -391,11 +417,18 @@ def decode_streaming_body(body: bytes, req: S3HttpRequest | None = None) -> byte
     Verification follows the spec: each chunk signature signs
     AWS4-HMAC-SHA256-PAYLOAD / date / scope / prev-sig / sha256("") /
     sha256(chunk-data), chained from the seed (header) signature.
+
+    A stream is only complete once the signed terminal 0-size chunk has been
+    seen (and verified) — a body truncated at any chunk boundary otherwise
+    passes every per-chunk check (reference: chunked_reader_v4.go fails such
+    streams with ErrUnexpectedEOF).  When the client signed an
+    x-amz-decoded-content-length header, the decoded size must match it too.
     """
     out = bytearray()
     pos = 0
     prev_sig = req.seed_signature if req else ""
     verify = bool(req and req.seed_signature and req.sig_secret)
+    saw_final_chunk = False
     while pos < len(body):
         nl = body.find(b"\r\n", pos)
         if nl < 0:
@@ -437,5 +470,19 @@ def decode_streaming_body(body: bytes, req: S3HttpRequest | None = None) -> byte
         out += data
         pos = nl + 2 + size + 2  # skip trailing CRLF
         if size == 0:
+            saw_final_chunk = True
             break
+    if not saw_final_chunk:
+        raise AuthError("IncompleteBody",
+                        "stream ended before the terminal chunk", status=400)
+    declared = (req.headers.get("x-amz-decoded-content-length") if req else None)
+    if declared is not None:
+        try:
+            if int(declared) != len(out):
+                raise AuthError("IncompleteBody",
+                                "decoded length != x-amz-decoded-content-length",
+                                status=400)
+        except ValueError:
+            raise AuthError("IncompleteBody",
+                            "bad x-amz-decoded-content-length", status=400)
     return bytes(out)

@@ -196,26 +196,6 @@ class VolumeGrpcService:
             collection=v.collection,
         )
 
-    def VolumeServerStatus(self, request, context):
-        resp = vs.VolumeServerStatusResponse()
-        for loc in self.store.locations:
-            st = os.statvfs(loc.directory)
-            all_b = st.f_blocks * st.f_frsize
-            free_b = st.f_bavail * st.f_frsize
-            resp.disk_statuses.add(
-                dir=loc.directory,
-                all=all_b,
-                used=all_b - free_b,
-                free=free_b,
-                percent_free=100.0 * free_b / all_b if all_b else 0.0,
-                percent_used=100.0 * (all_b - free_b) / all_b if all_b else 0.0,
-            )
-        return resp
-
-    def VolumeServerLeave(self, request, context):
-        self.server.stop_heartbeat()
-        return vs.VolumeServerLeaveResponse()
-
     # -- bulk file copy ---------------------------------------------------
 
     def CopyFile(self, request, context):

@@ -201,32 +201,28 @@ class VolumeServer:
 
     def _make_ec_fetcher(self, vid: int):
         """FetchFn for EcVolume: resolve shard locations via the master
-        (cached briefly, like store_ec.go's TTL-tiered cache) and stream the
-        interval from the owning peer via VolumeEcShardRead."""
+        through a tiered-TTL cache (found/empty/error tiers, negative
+        caching — store_ec.go:223-264) and stream the interval from the
+        owning peer via VolumeEcShardRead."""
         from ..pb import volume_server_pb2 as vs
+        from ..wdclient.location_cache import TieredLocationCache
 
-        cache: dict = {"at": 0.0, "locations": {}}
         me = f"{self.ip}:{self.port}"
 
         def lookup() -> dict[int, list[str]]:
-            now = time.monotonic()
-            if now - cache["at"] < 10.0 and cache["locations"]:
-                return cache["locations"]
             master = self.current_leader or self.master_addresses[0]
-            try:
-                resp = rpclib.master_stub(master, timeout=5).LookupEcVolume(
-                    master_pb2.LookupEcVolumeRequest(volume_id=vid)
-                )
-            except grpc.RpcError:
-                return cache["locations"]
+            resp = rpclib.master_stub(master, timeout=5).LookupEcVolume(
+                master_pb2.LookupEcVolumeRequest(volume_id=vid)
+            )
             locations: dict[int, list[str]] = {}
             for e in resp.shard_id_locations:
                 locations[e.shard_id] = [loc.url for loc in e.locations]
-            cache["at"], cache["locations"] = now, locations
             return locations
 
+        cache = TieredLocationCache(lookup)
+
         def fetch(shard_id: int, offset: int, length: int) -> bytes | None:
-            for url in lookup().get(shard_id, []):
+            for url in cache.get().get(shard_id, []):
                 if url == me:
                     continue
                 host, port = url.rsplit(":", 1)

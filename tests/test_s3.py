@@ -132,6 +132,58 @@ def test_streaming_chunk_decode_and_verify():
         s3auth.decode_streaming_body(bad, req)
 
 
+def test_streaming_truncation_rejected():
+    """A signed stream cut at a chunk boundary (no terminal 0-size chunk)
+    must fail, and a decoded length differing from the signed
+    x-amz-decoded-content-length must fail (chunked_reader_v4.go behavior)."""
+    body = b"5;chunk-signature=" + b"0" * 64 + b"\r\nhello\r\n"
+    with pytest.raises(s3auth.AuthError) as ei:
+        s3auth.decode_streaming_body(body)  # unverified decode, still gated
+    assert ei.value.code == "IncompleteBody"
+    whole = body + b"0;chunk-signature=" + b"0" * 64 + b"\r\n\r\n"
+    assert s3auth.decode_streaming_body(whole) == b"hello"
+    req = s3auth.S3HttpRequest(
+        method="PUT", raw_path="/b/k", raw_query="",
+        headers={"x-amz-decoded-content-length": "9"},
+    )
+    with pytest.raises(s3auth.AuthError) as ei:
+        s3auth.decode_streaming_body(whole, req)
+    assert ei.value.code == "IncompleteBody"
+
+
+def test_v2_replay_window():
+    """V2 header auth must reject requests whose Date is outside the
+    15-minute skew window (same bound V4 enforces)."""
+    import base64
+    import email.utils
+    import hmac as _hmac
+
+    iam = s3auth.IdentityAccessManagement()
+    iam.load_config({"identities": [{
+        "name": "u", "credentials": [{"accessKey": "AK", "secretKey": "SK"}],
+        "actions": ["Admin"],
+    }]})
+
+    def v2_req(date_header):
+        req = s3auth.S3HttpRequest(
+            method="GET", raw_path="/b/k", raw_query="",
+            headers={"date": date_header},
+        )
+        sts = iam._v2_string_to_sign(req)
+        sig = base64.b64encode(
+            _hmac.new(b"SK", sts.encode(), hashlib.sha1).digest()
+        ).decode()
+        req.headers["authorization"] = f"AWS AK:{sig}"
+        return req
+
+    fresh = v2_req(email.utils.formatdate(usegmt=True))
+    assert iam.authenticate(fresh).name == "u"
+    stale = v2_req("Tue, 27 Mar 2007 19:36:42 +0000")
+    with pytest.raises(s3auth.AuthError) as ei:
+        iam.authenticate(stale)
+    assert ei.value.code == "RequestTimeTooSkewed"
+
+
 # -- live gateway ------------------------------------------------------------
 
 
