@@ -88,6 +88,34 @@ def cmd_filer(args) -> None:
     _wait()
 
 
+def cmd_mount(args) -> None:
+    from .mount.fuse import FuseMount, available
+    from .mount.wfs import WFS
+
+    if not available():
+        raise SystemExit(
+            "mount: libfuse.so.2 or /dev/fuse unavailable on this host"
+        )
+    filer_http = args.filer
+    wfs = WFS(
+        filer_grpc=_grpc_addr(filer_http),
+        filer_http=filer_http,
+        chunk_size_mb=args.chunkSizeLimitMB,
+        collection=args.collection,
+        replication=args.replication,
+        cache_dir=args.cacheDir or None,
+        cache_mem_mb=args.cacheCapacityMB,
+    )
+    wfs.start_meta_subscription()
+    m = FuseMount(wfs, args.dir, allow_other=args.allowOthers)
+    m.start()
+    print(f"mounted {filer_http} on {args.dir}")
+    try:
+        _wait()
+    finally:
+        m.stop()
+
+
 def cmd_msg_broker(args) -> None:
     from .messaging.broker import MessageBrokerServer
 
@@ -298,6 +326,17 @@ def main(argv=None) -> None:
     f.add_argument("-maxMB", type=int, default=4)
     f.add_argument("-metricsPort", type=int, default=0)
     f.set_defaults(fn=cmd_filer)
+
+    mnt = sub.add_parser("mount")
+    mnt.add_argument("-filer", default="127.0.0.1:8888")
+    mnt.add_argument("-dir", required=True)
+    mnt.add_argument("-collection", default="")
+    mnt.add_argument("-replication", default="")
+    mnt.add_argument("-chunkSizeLimitMB", type=int, default=4)
+    mnt.add_argument("-cacheDir", default="")
+    mnt.add_argument("-cacheCapacityMB", type=int, default=32)
+    mnt.add_argument("-allowOthers", action="store_true")
+    mnt.set_defaults(fn=cmd_mount)
 
     mb = sub.add_parser("msgBroker")
     mb.add_argument("-filer", default="127.0.0.1:8888")

@@ -1,0 +1,26 @@
+"""FUSE mount layer: kernel VFS over the filer (layer 9 of SURVEY.md §1).
+
+- wfs.py         — the filesystem core (kernel-agnostic, fully tested)
+- dirty_pages.py — write-back interval buffering
+- meta_cache.py  — entry cache with listing completeness + subscription
+- fuse.py        — ctypes binding to libfuse.so.2 (gated on availability)
+"""
+
+from .dirty_pages import ContinuousIntervals
+from .meta_cache import MetaCache
+from .wfs import WFS, FileHandle, FuseError
+
+__all__ = [
+    "WFS",
+    "FileHandle",
+    "FuseError",
+    "ContinuousIntervals",
+    "MetaCache",
+    "mount_available",
+]
+
+
+def mount_available() -> bool:
+    from .fuse import available
+
+    return available()

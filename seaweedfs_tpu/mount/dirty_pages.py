@@ -1,0 +1,83 @@
+"""Dirty-page interval buffering for the mount write path.
+
+Reference: weed/filesys/dirty_page_interval.go — writes land in an ordered
+list of continuous byte intervals; an overlapping write punches out the
+older bytes (newest wins), adjacent intervals merge, and flush drains the
+intervals as upload units.  Keeping intervals (not fixed pages) means a
+sequential writer produces exactly one growing interval and uploads one
+chunk per max-chunk window, with no page-size write amplification.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class PageInterval:
+    offset: int
+    data: bytearray
+
+    @property
+    def stop(self) -> int:
+        return self.offset + len(self.data)
+
+
+class ContinuousIntervals:
+    """Sorted, disjoint, merged dirty intervals of one open file."""
+
+    def __init__(self):
+        self.intervals: list[PageInterval] = []
+
+    def total_bytes(self) -> int:
+        return sum(len(iv.data) for iv in self.intervals)
+
+    def max_stop(self) -> int:
+        return max((iv.stop for iv in self.intervals), default=0)
+
+    def add(self, offset: int, data: bytes) -> None:
+        """Overlay [offset, offset+len) with new bytes; newest wins."""
+        if not data:
+            return
+        new = PageInterval(offset, bytearray(data))
+        out: list[PageInterval] = []
+        for iv in self.intervals:
+            if iv.stop < new.offset or iv.offset > new.stop:
+                out.append(iv)  # fully disjoint, not even adjacent
+                continue
+            # overlapping or touching: keep non-overlapped remainders,
+            # then merge everything contiguous into `new`
+            if iv.offset < new.offset:
+                left = iv.data[: new.offset - iv.offset]
+                new.data[0:0] = left
+                new.offset = iv.offset
+            if iv.stop > new.stop:
+                new.data.extend(iv.data[new.stop - iv.offset :])
+        out.append(new)
+        out.sort(key=lambda iv: iv.offset)
+        self.intervals = out
+
+    def read(self, offset: int, size: int, base: bytearray) -> None:
+        """Overlay dirty bytes onto `base` (the already-fetched chunk data)
+        for the window [offset, offset+size)."""
+        stop = offset + size
+        for iv in self.intervals:
+            lo = max(iv.offset, offset)
+            hi = min(iv.stop, stop)
+            if lo < hi:
+                base[lo - offset : hi - offset] = iv.data[
+                    lo - iv.offset : hi - iv.offset
+                ]
+
+    def pop_largest(self) -> PageInterval | None:
+        """Remove and return the biggest interval (the reference flushes the
+        largest page list first when memory pressure hits)."""
+        if not self.intervals:
+            return None
+        best = max(range(len(self.intervals)),
+                   key=lambda i: len(self.intervals[i].data))
+        return self.intervals.pop(best)
+
+    def pop_all(self) -> list[PageInterval]:
+        out, self.intervals = self.intervals, []
+        return out

@@ -1,0 +1,424 @@
+"""ctypes binding to libfuse.so.2 (FUSE 2.9 high-level API).
+
+Reference capability: `weed mount` (weed/command/mount_std.go:52,208) via
+the bazil fuse fork.  Here the kernel interface is the system libfuse
+driven directly through ctypes — no third-party Python FUSE package — and
+every operation delegates to the kernel-agnostic WFS object (wfs.py).
+
+The struct layouts (struct stat, fuse_file_info, fuse_operations for
+FUSE_USE_VERSION 26) follow the public fuse.h / glibc ABI on x86-64
+Linux.  `available()` gates on libfuse + /dev/fuse so the package imports
+cleanly on hosts without FUSE.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import ctypes.util
+import errno
+import os
+import subprocess
+import threading
+
+from ..util import glog
+from .wfs import WFS, FuseError
+
+c_off_t = ctypes.c_int64
+c_mode_t = ctypes.c_uint32
+c_dev_t = ctypes.c_uint64
+
+
+class Timespec(ctypes.Structure):
+    _fields_ = [("tv_sec", ctypes.c_long), ("tv_nsec", ctypes.c_long)]
+
+
+class Stat(ctypes.Structure):  # glibc x86-64 struct stat
+    _fields_ = [
+        ("st_dev", c_dev_t),
+        ("st_ino", ctypes.c_uint64),
+        ("st_nlink", ctypes.c_uint64),
+        ("st_mode", c_mode_t),
+        ("st_uid", ctypes.c_uint32),
+        ("st_gid", ctypes.c_uint32),
+        ("__pad0", ctypes.c_int),
+        ("st_rdev", c_dev_t),
+        ("st_size", c_off_t),
+        ("st_blksize", ctypes.c_int64),
+        ("st_blocks", ctypes.c_int64),
+        ("st_atim", Timespec),
+        ("st_mtim", Timespec),
+        ("st_ctim", Timespec),
+        ("__reserved", ctypes.c_int64 * 3),
+    ]
+
+
+class StatVfs(ctypes.Structure):  # glibc x86-64 struct statvfs
+    _fields_ = [
+        ("f_bsize", ctypes.c_ulong),
+        ("f_frsize", ctypes.c_ulong),
+        ("f_blocks", ctypes.c_uint64),
+        ("f_bfree", ctypes.c_uint64),
+        ("f_bavail", ctypes.c_uint64),
+        ("f_files", ctypes.c_uint64),
+        ("f_ffree", ctypes.c_uint64),
+        ("f_favail", ctypes.c_uint64),
+        ("f_fsid", ctypes.c_ulong),
+        ("f_flag", ctypes.c_ulong),
+        ("f_namemax", ctypes.c_ulong),
+        ("__spare", ctypes.c_int * 6),
+    ]
+
+
+class FuseFileInfo(ctypes.Structure):  # fuse_common.h 2.9
+    _fields_ = [
+        ("flags", ctypes.c_int),
+        ("fh_old", ctypes.c_ulong),
+        ("writepage", ctypes.c_int),
+        ("flags_bits", ctypes.c_uint),  # direct_io:1 keep_cache:1 ...
+        ("fh", ctypes.c_uint64),
+        ("lock_owner", ctypes.c_uint64),
+    ]
+
+
+_FILL_DIR_T = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.c_void_p, ctypes.c_char_p,
+    ctypes.POINTER(Stat), c_off_t,
+)
+
+_P = ctypes.CFUNCTYPE  # shorthand
+_VOIDP = ctypes.c_void_p
+_CHARP = ctypes.c_char_p
+_INT = ctypes.c_int
+_SIZE = ctypes.c_size_t
+_FFIP = ctypes.POINTER(FuseFileInfo)
+
+
+class FuseOperations(ctypes.Structure):  # fuse.h, FUSE_USE_VERSION 26
+    _fields_ = [
+        # NOTE: data buffers are c_void_p, NOT c_char_p — ctypes converts a
+        # c_char_p argument into a Python bytes copy, so memmove would fill
+        # a throwaway instead of the kernel's buffer
+        ("getattr", _P(_INT, _CHARP, ctypes.POINTER(Stat))),
+        ("readlink", _P(_INT, _CHARP, _VOIDP, _SIZE)),
+        ("getdir", _VOIDP),  # deprecated
+        ("mknod", _P(_INT, _CHARP, c_mode_t, c_dev_t)),
+        ("mkdir", _P(_INT, _CHARP, c_mode_t)),
+        ("unlink", _P(_INT, _CHARP)),
+        ("rmdir", _P(_INT, _CHARP)),
+        ("symlink", _P(_INT, _CHARP, _CHARP)),
+        ("rename", _P(_INT, _CHARP, _CHARP)),
+        ("link", _P(_INT, _CHARP, _CHARP)),
+        ("chmod", _P(_INT, _CHARP, c_mode_t)),
+        ("chown", _P(_INT, _CHARP, ctypes.c_uint32, ctypes.c_uint32)),
+        ("truncate", _P(_INT, _CHARP, c_off_t)),
+        ("utime", _VOIDP),  # deprecated in favor of utimens
+        ("open", _P(_INT, _CHARP, _FFIP)),
+        ("read", _P(_INT, _CHARP, _VOIDP, _SIZE, c_off_t, _FFIP)),
+        ("write", _P(_INT, _CHARP, _VOIDP, _SIZE, c_off_t, _FFIP)),
+        ("statfs", _P(_INT, _CHARP, ctypes.POINTER(StatVfs))),
+        ("flush", _P(_INT, _CHARP, _FFIP)),
+        ("release", _P(_INT, _CHARP, _FFIP)),
+        ("fsync", _P(_INT, _CHARP, _INT, _FFIP)),
+        ("setxattr", _P(_INT, _CHARP, _CHARP, _VOIDP, _SIZE, _INT)),
+        ("getxattr", _P(_INT, _CHARP, _CHARP, _VOIDP, _SIZE)),
+        ("listxattr", _P(_INT, _CHARP, _VOIDP, _SIZE)),
+        ("removexattr", _P(_INT, _CHARP, _CHARP)),
+        ("opendir", _P(_INT, _CHARP, _FFIP)),
+        ("readdir", _P(_INT, _CHARP, _VOIDP, _FILL_DIR_T, c_off_t, _FFIP)),
+        ("releasedir", _P(_INT, _CHARP, _FFIP)),
+        ("fsyncdir", _P(_INT, _CHARP, _INT, _FFIP)),
+        ("init", _P(_VOIDP, _VOIDP)),
+        ("destroy", _P(None, _VOIDP)),
+        ("access", _P(_INT, _CHARP, _INT)),
+        ("create", _P(_INT, _CHARP, c_mode_t, _FFIP)),
+        ("ftruncate", _P(_INT, _CHARP, c_off_t, _FFIP)),
+        ("fgetattr", _P(_INT, _CHARP, ctypes.POINTER(Stat), _FFIP)),
+        ("lock", _VOIDP),
+        ("utimens", _P(_INT, _CHARP, ctypes.POINTER(Timespec))),
+        ("bmap", _VOIDP),
+        ("flag_bits", ctypes.c_uint),  # flag_nullpath_ok etc.
+        ("ioctl", _VOIDP),
+        ("poll", _VOIDP),
+        ("write_buf", _VOIDP),
+        ("read_buf", _VOIDP),
+        ("flock", _VOIDP),
+        ("fallocate", _VOIDP),
+    ]
+
+
+def _libfuse():
+    name = ctypes.util.find_library("fuse") or "libfuse.so.2"
+    return ctypes.CDLL(name)
+
+
+def available() -> bool:
+    try:
+        _libfuse()
+    except OSError:
+        return False
+    return os.path.exists("/dev/fuse")
+
+
+class FuseMount:
+    """Run a WFS under a kernel FUSE mountpoint.
+
+    start() spawns the libfuse main loop on a thread (single-threaded fuse
+    loop: the GIL would serialize callbacks anyway and -s keeps teardown
+    deterministic); stop() unmounts via fusermount and joins.
+    """
+
+    def __init__(self, wfs: WFS, mountpoint: str, allow_other: bool = False):
+        self.wfs = wfs
+        self.mountpoint = os.path.abspath(mountpoint)
+        self.allow_other = allow_other
+        self._thread: threading.Thread | None = None
+        self._ops = self._make_ops()  # must outlive the mount (GC!)
+        self._rc: int | None = None
+
+    # -- callback plumbing -------------------------------------------------
+
+    def _wrap(self, fn):
+        def call(*args):
+            try:
+                r = fn(*args)
+                return 0 if r is None else r
+            except FuseError as e:
+                return -e.errno
+            except OSError as e:
+                return -(e.errno or errno.EIO)
+            except Exception as e:  # noqa: BLE001 — kernel must get an errno
+                glog.warning("fuse: %s failed: %s", fn.__name__, e)
+                return -errno.EIO
+        call.__name__ = fn.__name__
+        return call
+
+    def _make_ops(self) -> FuseOperations:
+        w = self.wfs
+        fields = dict(FuseOperations._fields_)
+
+        def getattr_(path, st):
+            _fill_stat(st.contents, w.getattr(path.decode()))
+
+        def fgetattr(path, st, ffi):
+            h = w.handle(ffi.contents.fh) if ffi else None
+            if h is not None:
+                attrs = w.attrs_of(h.path, h.entry)
+                attrs["st_size"] = h.size()
+                _fill_stat(st.contents, attrs)
+            else:
+                _fill_stat(st.contents, w.getattr(path.decode()))
+
+        def readlink(path, buf, size):
+            target = w.readlink(path.decode()).encode()[: size - 1]
+            ctypes.memmove(buf, target + b"\0", len(target) + 1)
+
+        def mknod(path, mode, _dev):
+            w.mknod(path.decode(), mode)
+
+        def mkdir(path, mode):
+            w.mkdir(path.decode(), mode)
+
+        def unlink(path):
+            w.unlink(path.decode())
+
+        def rmdir(path):
+            w.rmdir(path.decode())
+
+        def symlink(target, link):
+            w.symlink(target.decode(), link.decode())
+
+        def rename(old, new):
+            w.rename(old.decode(), new.decode())
+
+        def link(_old, _new):
+            return -errno.ENOSYS  # hard links: not in the minimum surface
+
+        def chmod(path, mode):
+            w.set_attr(path.decode(), mode=mode)
+
+        def chown(path, uid, gid):
+            w.set_attr(
+                path.decode(),
+                uid=uid if uid != 0xFFFFFFFF else None,
+                gid=gid if gid != 0xFFFFFFFF else None,
+            )
+
+        def truncate(path, size):
+            w.set_attr(path.decode(), size=size)
+
+        def open_(path, ffi):
+            h = w.open(path.decode(), create=False)
+            ffi.contents.fh = h.fh
+
+        def create(path, mode, ffi):
+            h = w.open(path.decode(), create=True, mode=mode)
+            ffi.contents.fh = h.fh
+
+        def read(path, buf, size, off, ffi):
+            h = w.handle(ffi.contents.fh)
+            if h is None:
+                return -errno.EBADF
+            data = h.read(off, size)
+            ctypes.memmove(buf, data, len(data))
+            return len(data)
+
+        def write(path, buf, size, off, ffi):
+            h = w.handle(ffi.contents.fh)
+            if h is None:
+                return -errno.EBADF
+            return h.write(off, ctypes.string_at(buf, size))
+
+        def flush(path, ffi):
+            h = w.handle(ffi.contents.fh)
+            if h is not None:
+                h.flush()
+
+        def fsync(path, _datasync, ffi):
+            h = w.handle(ffi.contents.fh)
+            if h is not None:
+                h.flush()
+
+        def release(path, ffi):
+            h = w.handle(ffi.contents.fh)
+            if h is not None:
+                w.release(h)
+
+        def ftruncate(path, size, ffi):
+            h = w.handle(ffi.contents.fh)
+            if h is not None:
+                h.apply_truncate(size)
+            w.set_attr(path.decode(), size=size)
+
+        def statfs(_path, sv):
+            v = sv.contents
+            ctypes.memset(ctypes.byref(v), 0, ctypes.sizeof(v))
+            v.f_bsize = v.f_frsize = 4096
+            v.f_blocks = v.f_bfree = v.f_bavail = 1 << 30
+            v.f_files = v.f_ffree = v.f_favail = 1 << 30
+            v.f_namemax = 1024
+
+        def readdir(path, buf, filler, _off, _ffi):
+            filler(buf, b".", None, 0)
+            filler(buf, b"..", None, 0)
+            for e in w.list_dir(path.decode()):
+                filler(buf, e.name.encode(), None, 0)
+
+        def setxattr(path, name, value, size, _flags):
+            w.setxattr(path.decode(), name.decode(),
+                       ctypes.string_at(value, size))
+
+        def getxattr(path, name, value, size):
+            data = w.getxattr(path.decode(), name.decode())
+            if size == 0:
+                return len(data)
+            if size < len(data):
+                return -errno.ERANGE
+            ctypes.memmove(value, data, len(data))
+            return len(data)
+
+        def listxattr(path, buf, size):
+            blob = b"".join(n.encode() + b"\0" for n in w.listxattr(path.decode()))
+            if size == 0:
+                return len(blob)
+            if size < len(blob):
+                return -errno.ERANGE
+            ctypes.memmove(buf, blob, len(blob))
+            return len(blob)
+
+        def removexattr(path, name):
+            w.removexattr(path.decode(), name.decode())
+
+        def utimens(path, times):
+            mtime = None
+            if times:
+                ts = ctypes.cast(times, ctypes.POINTER(Timespec * 2)).contents
+                mtime = int(ts[1].tv_sec)
+            w.set_attr(path.decode(), mtime=mtime or int(__import__("time").time()))
+
+        def access(_path, _mode):
+            return 0
+
+        ops = FuseOperations()
+        impls = {
+            "getattr": getattr_, "fgetattr": fgetattr, "readlink": readlink,
+            "mknod": mknod, "mkdir": mkdir, "unlink": unlink, "rmdir": rmdir,
+            "symlink": symlink, "rename": rename, "link": link,
+            "chmod": chmod, "chown": chown, "truncate": truncate,
+            "open": open_, "create": create, "read": read, "write": write,
+            "flush": flush, "fsync": fsync, "release": release,
+            "ftruncate": ftruncate, "statfs": statfs, "readdir": readdir,
+            "setxattr": setxattr, "getxattr": getxattr,
+            "listxattr": listxattr, "removexattr": removexattr,
+            "utimens": utimens, "access": access,
+        }
+        self._keep = []  # CFUNCTYPE objects must not be GC'd
+        for name, impl in impls.items():
+            proto = fields[name]
+            cb = proto(self._wrap(impl))
+            self._keep.append(cb)
+            setattr(ops, name, cb)
+        return ops
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        os.makedirs(self.mountpoint, exist_ok=True)
+        lib = _libfuse()
+        argv_list = [b"seaweedfs_tpu", self.mountpoint.encode(), b"-f", b"-s",
+                     b"-o", b"default_permissions"]
+        if self.allow_other:
+            argv_list += [b"-o", b"allow_other"]
+        argc = len(argv_list)
+        argv = (ctypes.c_char_p * argc)(*argv_list)
+
+        def run():
+            self._rc = lib.fuse_main_real(
+                argc, argv, ctypes.byref(self._ops),
+                ctypes.sizeof(self._ops), None,
+            )
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        # wait until the kernel reports a fuse mount at the mountpoint
+        for _ in range(100):
+            if self.is_mounted():
+                return
+            if not self._thread.is_alive():
+                raise RuntimeError(
+                    f"fuse_main exited rc={self._rc} before mounting"
+                )
+            threading.Event().wait(0.05)
+        raise RuntimeError("fuse mount did not appear within 5s")
+
+    def is_mounted(self) -> bool:
+        try:
+            with open("/proc/mounts") as f:
+                return any(
+                    line.split()[1] == self.mountpoint and "fuse" in line
+                    for line in f
+                )
+        except OSError:
+            return False
+
+    def stop(self) -> None:
+        self.wfs.close()
+        subprocess.run(
+            ["fusermount", "-u", "-z", self.mountpoint],
+            capture_output=True,
+        )
+        if self._thread:
+            self._thread.join(timeout=10)
+
+
+def _fill_stat(st: Stat, attrs: dict) -> None:
+    ctypes.memset(ctypes.byref(st), 0, ctypes.sizeof(st))
+    st.st_mode = attrs["st_mode"]
+    st.st_size = attrs["st_size"]
+    st.st_uid = attrs["st_uid"]
+    st.st_gid = attrs["st_gid"]
+    st.st_nlink = attrs.get("st_nlink", 1)
+    st.st_blksize = 4096
+    st.st_blocks = attrs.get("st_blocks", 0)
+    st.st_atim.tv_sec = int(attrs["st_atime"])
+    st.st_mtim.tv_sec = int(attrs["st_mtime"])
+    st.st_ctim.tv_sec = int(attrs["st_ctime"])

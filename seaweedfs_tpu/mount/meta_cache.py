@@ -1,0 +1,109 @@
+"""Mount-side metadata cache of filer entries.
+
+Reference: weed/filesys/meta_cache/ — the mount keeps a local cache of
+Entry protos so getattr/lookup/readdir don't round-trip to the filer on
+every kernel call; directories are cached whole ("visited") after the
+first listing, and a background SubscribeMetadata stream keeps the cache
+coherent with changes made by other clients.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from ..pb import filer_pb2
+
+
+def _split(path: str) -> tuple[str, str]:
+    path = path.rstrip("/") or "/"
+    if path == "/":
+        return "/", ""
+    d, _, n = path.rpartition("/")
+    return d or "/", n
+
+
+class MetaCache:
+    """LRU of full-path -> Entry, plus a 'directory fully listed' set.
+
+    A cached directory means lookups for missing children can answer
+    ENOENT locally (negative caching via listing completeness, the same
+    trick the reference's bounded-tree visited marker plays).
+    """
+
+    def __init__(self, limit_entries: int = 65536):
+        self.limit = limit_entries
+        self._lock = threading.RLock()
+        self._entries: OrderedDict[str, filer_pb2.Entry] = OrderedDict()
+        self._listed_dirs: set[str] = set()
+
+    # -- entry ops ---------------------------------------------------------
+
+    def get(self, path: str):
+        with self._lock:
+            e = self._entries.get(path)
+            if e is not None:
+                self._entries.move_to_end(path)
+            return e
+
+    def put(self, path: str, entry: filer_pb2.Entry) -> None:
+        with self._lock:
+            self._entries[path] = entry
+            self._entries.move_to_end(path)
+            while len(self._entries) > self.limit:
+                evicted, _ = self._entries.popitem(last=False)
+                self._listed_dirs.discard(evicted)
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            self._entries.pop(path, None)
+            self._listed_dirs.discard(path)
+            # children of a removed dir are stale too
+            prefix = path.rstrip("/") + "/"
+            for k in [k for k in self._entries if k.startswith(prefix)]:
+                del self._entries[k]
+            for k in [k for k in self._listed_dirs if k.startswith(prefix)]:
+                self._listed_dirs.discard(k)
+
+    # -- directory completeness -------------------------------------------
+
+    def is_dir_listed(self, dir_path: str) -> bool:
+        with self._lock:
+            return dir_path in self._listed_dirs
+
+    def mark_dir_listed(self, dir_path: str, entries) -> None:
+        with self._lock:
+            base = dir_path.rstrip("/") or ""
+            for e in entries:
+                self.put(f"{base}/{e.name}", e)
+            self._listed_dirs.add(dir_path)
+
+    def children(self, dir_path: str) -> list[filer_pb2.Entry]:
+        prefix = (dir_path.rstrip("/") or "") + "/"
+        with self._lock:
+            return [
+                e
+                for p, e in self._entries.items()
+                if p.startswith(prefix) and "/" not in p[len(prefix):]
+            ]
+
+    def invalidate_dir(self, dir_path: str) -> None:
+        with self._lock:
+            self._listed_dirs.discard(dir_path)
+
+    # -- coherence with remote mutations ----------------------------------
+
+    def apply_event(self, directory: str, notification) -> None:
+        """Fold one filer EventNotification into the cache (the mount's
+        SubscribeMetadata consumer calls this)."""
+        old, new = notification.old_entry, notification.new_entry
+        new_dir = notification.new_parent_path or directory
+        with self._lock:
+            if old.name:
+                self.delete(f"{directory.rstrip('/') or ''}/{old.name}")
+                self.invalidate_dir(directory)
+            if new.name:
+                base = new_dir.rstrip("/") or ""
+                # putting the fresh entry keeps a fully-listed dir complete;
+                # an unlisted dir stays unlisted (next readdir refetches)
+                self.put(f"{base}/{new.name}", new)

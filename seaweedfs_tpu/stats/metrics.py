@@ -234,6 +234,10 @@ DISK_SIZE_GAUGE = REGISTRY.gauge(
     "seaweedfs_disk_size_bytes", "stored bytes by collection and kind",
     labels=("collection", "type"),
 )
+CHUNK_CACHE_COUNTER = REGISTRY.counter(
+    "seaweedfs_chunk_cache_total", "chunk cache lookups by result",
+    labels=("result",),
+)
 
 
 def serve_metrics(port: int, registry: Registry = REGISTRY,

@@ -252,8 +252,11 @@ class EcVolume:
         """
         shards: list[np.ndarray | None] = [None] * TOTAL_SHARDS
         have = 0
-        # snapshot: mount/unmount rpcs mutate self.shards from other threads
-        for sid, sh in sorted(self.shards.items()):
+        # snapshot in one C-level call: mount/unmount rpcs mutate
+        # self.shards from other threads
+        local_shards = list(self.shards.items())
+        local_shards.sort()
+        for sid, sh in local_shards:
             if sid == shard_id or have >= DATA_SHARDS:
                 continue
             try:

@@ -222,7 +222,8 @@ class VolumeServer:
         cache = TieredLocationCache(lookup)
 
         def fetch(shard_id: int, offset: int, length: int) -> bytes | None:
-            for url in cache.get().get(shard_id, []):
+            urls = cache.get().get(shard_id, [])
+            for url in urls:
                 if url == me:
                     continue
                 host, port = url.rsplit(":", 1)
@@ -239,6 +240,10 @@ class VolumeServer:
                         return data
                 except grpc.RpcError:
                     continue
+            if urls:
+                # every cached location failed — the shard likely moved;
+                # force a fresh master lookup for the next attempt
+                cache.invalidate()
             return None
 
         return fetch

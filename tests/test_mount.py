@@ -1,0 +1,326 @@
+"""Mount layer tests: dirty-page intervals, meta cache, chunk cache, the
+WFS filesystem core against a live mini-cluster, and (when the host allows
+it) a REAL kernel FUSE mount exercised with plain os/file calls.
+
+Reference analogues: weed/filesys/dirty_page_interval_test.go, the mount
+compose tier (docker/compose/local-mount-compose.yml), meta_cache/.
+"""
+
+import os
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.mount.dirty_pages import ContinuousIntervals
+from seaweedfs_tpu.mount.meta_cache import MetaCache
+from seaweedfs_tpu.pb import filer_pb2
+from seaweedfs_tpu.util.chunk_cache import TieredChunkCache
+
+
+def _free_port() -> int:
+    while True:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        if port < 50000:
+            return port
+
+
+# -- dirty-page intervals (dirty_page_interval_test.go analogues) -----------
+
+
+def test_intervals_sequential_writes_merge():
+    ci = ContinuousIntervals()
+    ci.add(0, b"aaaa")
+    ci.add(4, b"bbbb")
+    ci.add(8, b"cccc")
+    assert len(ci.intervals) == 1
+    assert bytes(ci.intervals[0].data) == b"aaaabbbbcccc"
+
+
+def test_intervals_overwrite_newest_wins():
+    ci = ContinuousIntervals()
+    ci.add(0, b"aaaaaaaaaa")
+    ci.add(3, b"BBB")
+    assert len(ci.intervals) == 1
+    assert bytes(ci.intervals[0].data) == b"aaaBBBaaaa"
+    # overlapping tail + gap + separate interval, then a bridging write
+    ci2 = ContinuousIntervals()
+    ci2.add(0, b"11111")
+    ci2.add(10, b"22222")
+    assert len(ci2.intervals) == 2
+    ci2.add(3, b"xxxxxxxxx")  # 3..12 bridges both
+    assert len(ci2.intervals) == 1
+    assert bytes(ci2.intervals[0].data) == b"111xxxxxxxxx222"
+
+
+def test_intervals_read_overlay_and_pop():
+    ci = ContinuousIntervals()
+    ci.add(5, b"ZZZZ")
+    base = bytearray(b"." * 10)
+    ci.read(3, 10, base)
+    assert bytes(base) == b"..ZZZZ...."
+    assert ci.total_bytes() == 4
+    assert ci.max_stop() == 9
+    iv = ci.pop_largest()
+    assert iv.offset == 5 and not ci.intervals
+
+
+# -- meta cache -------------------------------------------------------------
+
+
+def _entry(name, is_dir=False):
+    e = filer_pb2.Entry(name=name, is_directory=is_dir)
+    return e
+
+
+def test_meta_cache_listing_completeness():
+    mc = MetaCache()
+    mc.mark_dir_listed("/d", [_entry("a"), _entry("b")])
+    assert mc.is_dir_listed("/d")
+    assert {e.name for e in mc.children("/d")} == {"a", "b"}
+    assert mc.get("/d/a") is not None
+    mc.delete("/d/a")
+    assert mc.get("/d/a") is None
+    # deleting a dir drops its subtree
+    mc.put("/x", _entry("x", is_dir=True))
+    mc.put("/x/y", _entry("y"))
+    mc.delete("/x")
+    assert mc.get("/x/y") is None
+
+
+def test_meta_cache_lru_bound():
+    mc = MetaCache(limit_entries=4)
+    for i in range(8):
+        mc.put(f"/f{i}", _entry(f"f{i}"))
+    assert mc.get("/f0") is None and mc.get("/f7") is not None
+
+
+# -- tiered chunk cache -----------------------------------------------------
+
+
+def test_chunk_cache_tiers(tmp_path):
+    c = TieredChunkCache(
+        mem_limit_bytes=1024, mem_max_entry=256,
+        disk_dir=str(tmp_path / "cc"), disk_limit_bytes=4096,
+    )
+    c.set("1,a", b"x" * 100)       # memory
+    c.set("2,b", b"y" * 1000)      # too big for mem entry -> disk
+    assert c.get("1,a") == b"x" * 100
+    assert c.get("2,b") == b"y" * 1000
+    assert c.mem.get("2,b") is None  # stayed on disk (1000 > mem max entry)
+    # mem eviction under byte pressure
+    for i in range(20):
+        c.set(f"m,{i}", bytes([i]) * 200)
+    assert c.get("m,19") is not None
+    # disk eviction under byte pressure
+    for i in range(10):
+        c.disk.set(f"d,{i}", bytes([i]) * 1000)
+    assert c.disk.get("d,9") is not None
+    assert c.disk.get("d,0") is None
+
+
+# -- WFS over a live cluster ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mount_cluster(tmp_path_factory):
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    master = MasterServer(ip="127.0.0.1", port=_free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("mvol"))],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=_free_port(), pulse_seconds=0.5,
+    )
+    vs.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and len(master.topo.nodes) < 1:
+        time.sleep(0.1)
+    filer = FilerServer(
+        masters=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=_free_port(),
+        store="sqlite",
+        store_path=str(tmp_path_factory.mktemp("mountdb") / "filer.db"),
+        max_mb=1,
+    )
+    filer.start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+@pytest.fixture()
+def wfs(mount_cluster, tmp_path):
+    from seaweedfs_tpu.mount.wfs import WFS
+
+    _, _, filer = mount_cluster
+    w = WFS(
+        filer_grpc=f"127.0.0.1:{filer.grpc_port}",
+        filer_http=f"127.0.0.1:{filer.port}",
+        chunk_size_mb=1,
+        cache_dir=str(tmp_path / "cache"),
+    )
+    yield w
+    w.close()
+
+
+def test_wfs_file_roundtrip_chunked(wfs):
+    wfs.mkdir("/data")
+    h = wfs.open("/data/big.bin", create=True)
+    payload = bytes(range(256)) * 10240  # 2.5MB -> 3 chunks at 1MB
+    h.write(0, payload)
+    h.flush()
+    wfs.release(h)
+    entry = wfs.lookup_entry("/data/big.bin")
+    assert len(entry.chunks) >= 3
+    h2 = wfs.open("/data/big.bin")
+    assert h2.read(0, len(payload)) == payload
+    assert h2.read(1 << 20, 4096) == payload[1 << 20 : (1 << 20) + 4096]
+    wfs.release(h2)
+    assert wfs.getattr("/data/big.bin")["st_size"] == len(payload)
+
+
+def test_wfs_overwrite_and_dirty_read(wfs):
+    h = wfs.open("/data/notes.txt", create=True)
+    h.write(0, b"hello world")
+    # un-flushed dirty bytes must be visible to reads through the handle
+    assert h.read(0, 11) == b"hello world"
+    h.flush()
+    h.write(6, b"WORLD")
+    assert h.read(0, 11) == b"hello WORLD"
+    h.flush()
+    wfs.release(h)
+    h2 = wfs.open("/data/notes.txt")
+    assert h2.read(0, 100) == b"hello WORLD"
+    wfs.release(h2)
+
+
+def test_wfs_namespace_ops(wfs):
+    wfs.mkdir("/ns")
+    wfs.mkdir("/ns/sub")
+    h = wfs.open("/ns/f1", create=True)
+    h.write(0, b"abc")
+    wfs.release(h)
+    names = {e.name for e in wfs.list_dir("/ns")}
+    assert names == {"sub", "f1"}
+    wfs.rename("/ns/f1", "/ns/sub/f2")
+    assert wfs.lookup_entry("/ns/f1") is None
+    h = wfs.open("/ns/sub/f2")
+    assert h.read(0, 3) == b"abc"
+    wfs.release(h)
+    wfs.unlink("/ns/sub/f2")
+    with pytest.raises(OSError):
+        wfs.getattr("/ns/sub/f2")
+    wfs.rmdir("/ns/sub")
+    assert wfs.lookup_entry("/ns/sub") is None
+    wfs.rmdir("/ns")
+    assert wfs.lookup_entry("/ns") is None
+
+
+def test_wfs_truncate_and_setattr(wfs):
+    h = wfs.open("/trunc.bin", create=True)
+    h.write(0, b"x" * 1000)
+    h.flush()
+    wfs.release(h)
+    wfs.set_attr("/trunc.bin", size=0)
+    assert wfs.getattr("/trunc.bin")["st_size"] == 0
+    wfs.set_attr("/trunc.bin", mode=0o600, uid=12, gid=34)
+    a = wfs.getattr("/trunc.bin")
+    assert a["st_mode"] & 0o7777 == 0o600
+    assert (a["st_uid"], a["st_gid"]) == (12, 34)
+
+
+def test_wfs_xattr_and_symlink(wfs):
+    h = wfs.open("/xf", create=True)
+    wfs.release(h)
+    wfs.setxattr("/xf", "user.color", b"blue")
+    assert wfs.getxattr("/xf", "user.color") == b"blue"
+    assert wfs.listxattr("/xf") == ["user.color"]
+    wfs.removexattr("/xf", "user.color")
+    with pytest.raises(OSError):
+        wfs.getxattr("/xf", "user.color")
+    wfs.symlink("/xf", "/xlink")
+    assert wfs.readlink("/xlink") == "/xf"
+
+
+def test_wfs_spill_large_write(wfs):
+    """Writes beyond one chunk window spill early; flush commits the rest."""
+    h = wfs.open("/spill.bin", create=True)
+    blob = os.urandom(3 << 20)  # 3MB with chunk_size 1MB
+    for off in range(0, len(blob), 64 << 10):
+        h.write(off, blob[off : off + (64 << 10)])
+    assert h._pending_chunks, "expected early spill before flush"
+    h.flush()
+    wfs.release(h)
+    h2 = wfs.open("/spill.bin")
+    assert h2.read(0, len(blob)) == blob
+    wfs.release(h2)
+
+
+def test_wfs_sees_external_writes(mount_cluster, wfs):
+    """A file written through the filer HTTP API is visible via WFS."""
+    import urllib.request
+
+    _, _, filer = mount_cluster
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{filer.port}/ext/via-http.txt",
+        data=b"written by http", method="PUT",
+    )
+    with urllib.request.urlopen(req, timeout=10):
+        pass
+    h = wfs.open("/ext/via-http.txt")
+    assert h.read(0, 100) == b"written by http"
+    wfs.release(h)
+
+
+# -- real kernel FUSE mount -------------------------------------------------
+
+
+def _fuse_usable() -> bool:
+    from seaweedfs_tpu.mount.fuse import available
+
+    return available() and os.geteuid() == 0
+
+
+@pytest.mark.skipif(not _fuse_usable(), reason="no FUSE on this host")
+def test_kernel_fuse_mount(mount_cluster, tmp_path):
+    """cp/cat/rm through a real kernel mountpoint (the reference's
+    local-mount-compose tier, but in-process)."""
+    from seaweedfs_tpu.mount.fuse import FuseMount
+    from seaweedfs_tpu.mount.wfs import WFS
+
+    _, _, filer = mount_cluster
+    w = WFS(
+        filer_grpc=f"127.0.0.1:{filer.grpc_port}",
+        filer_http=f"127.0.0.1:{filer.port}",
+        chunk_size_mb=1,
+    )
+    mp = str(tmp_path / "mnt")
+    m = FuseMount(w, mp)
+    m.start()
+    try:
+        os.makedirs(f"{mp}/docs")
+        payload = os.urandom(2 << 20) + b"tail"
+        with open(f"{mp}/docs/blob.bin", "wb") as f:
+            f.write(payload)
+        with open(f"{mp}/docs/blob.bin", "rb") as f:
+            assert f.read() == payload
+        assert os.stat(f"{mp}/docs/blob.bin").st_size == len(payload)
+        assert sorted(os.listdir(f"{mp}/docs")) == ["blob.bin"]
+        os.rename(f"{mp}/docs/blob.bin", f"{mp}/docs/blob2.bin")
+        with open(f"{mp}/docs/blob2.bin", "rb") as f:
+            assert f.read(16) == payload[:16]
+        os.remove(f"{mp}/docs/blob2.bin")
+        assert os.listdir(f"{mp}/docs") == []
+        os.rmdir(f"{mp}/docs")
+        # the durable state lives in the filer, not the mount
+        assert filer.filer.find_entry("/docs") is None
+    finally:
+        m.stop()
