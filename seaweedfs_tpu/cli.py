@@ -48,6 +48,7 @@ def cmd_volume(args) -> None:
         metrics_port=args.metricsPort,
         jwt_signing_key=args.jwtKey,
         whitelist=args.whiteList.split(",") if args.whiteList else None,
+        tier_backends=_load_tier_backends(args.tierBackends),
     )
     v.start()
     print(f"volume server http={args.port} grpc={v.grpc_port} dirs={args.dir}")
@@ -263,6 +264,15 @@ def cmd_export(args) -> None:
     print(f"exported {n} needles to {args.output}")
 
 
+def _load_tier_backends(path: str) -> dict | None:
+    if not path:
+        return None
+    import json
+
+    with open(path) as f:
+        return json.load(f)
+
+
 def _grpc_addr(master: str) -> str:
     """Convert a server's HTTP address to its gRPC address (+10000)."""
     host, port = master.rsplit(":", 1)
@@ -308,6 +318,8 @@ def main(argv=None) -> None:
     v.add_argument("-metricsPort", type=int, default=0)
     v.add_argument("-jwtKey", default="")
     v.add_argument("-whiteList", default="")
+    v.add_argument("-tierBackends", default="",
+                   help="JSON file: {\"s3.default\": {\"endpoint\": ...}}")
     v.set_defaults(fn=cmd_volume)
 
     s = sub.add_parser("server")

@@ -13,7 +13,7 @@ from ..pb import master_pb2
 from ..pb import volume_server_pb2 as vs
 from ..storage.replica_placement import ReplicaPlacement
 from .commands import CommandEnv, register
-from .ec_commands import _iter_nodes, _node_grpc, _parse_flags
+from .ec_commands import _iter_nodes, _node_grpc, _parse_flags  # noqa: F401
 
 
 @register("volume.list")
@@ -93,6 +93,59 @@ def volume_move(env: CommandEnv, args: list[str]) -> str:
     )
     env.volume_server(source).VolumeDelete(vs.VolumeDeleteRequest(volume_id=vid))
     return f"moved {vid} {source} -> {target}"
+
+
+def _locate_volume(env: CommandEnv, vid: int) -> tuple[str, str]:
+    """-> (node_url, collection) of the first holder of vid."""
+    for _dc, _rack, dn in _iter_nodes(env.topology()):
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                if v.id == vid:
+                    return dn.id, v.collection
+    raise RuntimeError(f"volume {vid} not found in topology")
+
+
+@register("volume.tier.upload")
+def volume_tier_upload(env: CommandEnv, args: list[str]) -> str:
+    """Move a volume's .dat to a remote tier backend; the index stays
+    local and reads keep working through ranged requests.
+    Reference: weed/shell/command_volume_tier_upload.go."""
+    flags = _parse_flags(args)
+    vid = int(flags["volumeId"])
+    dest = flags.get("dest", "s3.default")
+    keep = flags.get("keepLocalDatFile", "false") == "true"
+    node = _node_grpc(flags.get("node") or _locate_volume(env, vid)[0])
+    env.volume_server(node).VolumeMarkReadonly(
+        vs.VolumeMarkReadonlyRequest(volume_id=vid)
+    )
+    processed = 0
+    for resp in env.volume_server(node).VolumeTierMoveDatToRemote(
+        vs.VolumeTierMoveDatToRemoteRequest(
+            volume_id=vid,
+            destination_backend_name=dest,
+            keep_local_dat_file=keep,
+        )
+    ):
+        processed = resp.processed
+    return f"volume {vid} .dat -> {dest} ({processed} bytes)"
+
+
+@register("volume.tier.download")
+def volume_tier_download(env: CommandEnv, args: list[str]) -> str:
+    """Bring a tiered volume's .dat back to local disk and make it
+    writable again (weed/shell/command_volume_tier_download.go)."""
+    flags = _parse_flags(args)
+    vid = int(flags["volumeId"])
+    node = _node_grpc(flags.get("node") or _locate_volume(env, vid)[0])
+    processed = 0
+    for resp in env.volume_server(node).VolumeTierMoveDatFromRemote(
+        vs.VolumeTierMoveDatFromRemoteRequest(volume_id=vid)
+    ):
+        processed = resp.processed
+    env.volume_server(node).VolumeMarkWritable(
+        vs.VolumeMarkWritableRequest(volume_id=vid)
+    )
+    return f"volume {vid} .dat downloaded ({processed} bytes)"
 
 
 def find_misplaced_volumes(topo: master_pb2.TopologyInfo) -> dict[int, dict]:

@@ -16,13 +16,20 @@ from ..pb import volume_info_pb2
 
 
 def save_volume_info(path: str, version: int, replication: str = "",
-                     dat_file_size: int = 0) -> None:
+                     dat_file_size: int = 0,
+                     remote_files: list[dict] | None = None) -> None:
     """``dat_file_size`` records the logical .dat size; EC volumes with no
     local shard use it to recover interval geometry (a tombstoned .ecx
-    entry loses its size, so the index alone can under-bound the volume)."""
+    entry loses its size, so the index alone can under-bound the volume).
+
+    ``remote_files`` records tier placement (volume_info.proto RemoteFile
+    dicts: backend_type/backend_id/key/file_size/modified_time/extension);
+    a volume whose .dat moved to a remote tier is reopened through it."""
     info = volume_info_pb2.VolumeInfo(
         version=version, replication=replication, dat_file_size=dat_file_size
     )
+    for rf in remote_files or ():
+        info.files.add(**rf)
     with open(path, "w") as f:
         f.write(json_format.MessageToJson(info))
 

@@ -5,6 +5,12 @@ volume_checking.go): append-only writes under a lock, tombstone deletes (an
 empty needle marks deletion in the log, the index records size -1), CRC
 verification on read, and load-time integrity checking that truncates torn
 tail appends.
+
+The `.dat` bytes flow through a BackendStorageFile (backend.py — the seam
+from weed/storage/backend/backend.go:15): local volumes use DiskFile;
+a volume whose `.vif` records a remote tier placement opens a read-only
+RemoteBackendFile instead (volume_tier.go LoadRemoteFile), with the index
+and needle map still local.
 """
 
 from __future__ import annotations
@@ -14,10 +20,12 @@ import threading
 import time
 
 from . import types as t
+from .backend import DiskFile, get_backend
 from .idx import IndexWriter, walk_index_file
 from .needle import Needle, actual_size, body_length
 from .needle_map import NeedleMap
 from .super_block import CURRENT_VERSION, SUPER_BLOCK_SIZE, SuperBlock
+from .vif import load_volume_info, save_volume_info
 
 
 class Volume:
@@ -29,15 +37,26 @@ class Volume:
         self.read_only = False
         self._lock = threading.RLock()
         base = self.file_name()
-        is_new = not os.path.exists(base + ".dat")
-        self.super_block = super_block or SuperBlock()
-        self._dat = open(base + ".dat", "a+b")
-        if is_new:
-            self._dat.write(self.super_block.to_bytes())
-            self._dat.flush()
+        self.volume_info = load_volume_info(base + ".vif")
+        remote = self._remote_dat_file()
+        if remote is not None:
+            # .dat lives on a remote tier: serve reads through it, stay
+            # read-only until tier.download brings the bytes back
+            self._dat = remote
+            self.read_only = True
+            self.super_block = SuperBlock.from_bytes(
+                self._dat.read_at(0, 64)
+            )
         else:
-            self._dat.seek(0)
-            self.super_block = SuperBlock.from_bytes(self._dat.read(64))
+            is_new = not os.path.exists(base + ".dat")
+            self.super_block = super_block or SuperBlock()
+            self._dat = DiskFile(base + ".dat")
+            if is_new:
+                self._dat.write_at(0, self.super_block.to_bytes())
+            else:
+                self.super_block = SuperBlock.from_bytes(
+                    self._dat.read_at(0, 64)
+                )
         self.version = self.super_block.version
         self.needle_map = (
             NeedleMap.load_from_idx(base + ".idx")
@@ -46,6 +65,27 @@ class Volume:
         )
         self.check_and_fix_integrity()
         self._idx = IndexWriter(base + ".idx")
+
+    def _remote_dat_file(self):
+        """RemoteBackendFile when the .vif maps the .dat to a configured
+        tier; None for plain local volumes (or unconfigured backends)."""
+        if self.volume_info is None:
+            return None
+        for rf in self.volume_info.files:
+            if rf.extension and rf.extension != ".dat":
+                continue
+            backend = get_backend(f"{rf.backend_type}.{rf.backend_id}")
+            if backend is None:
+                raise IOError(
+                    f"volume {self.volume_id}: .dat is on unconfigured "
+                    f"backend {rf.backend_type}.{rf.backend_id}"
+                )
+            return backend.remote_file(rf.key, rf.file_size)
+        return None
+
+    @property
+    def is_remote(self) -> bool:
+        return self._dat.is_remote
 
     # -- naming -----------------------------------------------------------
 
@@ -62,19 +102,17 @@ class Volume:
         with self._lock:
             if self.read_only:
                 raise PermissionError(f"volume {self.volume_id} is read-only")
-            self._dat.seek(0, os.SEEK_END)
-            offset = self._dat.tell()
+            offset = self._dat.file_size()
             if offset % t.NEEDLE_PADDING_SIZE:  # heal torn tail
                 pad = t.NEEDLE_PADDING_SIZE - offset % t.NEEDLE_PADDING_SIZE
-                self._dat.write(b"\0" * pad)
+                self._dat.write_at(offset, b"\0" * pad)
                 offset += pad
             if offset >= t.MAX_POSSIBLE_VOLUME_SIZE:
                 raise IOError("volume size limit exceeded")
             if not n.append_at_ns:
                 n.append_at_ns = time.time_ns()
             blob = n.to_bytes(self.version)
-            self._dat.write(blob)
-            self._dat.flush()
+            self._dat.write_at(offset, blob)
             old = self.needle_map.get(n.id)
             if old is None or old.offset < offset:
                 self.needle_map.put(n.id, offset, n.size)
@@ -84,15 +122,15 @@ class Volume:
     def delete_needle(self, needle_id: int) -> int:
         """Append a tombstone marker needle; returns freed byte count."""
         with self._lock:
+            if self.read_only:
+                raise PermissionError(f"volume {self.volume_id} is read-only")
             existing = self.needle_map.get(needle_id)
             if existing is None:
                 return 0
             marker = Needle(id=needle_id, cookie=0, data=b"")
-            self._dat.seek(0, os.SEEK_END)
-            offset = self._dat.tell()
+            offset = self._dat.file_size()
             marker.append_at_ns = time.time_ns()
-            self._dat.write(marker.to_bytes(self.version))
-            self._dat.flush()
+            self._dat.write_at(offset, marker.to_bytes(self.version))
             self.needle_map.delete(needle_id)
             self._idx.delete(needle_id, offset)
             return max(existing.size, 0)
@@ -104,8 +142,9 @@ class Volume:
             nv = self.needle_map.get(needle_id)
             if nv is None or t.size_is_deleted(nv.size):
                 raise KeyError(f"needle {needle_id:x} not found")
-            self._dat.seek(nv.offset)
-            blob = self._dat.read(actual_size(nv.size, self.version))
+            blob = self._dat.read_at(
+                nv.offset, actual_size(nv.size, self.version)
+            )
         n = Needle.from_bytes(blob, self.version)
         if n.size != nv.size:
             raise IOError("size mismatch reading needle")
@@ -113,19 +152,82 @@ class Volume:
             raise PermissionError("cookie mismatch")
         return n
 
+    # -- remote tier ------------------------------------------------------
+
+    def tier_to_remote(self, backend_name: str, keep_local: bool = False,
+                       progress=None) -> int:
+        """Upload the .dat to a remote tier, record it in the .vif, and
+        reopen through the remote file (volume.tier.upload;
+        volume_grpc_tier.go).  Returns bytes uploaded."""
+        backend = get_backend(backend_name)
+        if backend is None:
+            raise IOError(f"backend {backend_name} not configured")
+        with self._lock:
+            if self.is_remote:
+                raise IOError(f"volume {self.volume_id} is already remote")
+            self.read_only = True  # no appends while the bytes move
+            self._dat.sync()
+            base = self.file_name()
+            key = f"{os.path.basename(base)}.dat"
+            size = self._dat.file_size()
+            backend.upload_file(base + ".dat", key, progress=progress)
+            save_volume_info(
+                base + ".vif", self.version,
+                replication=str(self.super_block.replica_placement or ""),
+                dat_file_size=size,
+                remote_files=[{
+                    "backend_type": backend.backend_type,
+                    "backend_id": backend.backend_id,
+                    "key": key,
+                    "file_size": size,
+                    "modified_time": int(time.time()),
+                    "extension": ".dat",
+                }],
+            )
+            self.volume_info = load_volume_info(base + ".vif")
+            self._dat.close()
+            self._dat = backend.remote_file(key, size)
+            if not keep_local:
+                os.remove(base + ".dat")
+            return size
+
+    def tier_to_local(self, progress=None) -> int:
+        """Download the .dat back from its remote tier and reopen locally
+        (volume.tier.download).  Returns bytes downloaded."""
+        with self._lock:
+            if not self.is_remote:
+                return 0
+            remote = self._dat
+            base = self.file_name()
+            got = remote.backend.download_file(
+                remote.key, base + ".dat", progress=progress
+            )
+            remote.backend.delete_file(remote.key)
+            save_volume_info(
+                base + ".vif", self.version,
+                replication=str(self.super_block.replica_placement or ""),
+                dat_file_size=got,
+            )
+            self.volume_info = load_volume_info(base + ".vif")
+            self._dat = DiskFile(base + ".dat")
+            self.read_only = False
+            return got
+
     # -- stats / lifecycle ------------------------------------------------
 
     def flush(self) -> None:
         """Fence buffered appends so other handles see consistent
         .dat/.idx files (bulk copy streams them by path)."""
         with self._lock:
-            self._dat.flush()
+            self._dat.sync()
             self._idx.flush()
 
     @property
     def content_size(self) -> int:
-        self._dat.seek(0, os.SEEK_END)
-        return self._dat.tell()
+        # under the lock: tier transitions swap self._dat and a heartbeat
+        # thread polling sizes must not see the half-closed handle
+        with self._lock:
+            return self._dat.file_size()
 
     def garbage_level(self) -> float:
         size = self.content_size
@@ -136,13 +238,11 @@ class Volume:
 
     def sync(self) -> None:
         with self._lock:
-            self._dat.flush()
-            os.fsync(self._dat.fileno())
+            self._dat.sync()
             self._idx.flush()
 
     def close(self) -> None:
         with self._lock:
-            self._dat.flush()
             self._dat.close()
             self._idx.close()
 
@@ -154,9 +254,9 @@ class Volume:
         Reference: CheckAndFixVolumeDataIntegrity (volume_checking.go:17) —
         the last entry's record must lie fully inside the file and carry the
         expected needle id; otherwise the torn tail is truncated away.
+        Remote-tier volumes skip the fix (their bytes are immutable).
         """
-        self._dat.seek(0, os.SEEK_END)
-        file_size = self._dat.tell()
+        file_size = self._dat.file_size()
         last = None
         for v in self.needle_map.items_ascending():
             if last is None or v.offset > last.offset:
@@ -165,12 +265,15 @@ class Volume:
             return
         end = last.offset + actual_size(max(last.size, 0), self.version)
         if end > file_size:
+            if self.is_remote:
+                raise IOError(
+                    f"volume {self.volume_id}: remote .dat shorter than index"
+                )
             # torn append: drop the entry and truncate to the previous record
             self.needle_map.delete(last.key)
             self._dat.truncate(last.offset)
             return
-        self._dat.seek(last.offset)
-        hdr = self._dat.read(t.NEEDLE_HEADER_SIZE)
+        hdr = self._dat.read_at(last.offset, t.NEEDLE_HEADER_SIZE)
         if len(hdr) == t.NEEDLE_HEADER_SIZE:
             n = Needle.parse_header(hdr)
             if n.id != last.key:

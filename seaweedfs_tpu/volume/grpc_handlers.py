@@ -115,8 +115,9 @@ class VolumeGrpcService:
         if v is None:
             context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
         with v._lock:
-            v._dat.seek(request.offset)
-            blob = v._dat.read(actual_size(request.size, v.version))
+            blob = v._dat.read_at(
+                request.offset, actual_size(request.size, v.version)
+            )
         return vs.ReadNeedleBlobResponse(needle_blob=blob)
 
     def WriteNeedleBlob(self, request, context):
@@ -466,6 +467,48 @@ class VolumeGrpcService:
             else:
                 v.delete_needle(n.id)
         return vs.VolumeTailReceiverResponse()
+
+    # -- remote tier -------------------------------------------------------
+
+    def VolumeTierMoveDatToRemote(self, request, context):
+        """Stream-upload a volume's .dat to the named remote tier backend
+        and record it in the .vif (volume_grpc_tier.go; shell command
+        volume.tier.upload).  Progress is streamed back per part."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        total = max(v.content_size, 1)
+        sent: list[int] = [0]
+        updates = []
+
+        def progress(n):
+            sent[0] = n
+            updates.append(n)
+
+        try:
+            v.tier_to_remote(
+                request.destination_backend_name,
+                keep_local=request.keep_local_dat_file,
+                progress=progress,
+            )
+        except (IOError, PermissionError) as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        yield vs.VolumeTierMoveDatToRemoteResponse(
+            processed=sent[0] or total,
+            processedPercentage=100.0,
+        )
+
+    def VolumeTierMoveDatFromRemote(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        try:
+            got = v.tier_to_local()
+        except IOError as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        yield vs.VolumeTierMoveDatFromRemoteResponse(
+            processed=got, processedPercentage=100.0
+        )
 
     # -- server status / membership ---------------------------------------
 

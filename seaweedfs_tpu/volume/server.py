@@ -44,7 +44,19 @@ class VolumeServer:
         metrics_port: int = 0,
         jwt_signing_key: bytes | str = b"",
         whitelist: list[str] | None = None,
+        tier_backends: dict | None = None,
     ):
+        # remote-tier backends: {"s3.default": {"endpoint": ..., ...}}
+        # (the [storage.backend] config tier; backend.go:32-46)
+        if tier_backends:
+            from ..storage.backend_s3 import make_s3_backend
+
+            for name, conf in tier_backends.items():
+                btype, _, bid = name.partition(".")
+                if btype == "s3":
+                    make_s3_backend(bid or "default", conf)
+                else:
+                    glog.warning("unknown tier backend type %s", btype)
         self.ip = ip
         self.port = port
         self.grpc_port = port + GRPC_PORT_OFFSET
