@@ -15,6 +15,7 @@ import threading
 import time
 
 from ..pb import filer_pb2
+from ..util import glog
 from . import filechunks
 from .filerstore import FilerStore
 from .meta_log import MetaLogBuffer
@@ -38,13 +39,21 @@ def join_path(directory: str, name: str) -> str:
 
 
 class Filer:
-    def __init__(self, store: FilerStore, delete_chunks_fn=None):
+    def __init__(self, store: FilerStore, delete_chunks_fn=None,
+                 resolve_chunks_fn=None):
         """``delete_chunks_fn(file_ids: list[str])`` deletes blobs; when
-        None, chunk deletion is a no-op (offline/metadata-only use)."""
+        None, chunk deletion is a no-op (offline/metadata-only use).
+
+        ``resolve_chunks_fn(chunks) -> chunks`` expands manifest chunks;
+        garbage-collection diffs run over EXPANDED lists on both sides so
+        a chunk folded into a manifest is never mistaken for garbage
+        (reference: MinusChunks with a lookup fn, filechunk_manifest.go).
+        """
         self.store = store
         self.meta_log = MetaLogBuffer()
         self._append_lock = threading.Lock()
         self._delete_fn = delete_chunks_fn
+        self._resolve_fn = resolve_chunks_fn
         self._deletion_q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         self._deleter = threading.Thread(target=self._deletion_loop, daemon=True)
@@ -70,8 +79,9 @@ class Filer:
         self.store.insert_entry(directory, entry)
         # blobs shadowed by the rewrite get deleted asynchronously
         if old is not None and old.chunks:
-            garbage = filechunks.minus_chunks(old.chunks, entry.chunks)
-            self.queue_chunk_deletion([c.file_id for c in garbage])
+            self.queue_chunk_deletion(
+                self._garbage_fids(old.chunks, entry.chunks)
+            )
         self.meta_log.append(directory, old, entry, signatures=signatures)
 
     def update_entry(self, directory: str, entry: filer_pb2.Entry,
@@ -81,9 +91,45 @@ class Filer:
             raise FileNotFoundError(join_path(directory, entry.name))
         self.store.update_entry(directory, entry)
         if old.chunks:
-            garbage = filechunks.minus_chunks(old.chunks, entry.chunks)
-            self.queue_chunk_deletion([c.file_id for c in garbage])
+            self.queue_chunk_deletion(
+                self._garbage_fids(old.chunks, entry.chunks)
+            )
         self.meta_log.append(directory, old, entry, signatures=signatures)
+
+    def _garbage_fids(self, old_chunks, new_chunks) -> list[str]:
+        """fids in old but not new, with manifests EXPANDED on both sides
+        so a chunk folded into a manifest is never mistaken for garbage.
+        A resolution failure skips collection (leak beats corruption)."""
+        try:
+            garbage = filechunks.minus_chunks(
+                self._expanded(old_chunks), self._expanded(new_chunks)
+            )
+        except Exception:
+            glog.warning("manifest unresolvable; skipping GC of a rewrite")
+            return []
+        return [c.file_id for c in garbage]
+
+    def _expanded(self, chunks) -> list:
+        """Chunk list + everything reachable through its manifests."""
+        chunks = list(chunks)
+        if self._resolve_fn is None or not any(
+            c.is_chunk_manifest for c in chunks
+        ):
+            return chunks
+        return chunks + [
+            c for c in self._resolve_fn(chunks) if not c.is_chunk_manifest
+        ]
+
+    def _all_fids(self, chunks) -> list[str]:
+        """Every fid a file's deletion must reclaim: the chunks themselves
+        plus everything inside their manifests (resolve-before-delete,
+        filer_delete_entry.go).  Unresolvable manifests delete what is
+        known rather than failing the metadata removal."""
+        try:
+            return [c.file_id for c in self._expanded(chunks)]
+        except Exception:
+            glog.warning("manifest unresolvable; inner chunks may leak")
+            return [c.file_id for c in chunks]
 
     def append_chunks(self, directory: str, name: str, chunks) -> None:
         # serialize the read-modify-write: two concurrent appenders would
@@ -161,7 +207,7 @@ class Filer:
                 if not ignore_recursive_error:
                     raise
         elif is_delete_data and entry.chunks:
-            self.queue_chunk_deletion([c.file_id for c in entry.chunks])
+            self.queue_chunk_deletion(self._all_fids(entry.chunks))
         self.store.delete_entry(directory, name)
         self.meta_log.append(
             directory, entry, None, delete_chunks=is_delete_data,
@@ -182,9 +228,7 @@ class Filer:
                     if e.is_directory:
                         stack.append(join_path(d, e.name))
                     elif is_delete_data and e.chunks:
-                        self.queue_chunk_deletion(
-                            [c.file_id for c in e.chunks]
-                        )
+                        self.queue_chunk_deletion(self._all_fids(e.chunks))
                 start = batch[-1].name
         self.store.delete_folder_children(path)
 

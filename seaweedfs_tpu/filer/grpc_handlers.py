@@ -45,8 +45,19 @@ class FilerGrpcService:
             resp.entry.CopyFrom(e)
             yield resp
 
+    def _maybe_manifestize(self, directory, entry) -> None:
+        """Fold over-long chunk lists before the store write
+        (filer_grpc_server.go MaybeManifestize)."""
+        folded = self.fs.manifestize_chunks(
+            list(entry.chunks), path=join_path(directory, entry.name)
+        )
+        if len(folded) != len(entry.chunks):
+            del entry.chunks[:]
+            entry.chunks.extend(folded)
+
     def CreateEntry(self, request, context):
         try:
+            self._maybe_manifestize(request.directory, request.entry)
             self.filer.create_entry(
                 request.directory, request.entry, o_excl=request.o_excl,
                 signatures=list(request.signatures),
@@ -57,6 +68,7 @@ class FilerGrpcService:
 
     def UpdateEntry(self, request, context):
         try:
+            self._maybe_manifestize(request.directory, request.entry)
             self.filer.update_entry(request.directory, request.entry,
                                     signatures=list(request.signatures))
         except FileNotFoundError as e:
@@ -67,6 +79,11 @@ class FilerGrpcService:
         self.filer.append_chunks(
             request.directory, request.entry_name, list(request.chunks)
         )
+        entry = self.filer.store.find_entry(request.directory,
+                                            request.entry_name)
+        if entry is not None and len(entry.chunks) > self.fs.manifest_batch:
+            self._maybe_manifestize(request.directory, entry)
+            self.filer.update_entry(request.directory, entry)
         return filer_pb2.AppendToEntryResponse()
 
     def DeleteEntry(self, request, context):

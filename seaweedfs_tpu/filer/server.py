@@ -17,8 +17,9 @@ from ..operation import delete_file_ids, download, upload_data
 from ..operation.assign import AssignResult, assign_any
 from ..pb import filer_pb2
 from ..pb import rpc as rpclib
+from ..util.chunk_cache import TieredChunkCache
 from ..wdclient import MasterClient
-from . import filechunks
+from . import filechunk_manifest, filechunks
 from .filer import Filer, split_path
 from .filerstore import make_store
 from .grpc_handlers import FilerGrpcService
@@ -39,6 +40,9 @@ class FilerServer:
         default_replication: str = "",
         metrics_port: int = 0,
         notification=None,  # notification.Publisher, or None
+        chunk_cache_dir: str = "",
+        chunk_cache_mem_mb: int = 32,
+        manifest_batch: int = filechunk_manifest.MANIFEST_BATCH,
     ):
         self.masters = list(masters)
         self.ip = ip
@@ -50,15 +54,25 @@ class FilerServer:
         self.metrics_port = metrics_port
         self.master_client = MasterClient(f"filer@{ip}:{port}", self.masters)
         if store == "memory":
-            self.filer = Filer(make_store("memory"), self._delete_chunks)
+            self.filer = Filer(make_store("memory"), self._delete_chunks,
+                               resolve_chunks_fn=self.resolve_chunks)
         else:
             self.filer = Filer(
-                make_store(store, path=store_path), self._delete_chunks
+                make_store(store, path=store_path), self._delete_chunks,
+                resolve_chunks_fn=self.resolve_chunks,
             )
         self._brokers: dict[str, list[str]] = {}
         self._grpc_server = None
         self._httpd = None
         self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+        # tiered read cache + manifest batching (reader_at.go:88-104,
+        # filechunk_manifest.go)
+        self.chunk_cache = TieredChunkCache(
+            mem_limit_bytes=chunk_cache_mem_mb << 20,
+            mem_max_entry=max_mb << 20,
+            disk_dir=chunk_cache_dir or None,
+        )
+        self.manifest_batch = manifest_batch
         self.notification = notification
         if notification is not None:
             # every metadata mutation fans out to the configured queue
@@ -145,7 +159,7 @@ class FilerServer:
         elif data:
             chunks = [upload_one(0)]
         entry = filer_pb2.Entry(name=name)
-        entry.chunks.extend(chunks)
+        entry.chunks.extend(self.manifestize_chunks(chunks, path=path))
         entry.attributes.file_size = len(data)
         entry.attributes.mime = mime
         entry.attributes.mtime = int(time.time())
@@ -190,7 +204,8 @@ class FilerServer:
                          size: int) -> bytes:
         if entry.content:  # inline small-file content
             return bytes(entry.content[offset : offset + size])
-        views = filechunks.view_from_chunks(list(entry.chunks), offset, size)
+        chunks = self.resolve_chunks(list(entry.chunks))
+        views = filechunks.view_from_chunks(chunks, offset, size)
         if not views:
             return b""
         if len(views) == 1:
@@ -203,7 +218,40 @@ class FilerServer:
             out[lo : lo + len(blob)] = blob
         return bytes(out)
 
+    def resolve_chunks(self, chunks: list) -> list:
+        """Expand manifest chunks (cached) into the real chunk list."""
+        if not filechunk_manifest.has_chunk_manifest(chunks):
+            return chunks
+        return filechunk_manifest.resolve_chunk_manifest(
+            self._fetch_whole, chunks
+        )
+
+    def _fetch_whole(self, file_id: str) -> bytes:
+        """Whole-chunk fetch through the tiered cache."""
+        cached = self.chunk_cache.get(file_id)
+        if cached is not None:
+            return cached
+        urls = self.master_client.lookup_file_id(file_id)
+        if not urls:
+            raise IOError(f"no locations for chunk {file_id}")
+        last_err: Exception | None = None
+        for url in urls:
+            try:
+                blob = download(url)
+                self.chunk_cache.set(file_id, blob)
+                return blob
+            except Exception as e:
+                last_err = e
+        raise IOError(f"chunk {file_id} unreadable: {last_err}")
+
     def _fetch_view(self, view: filechunks.ChunkView) -> bytes:
+        cached = self.chunk_cache.get(view.file_id)
+        if cached is not None:
+            return cached[view.offset : view.offset + view.size]
+        # small chunks: fetch whole + cache; large: ranged read, no cache
+        if view.chunk_size and view.chunk_size <= (self.max_mb << 20):
+            blob = self._fetch_whole(view.file_id)
+            return blob[view.offset : view.offset + view.size]
         urls = self.master_client.lookup_file_id(view.file_id)
         if not urls:
             raise IOError(f"no locations for chunk {view.file_id}")
@@ -215,6 +263,25 @@ class FilerServer:
             except Exception as e:
                 last_err = e
         raise IOError(f"chunk {view.file_id} unreadable: {last_err}")
+
+    def manifestize_chunks(self, chunks: list, path: str = "") -> list:
+        """Fold an over-long chunk list into manifest chunks before the
+        entry hits the metadata store (filer_grpc_server.go MaybeManifestize
+        on create/update)."""
+
+        def save(blob: bytes) -> filer_pb2.FileChunk:
+            result = assign_any(
+                self._master_order(), count=1,
+                collection=self.filer.bucket_collection(path),
+                replication=self.default_replication,
+            )
+            upload_data(result.fid_url(), blob, jwt=result.auth)
+            return filechunks.make_chunk(result.fid, 0, len(blob),
+                                         time.time_ns())
+
+        return filechunk_manifest.maybe_manifestize(
+            save, chunks, self.manifest_batch
+        )
 
     # -- collections / brokers --------------------------------------------
 

@@ -367,22 +367,36 @@ class WFS:
             self._vid_cache[vid] = (now, urls)
         return [f"http://{u}/{file_id}" for u in urls]
 
-    def read_chunk_view(self, view: filechunks.ChunkView) -> bytes:
-        """Whole-chunk read-through cache, sliced to the view window
-        (reader_at.go:88-104 fetches and caches full chunks)."""
-        whole = self.chunks.get(view.file_id)
+    def fetch_whole_chunk(self, file_id: str) -> bytes:
+        whole = self.chunks.get(file_id)
         if whole is None:
             last: Exception | None = None
-            for url in self.lookup_fid_urls(view.file_id):
+            for url in self.lookup_fid_urls(file_id):
                 try:
                     whole = download(url)
                     break
                 except Exception as e:  # noqa: BLE001 — try other replicas
                     last = e
             if whole is None:
-                raise FuseError(errno.EIO, f"chunk {view.file_id}: {last}")
-            self.chunks.set(view.file_id, whole)
+                raise FuseError(errno.EIO, f"chunk {file_id}: {last}")
+            self.chunks.set(file_id, whole)
+        return whole
+
+    def read_chunk_view(self, view: filechunks.ChunkView) -> bytes:
+        """Whole-chunk read-through cache, sliced to the view window
+        (reader_at.go:88-104 fetches and caches full chunks)."""
+        whole = self.fetch_whole_chunk(view.file_id)
         return whole[view.offset : view.offset + view.size]
+
+    def resolve_chunks(self, chunks: list) -> list:
+        from ..filer.filechunk_manifest import (
+            has_chunk_manifest,
+            resolve_chunk_manifest,
+        )
+
+        if not has_chunk_manifest(chunks):
+            return chunks
+        return resolve_chunk_manifest(self.fetch_whole_chunk, chunks)
 
     def assign_and_upload(self, path: str, data: bytes) -> filer_pb2.FileChunk:
         resp = self._stub().AssignVolume(
@@ -481,7 +495,10 @@ class FileHandle:
             if self.entry.content:
                 inline = bytes(self.entry.content[offset : offset + size])
                 out[: len(inline)] = inline
-            chunks = list(self.entry.chunks) + self._pending_chunks
+            chunks = (
+                self.wfs.resolve_chunks(list(self.entry.chunks))
+                + self._pending_chunks
+            )
             views = filechunks.view_from_chunks(chunks, offset, size)
             for v in views:
                 blob = self.wfs.read_chunk_view(v)

@@ -1,0 +1,218 @@
+"""Manifest chunks + filer chunk cache.
+
+Reference analogues: weed/filer/filechunk_manifest_test.go and the
+tiered chunk cache behavior of reader_at.go:88-104.
+"""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer import filechunk_manifest as fcm
+from seaweedfs_tpu.filer import filechunks
+from seaweedfs_tpu.pb import filer_pb2
+
+
+def _free_port() -> int:
+    while True:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        if port < 50000:
+            return port
+
+
+def chunk(fid, offset, size, mtime=1):
+    return filer_pb2.FileChunk(file_id=fid, offset=offset, size=size,
+                               mtime=mtime)
+
+
+class BlobStore:
+    """In-memory save/fetch pair for unit tests."""
+
+    def __init__(self):
+        self.blobs = {}
+        self.n = 0
+
+    def save(self, data: bytes) -> filer_pb2.FileChunk:
+        self.n += 1
+        fid = f"m,{self.n:x}"
+        self.blobs[fid] = data
+        return filer_pb2.FileChunk(file_id=fid, size=len(data))
+
+    def fetch(self, fid: str) -> bytes:
+        return self.blobs[fid]
+
+
+def test_manifestize_and_resolve_roundtrip():
+    store = BlobStore()
+    chunks = [chunk(f"1,{i:x}", i * 100, 100, mtime=i) for i in range(10)]
+    folded = fcm.maybe_manifestize(store.save, chunks, manifest_batch=4)
+    # 10 plain -> 2 manifests of 4 + 2 plain tail
+    manifests = [c for c in folded if c.is_chunk_manifest]
+    plain = [c for c in folded if not c.is_chunk_manifest]
+    assert len(manifests) == 2 and len(plain) == 2
+    # manifest chunk spans its batch's byte range
+    assert manifests[0].offset == 0 and manifests[0].size == 400
+    # resolution returns the full original list
+    resolved = fcm.resolve_chunk_manifest(store.fetch, folded)
+    assert sorted(c.file_id for c in resolved) == sorted(
+        c.file_id for c in chunks
+    )
+    assert filechunks.total_size(resolved) == 1000
+    # short lists pass through untouched
+    short = fcm.maybe_manifestize(store.save, chunks[:3], manifest_batch=4)
+    assert [c.file_id for c in short] == [c.file_id for c in chunks[:3]]
+
+
+def test_manifest_of_manifests_resolves():
+    store = BlobStore()
+    chunks = [chunk(f"1,{i:x}", i * 10, 10) for i in range(16)]
+    folded = fcm.maybe_manifestize(store.save, chunks, manifest_batch=4)
+    refolded = fcm.maybe_manifestize(store.save, folded, manifest_batch=4)
+    resolved = fcm.resolve_chunk_manifest(store.fetch, refolded)
+    assert sorted(c.file_id for c in resolved) == sorted(
+        c.file_id for c in chunks
+    )
+
+
+def test_manifest_cycle_detected():
+    m = filer_pb2.FileChunkManifest()
+    mc = chunk("loop,1", 0, 10)
+    mc.is_chunk_manifest = True
+    m.chunks.append(mc)
+    import gzip
+
+    blob = gzip.compress(m.SerializeToString())
+    with pytest.raises(IOError):
+        fcm.resolve_chunk_manifest(lambda fid: blob, [mc])
+
+
+# -- live filer with a tiny manifest batch ----------------------------------
+
+
+@pytest.fixture(scope="module")
+def manifest_cluster(tmp_path_factory):
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    master = MasterServer(ip="127.0.0.1", port=_free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("manvol"))],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=_free_port(), pulse_seconds=0.5,
+    )
+    vs.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and len(master.topo.nodes) < 1:
+        time.sleep(0.1)
+    filer = FilerServer(
+        masters=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=_free_port(), store="memory",
+        max_mb=1,
+        manifest_batch=4,  # tiny: a 6MB file manifestizes
+        chunk_cache_dir=str(tmp_path_factory.mktemp("fcache")),
+    )
+    filer.start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def _http(method, url, data=None):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_filer_manifestizes_large_files(manifest_cluster):
+    _, _, filer = manifest_cluster
+    base = f"http://127.0.0.1:{filer.port}"
+    payload = bytes(range(256)) * 24576  # 6MB -> 6 chunks > batch of 4
+    code, _ = _http("PUT", f"{base}/big/manifested.bin", payload)
+    assert code == 201
+    entry = filer.filer.find_entry("/big/manifested.bin")
+    manifests = [c for c in entry.chunks if c.is_chunk_manifest]
+    assert manifests, "expected the chunk list to be manifestized"
+    assert len(entry.chunks) < 6
+    # reads resolve through the manifest (and populate the chunk cache)
+    code, got = _http("GET", f"{base}/big/manifested.bin")
+    assert code == 200 and got == payload
+    # ranged read across a manifest boundary
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"{base}/big/manifested.bin",
+        headers={"Range": "bytes=4194204-4194404"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.read() == payload[4194204:4194405]
+
+
+def test_chunk_cache_hits_counted(manifest_cluster):
+    from seaweedfs_tpu.stats.metrics import CHUNK_CACHE_COUNTER
+
+    _, _, filer = manifest_cluster
+    base = f"http://127.0.0.1:{filer.port}"
+    payload = b"cachable" * 1000
+    _http("PUT", f"{base}/c/cached.bin", payload)
+
+    def hits():
+        return CHUNK_CACHE_COUNTER.labels("hit").value
+
+    _http("GET", f"{base}/c/cached.bin")
+    h0 = hits()
+    _http("GET", f"{base}/c/cached.bin")
+    assert hits() > h0
+
+
+def test_mount_reads_manifested_file(manifest_cluster):
+    """The mount layer resolves manifest chunks on read too."""
+    from seaweedfs_tpu.mount.wfs import WFS
+
+    _, _, filer = manifest_cluster
+    base = f"http://127.0.0.1:{filer.port}"
+    payload = bytes(range(256)) * 24576  # 6MB, manifestized
+    _http("PUT", f"{base}/mnt/m.bin", payload)
+    w = WFS(filer_grpc=f"127.0.0.1:{filer.grpc_port}",
+            filer_http=f"127.0.0.1:{filer.port}", chunk_size_mb=1)
+    h = w.open("/mnt/m.bin")
+    assert h.read(0, len(payload)) == payload
+    assert h.read((3 << 20) - 10, 20) == payload[(3 << 20) - 10 : (3 << 20) + 10]
+    w.release(h)
+    w.close()
+
+
+def test_manifest_gc_preserves_inner_chunks(manifest_cluster):
+    """Overwriting a manifestized file must delete the manifest AND its
+    inner chunks, while a rewrite folding chunks into a manifest must NOT
+    delete the live inner chunks."""
+    _, _, filer = manifest_cluster
+    base = f"http://127.0.0.1:{filer.port}"
+    payload = bytes(range(256)) * 24576  # 6MB, manifestized
+    _http("PUT", f"{base}/gc/f.bin", payload)
+    code, got = _http("GET", f"{base}/gc/f.bin")
+    assert code == 200 and got == payload
+
+    # overwrite with new content: the old manifest + inner chunks become
+    # garbage; data must still read back correctly afterwards
+    payload2 = bytes(reversed(range(256))) * 24576
+    _http("PUT", f"{base}/gc/f.bin", payload2)
+    code, got = _http("GET", f"{base}/gc/f.bin")
+    assert code == 200 and got == payload2
+
+    # delete: queue drains without failing and the entry is gone
+    code, _ = _http("DELETE", f"{base}/gc/f.bin")
+    assert code in (200, 202, 204)
+    assert filer.filer.find_entry("/gc/f.bin") is None
