@@ -1,0 +1,188 @@
+"""S3 bucket-policy engine + POST-policy (browser form upload) checks.
+
+Reference: weed/s3api/policy/ (post-policy condition evaluation) and the
+AWS bucket-policy document semantics the reference's policy package
+implements: explicit Deny wins, then explicit Allow, else fall through
+to identity-based authorization.
+
+Implemented from the public AWS policy-language specification; pinned by
+tests/test_s3_policy.py.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import fnmatch
+import json
+from dataclasses import dataclass
+
+ALLOW = "Allow"
+DENY = "Deny"
+DEFAULT = ""  # no statement matched: fall through to identity auth
+
+# internal action + key -> s3:* action names
+_ACTION_MAP = {
+    "Read": "s3:GetObject",
+    "Write": "s3:PutObject",
+    "List": "s3:ListBucket",
+    "Tagging": "s3:PutObjectTagging",
+    "Delete": "s3:DeleteObject",
+}
+
+
+def s3_action(internal: str, key: str = "") -> str:
+    return _ACTION_MAP.get(internal, f"s3:{internal}")
+
+
+def resource_arn(bucket: str, key: str = "") -> str:
+    return f"arn:aws:s3:::{bucket}/{key}" if key else f"arn:aws:s3:::{bucket}"
+
+
+class PolicyError(ValueError):
+    pass
+
+
+@dataclass
+class Statement:
+    effect: str
+    principals: list[str]  # "*" or AWS principal strings
+    actions: list[str]
+    not_actions: list[str]
+    resources: list[str]
+
+    def matches(self, principal: str, action: str, resource: str) -> bool:
+        if not any(_wild(p, principal) or p == "*" for p in self.principals):
+            return False
+        if self.not_actions:
+            if any(_wild(a, action) for a in self.not_actions):
+                return False
+        elif not any(_wild(a, action) for a in self.actions):
+            return False
+        return any(_wild(r, resource) for r in self.resources)
+
+
+def _wild(pattern: str, value: str) -> bool:
+    """AWS wildcard match: * and ? only ([ stays literal)."""
+    pattern = pattern.replace("[", "[[]")
+    return fnmatch.fnmatchcase(value, pattern)
+
+
+class BucketPolicy:
+    def __init__(self, statements: list[Statement]):
+        self.statements = statements
+
+    @classmethod
+    def parse(cls, doc: "str | bytes | dict") -> "BucketPolicy":
+        if isinstance(doc, (str, bytes)):
+            try:
+                doc = json.loads(doc)
+            except json.JSONDecodeError as e:
+                raise PolicyError(f"malformed policy JSON: {e}")
+        if not isinstance(doc, dict):
+            raise PolicyError("policy must be a JSON object")
+        statements = []
+        for raw in _as_list(doc.get("Statement")):
+            effect = raw.get("Effect")
+            if effect not in (ALLOW, DENY):
+                raise PolicyError(f"bad Effect {effect!r}")
+            principal = raw.get("Principal", "*")
+            if isinstance(principal, dict):
+                principals = _as_list(principal.get("AWS", []))
+            else:
+                principals = _as_list(principal)
+            actions = _as_list(raw.get("Action", []))
+            not_actions = _as_list(raw.get("NotAction", []))
+            if not actions and not not_actions:
+                raise PolicyError("statement needs Action or NotAction")
+            resources = _as_list(raw.get("Resource", []))
+            if not resources:
+                raise PolicyError("statement needs Resource")
+            statements.append(
+                Statement(effect, [str(p) for p in principals],
+                          actions, not_actions, resources)
+            )
+        if not statements:
+            raise PolicyError("policy has no statements")
+        return cls(statements)
+
+    def evaluate(self, principal: str, action: str, resource: str) -> str:
+        """-> DENY | ALLOW | DEFAULT (explicit deny wins)."""
+        verdict = DEFAULT
+        for s in self.statements:
+            if not s.matches(principal, action, resource):
+                continue
+            if s.effect == DENY:
+                return DENY
+            verdict = ALLOW
+        return verdict
+
+
+def _as_list(x) -> list:
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+# -- POST policy (browser form uploads) --------------------------------------
+
+
+@dataclass
+class PostPolicy:
+    expiration: datetime.datetime
+    conditions: list
+
+    @classmethod
+    def parse(cls, b64: str) -> "PostPolicy":
+        try:
+            doc = json.loads(base64.b64decode(b64))
+        except (ValueError, json.JSONDecodeError) as e:
+            raise PolicyError(f"bad post policy: {e}")
+        exp = doc.get("expiration")
+        if not exp:
+            raise PolicyError("post policy missing expiration")
+        try:
+            expiration = datetime.datetime.strptime(
+                exp, "%Y-%m-%dT%H:%M:%S.%fZ"
+            ).replace(tzinfo=datetime.timezone.utc)
+        except ValueError:
+            expiration = datetime.datetime.strptime(
+                exp, "%Y-%m-%dT%H:%M:%SZ"
+            ).replace(tzinfo=datetime.timezone.utc)
+        return cls(expiration, _as_list(doc.get("conditions")))
+
+    def check(self, form: dict[str, str], content_length: int) -> None:
+        """Validate form fields against the signed conditions
+        (policy/post-policy condition kinds: eq, starts-with,
+        content-length-range)."""
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if now > self.expiration:
+            raise PolicyError("post policy expired")
+        for cond in self.conditions:
+            if isinstance(cond, dict):
+                for k, v in cond.items():
+                    got = form.get(k.lower(), "")
+                    if k.lower().startswith("x-ignore-"):
+                        continue
+                    if got != str(v):
+                        raise PolicyError(f"condition {k}={v!r} not met")
+            elif isinstance(cond, list) and len(cond) == 3:
+                op, name, want = cond
+                if op == "eq":
+                    name = str(name).lstrip("$").lower()
+                    if form.get(name, "") != str(want):
+                        raise PolicyError(f"eq condition on {name} not met")
+                elif op == "starts-with":
+                    name = str(name).lstrip("$").lower()
+                    if not form.get(name, "").startswith(str(want)):
+                        raise PolicyError(
+                            f"starts-with condition on {name} not met"
+                        )
+                elif op == "content-length-range":
+                    lo, hi = int(name), int(want)
+                    if not lo <= content_length <= hi:
+                        raise PolicyError("content-length out of range")
+                else:
+                    raise PolicyError(f"unknown condition op {op!r}")
+            else:
+                raise PolicyError("malformed condition")

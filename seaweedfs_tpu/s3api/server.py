@@ -39,6 +39,15 @@ from .auth import (
     decode_streaming_body,
 )
 from .filer_client import FilerClient, FilerUnavailable
+from .policy import (
+    ALLOW,
+    DENY,
+    BucketPolicy,
+    PolicyError,
+    PostPolicy,
+    resource_arn,
+    s3_action,
+)
 
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
 BUCKETS_DIR = "/buckets"
@@ -57,11 +66,21 @@ class S3ApiServer:
         port: int = 8333,
         config_path: str = "",
         domain: str = "",
+        iam_config_filer_path: str = "",
+        iam_refresh_seconds: float = 3.0,
     ):
         self.port = port
         self.client = FilerClient(filer)
         self.iam = IdentityAccessManagement(config_path, domain)
         self._httpd: ThreadingHTTPServer | None = None
+        # parsed-bucket-policy cache: bucket -> (expires_at, policy|None)
+        self._policy_cache: dict[str, tuple[float, BucketPolicy | None]] = {}
+        self._policy_lock = threading.Lock()
+        # identities shared with the IAM API through the filer
+        # (iamapi writes /etc/iam/identity.json; the gateway re-reads it)
+        self.iam_config_filer_path = iam_config_filer_path
+        self.iam_refresh_seconds = iam_refresh_seconds
+        self._iam_stop = threading.Event()
 
     def start(self) -> None:
         from ..util import glog
@@ -69,13 +88,62 @@ class S3ApiServer:
         handler = type("BoundS3Handler", (S3Handler,), {"s3": self})
         self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        if self.iam_config_filer_path:
+            self.refresh_iam_from_filer()
+            threading.Thread(target=self._iam_refresh_loop,
+                             daemon=True).start()
         glog.info("s3 gateway started port=%d filer=%s auth=%s",
                   self.port, self.client.http_address, self.iam.enabled)
 
     def stop(self) -> None:
+        self._iam_stop.set()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()
+
+    # -- IAM config via filer ------------------------------------------------
+
+    def refresh_iam_from_filer(self) -> None:
+        import json as _json
+
+        try:
+            status, _hdrs, body = self.client.get_object(
+                self.iam_config_filer_path
+            )
+        except Exception:
+            return
+        if status == 200 and body:
+            try:
+                self.iam.load_config(_json.loads(body))
+            except (ValueError, KeyError):
+                pass
+
+    def _iam_refresh_loop(self) -> None:
+        while not self._iam_stop.wait(self.iam_refresh_seconds):
+            self.refresh_iam_from_filer()
+
+    # -- bucket policy -------------------------------------------------------
+
+    def bucket_policy(self, bucket: str) -> BucketPolicy | None:
+        now = time.monotonic()
+        with self._policy_lock:
+            hit = self._policy_cache.get(bucket)
+            if hit and now < hit[0]:
+                return hit[1]
+        entry = self.client.find_entry(BUCKETS_DIR, bucket)
+        pol = None
+        if entry is not None and POLICY_KEY in entry.extended:
+            try:
+                pol = BucketPolicy.parse(bytes(entry.extended[POLICY_KEY]))
+            except PolicyError:
+                pol = None
+        with self._policy_lock:
+            self._policy_cache[bucket] = (now + 5.0, pol)
+        return pol
+
+    def invalidate_policy(self, bucket: str) -> None:
+        with self._policy_lock:
+            self._policy_cache.pop(bucket, None)
 
     # -- path helpers --------------------------------------------------------
 
