@@ -212,6 +212,8 @@ def cmd_shell(args) -> None:
     from .shell.commands import CommandEnv, run_command
 
     env = CommandEnv(_grpc_addr(args.master))
+    if getattr(args, "filer", ""):
+        env.option["filer"] = args.filer
     if args.command:
         print(run_command(env, args.command))
         return
@@ -395,6 +397,8 @@ def main(argv=None) -> None:
 
     sh = sub.add_parser("shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
+    sh.add_argument("-filer", default="",
+                    help="filer http address for fs.*/s3.* commands")
     sh.add_argument("-c", dest="command", default="")
     sh.set_defaults(fn=cmd_shell)
 

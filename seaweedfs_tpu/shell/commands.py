@@ -111,4 +111,5 @@ def run_maintenance(env: CommandEnv) -> list[str]:
 
 # import command modules for registration side effects
 from . import ec_commands  # noqa: E402,F401
+from . import fs_commands  # noqa: E402,F401
 from . import volume_commands  # noqa: E402,F401

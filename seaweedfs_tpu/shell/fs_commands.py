@@ -1,0 +1,500 @@
+"""Admin-shell filer namespace + collection + s3 bucket commands.
+
+Reference surface: weed/shell/command_fs_*.go (ls/cat/du/tree/mv/cd/pwd,
+meta save/load/cat), command_collection_{list,delete}.go and
+command_s3_{bucket_create,bucket_delete,bucket_list,clean_uploads}.go.
+The designs differ where Python allows: commands return their output as a
+string (run_command contract in commands.py), paths resolve against a
+per-env working directory, and traversal is plain recursion over the
+paged ListEntries rpc rather than goroutine/channel pipelines.
+
+fs.meta.save/load use the same on-disk format as the reference
+(command_fs_meta_save.go:74-90): a stream of [u32 big-endian size]
+[marshalled filer_pb.FullEntry] records, so .meta snapshots are
+interchangeable at the wire level.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+
+import grpc
+
+from ..pb import filer_pb2, master_pb2
+from ..s3api.filer_client import FilerClient
+from .commands import CommandEnv, register
+
+BUCKETS_DIR = "/buckets"
+UPLOADS_DIR = ".uploads"
+
+
+# ---------------------------------------------------------------------------
+# env helpers
+
+
+def _filer(env: CommandEnv) -> FilerClient:
+    addr = env.option.get("filer")
+    if not addr:
+        raise ValueError("no filer configured; start the shell with -filer")
+    return FilerClient(addr)
+
+
+def _cwd(env: CommandEnv) -> str:
+    return env.option.get("fs_cwd", "/")
+
+
+def _resolve(env: CommandEnv, path: str | None) -> str:
+    """Make an absolute filer path from a command argument."""
+    cwd = _cwd(env)
+    if not path or path == ".":
+        return cwd
+    if not path.startswith("/"):
+        path = cwd.rstrip("/") + "/" + path
+    # normalise //, trailing / (but keep root)
+    parts = [p for p in path.split("/") if p and p != "."]
+    out: list[str] = []
+    for p in parts:
+        if p == "..":
+            if out:
+                out.pop()
+        else:
+            out.append(p)
+    return "/" + "/".join(out)
+
+
+def _split(path: str) -> tuple[str, str]:
+    path = path.rstrip("/") or "/"
+    if path == "/":
+        return "/", ""
+    i = path.rindex("/")
+    return (path[:i] or "/"), path[i + 1 :]
+
+
+def _is_directory(client: FilerClient, path: str) -> bool:
+    if path == "/":
+        return True
+    d, n = _split(path)
+    e = client.find_entry(d, n)
+    return e is not None and e.is_directory
+
+
+def _iter_dir(client: FilerClient, directory: str, prefix: str = ""):
+    """Yield every entry of a directory, paging through ListEntries."""
+    start, inclusive = "", False
+    while True:
+        batch = client.list_entries(
+            directory, prefix=prefix, start_from=start,
+            inclusive=inclusive, limit=1024,
+        )
+        yield from batch
+        if len(batch) < 1024:
+            return
+        start, inclusive = batch[-1].name, False
+
+
+def _select(client: FilerClient, path: str):
+    """Resolve a path argument the way the fs.* commands do: a directory
+    yields its entries; a file/prefix yields matching siblings.
+    Returns (directory, [entries])."""
+    if _is_directory(client, path):
+        return path, list(_iter_dir(client, path))
+    d, n = _split(path)
+    return d, [e for e in _iter_dir(client, d, prefix=n)]
+
+
+def _flags(
+    args: list[str], bools: tuple[str, ...] = ("l", "a", "r", "v", "force")
+) -> tuple[set[str], dict[str, str], list[str]]:
+    """Split ["-l", "-name", "x", "path"] into boolean flags, -key value
+    options, and positionals.  Flags named in `bools` never consume the
+    next token; anything else takes a value (-key value or -key=value)."""
+    short: set[str] = set()
+    opts: dict[str, str] = {}
+    pos: list[str] = []
+    i = 0
+    while i < len(args):
+        a = args[i]
+        if a.startswith("-") and len(a) > 1:
+            key = a.lstrip("-")
+            if "=" in key:
+                k, _, v = key.partition("=")
+                opts[k] = v
+            elif key in bools or all(c in bools for c in key):
+                short.update(key)
+            elif i + 1 < len(args):
+                opts[key] = args[i + 1]
+                i += 1
+            else:
+                short.add(key)
+            i += 1
+        else:
+            pos.append(a)
+            i += 1
+    return short, opts, pos
+
+
+def _parse_duration(s: str) -> float:
+    """"24h" / "90m" / "1.5h" / "300s" -> seconds."""
+    units = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+    total, num = 0.0, ""
+    for ch in s:
+        if ch.isdigit() or ch == ".":
+            num += ch
+        elif ch in units and num:
+            total += float(num) * units[ch]
+            num = ""
+        else:
+            raise ValueError(f"bad duration {s!r}")
+    if num:
+        total += float(num)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# fs.* namespace commands
+
+
+@register("fs.pwd")
+def fs_pwd(env: CommandEnv, args: list[str]) -> str:
+    return _cwd(env)
+
+
+@register("fs.cd")
+def fs_cd(env: CommandEnv, args: list[str]) -> str:
+    client = _filer(env)
+    target = _resolve(env, args[0] if args else "/")
+    if not _is_directory(client, target):
+        raise ValueError(f"not a directory: {target}")
+    env.option["fs_cwd"] = target
+    return target
+
+
+@register("fs.ls")
+def fs_ls(env: CommandEnv, args: list[str]) -> str:
+    short, _, pos = _flags(args)
+    long_format = "l" in short
+    show_hidden = "a" in short
+    client = _filer(env)
+    path = _resolve(env, pos[0] if pos else None)
+    directory, entries = _select(client, path)
+    out = []
+    n = 0
+    for e in entries:
+        if not show_hidden and e.name.startswith("."):
+            continue
+        n += 1
+        if long_format:
+            a = e.attributes
+            kind = "d" if e.is_directory else "-"
+            size = sum(c.size for c in e.chunks) or len(e.content)
+            mtime = time.strftime(
+                "%Y-%m-%d %H:%M", time.localtime(a.mtime or 0))
+            out.append(
+                f"{kind}{a.file_mode & 0o7777:04o} {a.uid:>5} {a.gid:>5} "
+                f"{size:>12} {mtime} "
+                f"{directory.rstrip('/')}/{e.name}"
+            )
+        else:
+            out.append(e.name)
+    if long_format:
+        out.append(f"total {n}")
+    return "\n".join(out)
+
+
+@register("fs.cat")
+def fs_cat(env: CommandEnv, args: list[str]) -> str:
+    if not args:
+        raise ValueError("fs.cat <path>")
+    client = _filer(env)
+    path = _resolve(env, args[0])
+    if _is_directory(client, path):
+        raise ValueError(f"{path} is a directory")
+    status, _, body = client.get_object(path)
+    if status != 200:
+        raise ValueError(f"read {path}: HTTP {status}")
+    return body.decode("utf-8", errors="replace")
+
+
+@register("fs.du")
+def fs_du(env: CommandEnv, args: list[str]) -> str:
+    client = _filer(env)
+    path = _resolve(env, args[0] if args else None)
+    out: list[str] = []
+
+    def walk(directory: str, prefix: str) -> tuple[int, int]:
+        blocks = byte_count = 0
+        for e in _iter_dir(client, directory, prefix=prefix):
+            child = directory.rstrip("/") + "/" + e.name
+            if e.is_directory:
+                b, s = walk(child, "")
+            else:
+                b = len(e.chunks)
+                s = sum(c.size for c in e.chunks) or len(e.content)
+                out.append(f"block:{b:4d}\tbyte:{s:10d}\t{child}")
+            blocks += b
+            byte_count += s
+        return blocks, byte_count
+
+    if _is_directory(client, path):
+        b, s = walk(path, "")
+        out.append(f"block:{b:4d}\tbyte:{s:10d}\t{path}")
+    else:
+        d, n = _split(path)
+        walk(d, n)
+    return "\n".join(out)
+
+
+@register("fs.tree")
+def fs_tree(env: CommandEnv, args: list[str]) -> str:
+    client = _filer(env)
+    path = _resolve(env, args[0] if args else None)
+    out: list[str] = []
+
+    def walk(directory: str, prefix: str, indent: str) -> tuple[int, int]:
+        dirs = files = 0
+        entries = [e for e in _iter_dir(client, directory, prefix=prefix)]
+        for i, e in enumerate(entries):
+            last = i == len(entries) - 1
+            out.append(f"{indent}{'└──' if last else '├──'} {e.name}")
+            if e.is_directory:
+                dirs += 1
+                sub = indent + ("    " if last else "│   ")
+                d2, f2 = walk(
+                    directory.rstrip("/") + "/" + e.name, "", sub)
+                dirs += d2
+                files += f2
+            else:
+                files += 1
+        return dirs, files
+
+    if _is_directory(client, path):
+        out.append(path)
+        dirs, files = walk(path, "", "")
+    else:
+        d, n = _split(path)
+        dirs, files = walk(d, n, "")
+    out.append(f"{dirs} directories, {files} files")
+    return "\n".join(out)
+
+
+@register("fs.mv")
+def fs_mv(env: CommandEnv, args: list[str]) -> str:
+    if len(args) != 2:
+        raise ValueError("fs.mv <source> <destination>")
+    client = _filer(env)
+    src = _resolve(env, args[0])
+    dst = _resolve(env, args[1])
+    src_dir, src_name = _split(src)
+    # moving INTO an existing directory keeps the source name
+    if _is_directory(client, dst):
+        dst_dir, dst_name = dst, src_name
+    else:
+        dst_dir, dst_name = _split(dst)
+    client.stub().AtomicRenameEntry(
+        filer_pb2.AtomicRenameEntryRequest(
+            old_directory=src_dir, old_name=src_name,
+            new_directory=dst_dir, new_name=dst_name,
+        )
+    )
+    return f"move: {src} => {dst_dir.rstrip('/')}/{dst_name}"
+
+
+@register("fs.rm")
+def fs_rm(env: CommandEnv, args: list[str]) -> str:
+    short, _, pos = _flags(args)
+    if not pos:
+        raise ValueError("fs.rm [-r] <path>")
+    client = _filer(env)
+    path = _resolve(env, pos[0])
+    d, n = _split(path)
+    client.delete_entry(d, n, is_delete_data=True,
+                        is_recursive="r" in short)
+    return f"removed {path}"
+
+
+# -- fs.meta.* --------------------------------------------------------------
+
+
+def _walk_full_entries(client: FilerClient, directory: str):
+    """BFS over the subtree rooted at `directory`, yielding FullEntry pbs
+    (the fs.meta.save stream unit, command_fs_meta_save.go:83)."""
+    queue = [directory]
+    while queue:
+        d = queue.pop(0)
+        for e in _iter_dir(client, d):
+            yield filer_pb2.FullEntry(dir=d, entry=e)
+            if e.is_directory:
+                queue.append(d.rstrip("/") + "/" + e.name)
+
+
+@register("fs.meta.save")
+def fs_meta_save(env: CommandEnv, args: list[str]) -> str:
+    short, opts, pos = _flags(args)
+    client = _filer(env)
+    path = _resolve(env, pos[0] if pos else None)
+    fname = opts.get("o")
+    if not fname:
+        host, _, port = env.option.get("filer", "filer:8888").partition(":")
+        fname = f"{host}-{port}-{time.strftime('%Y%m%d-%H%M%S')}.meta"
+    dirs = files = 0
+    with open(fname, "wb") as f:
+        for fe in _walk_full_entries(client, path):
+            blob = fe.SerializeToString()
+            f.write(struct.pack(">I", len(blob)))
+            f.write(blob)
+            if fe.entry.is_directory:
+                dirs += 1
+            else:
+                files += 1
+    return (f"total {dirs} directories, {files} files\n"
+            f"meta data for {path} is saved to {fname}")
+
+
+@register("fs.meta.load")
+def fs_meta_load(env: CommandEnv, args: list[str]) -> str:
+    if not args:
+        raise ValueError("fs.meta.load <file.meta>")
+    client = _filer(env)
+    stub = client.stub()
+    dirs = files = 0
+    out = []
+    with open(args[-1], "rb") as f:
+        while True:
+            hdr = f.read(4)
+            if len(hdr) < 4:
+                break
+            (size,) = struct.unpack(">I", hdr)
+            fe = filer_pb2.FullEntry()
+            fe.ParseFromString(f.read(size))
+            stub.CreateEntry(filer_pb2.CreateEntryRequest(
+                directory=fe.dir, entry=fe.entry))
+            out.append(
+                f"load {fe.dir.rstrip('/')}/{fe.entry.name}")
+            if fe.entry.is_directory:
+                dirs += 1
+            else:
+                files += 1
+    out.append(f"total {dirs} directories, {files} files")
+    out.append(f"{args[-1]} is loaded.")
+    return "\n".join(out)
+
+
+@register("fs.meta.cat")
+def fs_meta_cat(env: CommandEnv, args: list[str]) -> str:
+    if not args:
+        raise ValueError("fs.meta.cat <path>")
+    client = _filer(env)
+    path = _resolve(env, args[0])
+    d, n = _split(path)
+    e = client.find_entry(d, n)
+    if e is None:
+        raise ValueError(f"no entry {path}")
+    return str(e)
+
+
+# ---------------------------------------------------------------------------
+# collection.* commands (master-side)
+
+
+@register("collection.list")
+def collection_list(env: CommandEnv, args: list[str]) -> str:
+    resp = env.master().CollectionList(master_pb2.CollectionListRequest(
+        include_normal_volumes=True, include_ec_volumes=True))
+    out = [f'collection:"{c.name}"' for c in resp.collections]
+    out.append(f"Total {len(resp.collections)} collections.")
+    return "\n".join(out)
+
+
+@register("collection.delete")
+def collection_delete(env: CommandEnv, args: list[str]) -> str:
+    _, opts, pos = _flags(args)
+    name = opts.get("collection", pos[0] if pos else "")
+    if not name:
+        raise ValueError("collection.delete <name>")
+    env.master().CollectionDelete(
+        master_pb2.CollectionDeleteRequest(name=name))
+    return f"collection {name} is deleted."
+
+
+# ---------------------------------------------------------------------------
+# s3.* bucket commands (filer-side, /buckets convention)
+
+
+def _buckets_path(client: FilerClient) -> str:
+    try:
+        resp = client.stub().GetFilerConfiguration(
+            filer_pb2.GetFilerConfigurationRequest())
+        return resp.dir_buckets or BUCKETS_DIR
+    except grpc.RpcError:
+        return BUCKETS_DIR
+
+
+@register("s3.bucket.list")
+def s3_bucket_list(env: CommandEnv, args: list[str]) -> str:
+    client = _filer(env)
+    out = []
+    for e in _iter_dir(client, _buckets_path(client)):
+        if e.is_directory and not e.name.startswith("."):
+            out.append(e.name)
+    return "\n".join(out)
+
+
+@register("s3.bucket.create")
+def s3_bucket_create(env: CommandEnv, args: list[str]) -> str:
+    _, opts, pos = _flags(args)
+    name = opts.get("name", pos[0] if pos else "")
+    if not name:
+        raise ValueError("s3.bucket.create -name <bucket>")
+    client = _filer(env)
+    bp = _buckets_path(client)
+    now = int(time.time())
+    entry = filer_pb2.Entry(
+        name=name, is_directory=True,
+        attributes=filer_pb2.FuseAttributes(
+            mtime=now, crtime=now, file_mode=0o40777,
+            collection=name,
+            replication=opts.get("replication", ""),
+        ),
+    )
+    client.create_entry(bp, entry)
+    return f"created bucket {name}"
+
+
+@register("s3.bucket.delete")
+def s3_bucket_delete(env: CommandEnv, args: list[str]) -> str:
+    _, opts, pos = _flags(args)
+    name = opts.get("name", pos[0] if pos else "")
+    if not name:
+        raise ValueError("s3.bucket.delete -name <bucket>")
+    client = _filer(env)
+    bp = _buckets_path(client)
+    client.delete_entry(bp, name, is_delete_data=True, is_recursive=True)
+    # the bucket's backing collection goes with it (reference deletes the
+    # collection so the volumes are reclaimed, command_s3_bucket_delete.go)
+    try:
+        env.master().CollectionDelete(
+            master_pb2.CollectionDeleteRequest(name=name))
+    except grpc.RpcError:
+        pass  # bucket may never have grown volumes
+    return f"deleted bucket {name}"
+
+
+@register("s3.clean.uploads")
+def s3_clean_uploads(env: CommandEnv, args: list[str]) -> str:
+    _, opts, _ = _flags(args)
+    age_s = _parse_duration(opts.get("timeAgo", "24h"))
+    client = _filer(env)
+    bp = _buckets_path(client)
+    now = time.time()
+    out = []
+    for bucket in _iter_dir(client, bp):
+        if not bucket.is_directory:
+            continue
+        updir = f"{bp}/{bucket.name}/{UPLOADS_DIR}"
+        for up in _iter_dir(client, updir):
+            if up.attributes.crtime + age_s < now:
+                client.delete_entry(
+                    updir, up.name, is_delete_data=True, is_recursive=True)
+                out.append(f"purge {updir}/{up.name}")
+    return "\n".join(out)

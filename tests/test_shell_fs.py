@@ -1,0 +1,194 @@
+"""fs.* / collection.* / s3.* admin-shell commands against a live stack.
+
+Reference semantics: weed/shell/command_fs_ls.go, command_fs_du.go,
+command_fs_tree.go, command_fs_mv.go, command_fs_meta_save.go /
+command_fs_meta_load.go (the 4-byte-size + FullEntry .meta stream),
+command_collection_list.go, command_s3_bucket_create.go,
+command_s3_clean_uploads.go.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _poll(fn, ok, timeout=10.0):
+    deadline = time.time() + timeout
+    out = fn()
+    while not ok(out) and time.time() < deadline:
+        time.sleep(0.2)
+        out = fn()
+    return out
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    master = MasterServer(ip="127.0.0.1", port=_free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("shellvol"))],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=_free_port(), pulse_seconds=0.5,
+        max_volume_count=100,
+    )
+    vs.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and len(master.topo.nodes) < 1:
+        time.sleep(0.1)
+    filer = FilerServer(
+        masters=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=_free_port(),
+        store="memory",
+        max_mb=1,
+    )
+    filer.start()
+    env = CommandEnv(f"127.0.0.1:{master.grpc_port}")
+    env.option["filer"] = f"127.0.0.1:{filer.port}"
+    yield env, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+@pytest.fixture(scope="module")
+def populated(stack):
+    """Seed a small namespace through the filer HTTP path."""
+    env, filer = stack
+    from seaweedfs_tpu.s3api.filer_client import FilerClient
+
+    client = FilerClient(f"127.0.0.1:{filer.port}")
+    client.put_object("/data/a.txt", b"alpha\n", mime="text/plain")
+    client.put_object("/data/b.txt", b"bravo-bravo\n", mime="text/plain")
+    client.put_object("/data/sub/c.bin", b"\x00" * 1024)
+    client.put_object("/data/.hidden", b"shh")
+    return env, client
+
+
+def test_fs_ls(populated):
+    env, _ = populated
+    names = run_command(env, "fs.ls /data").splitlines()
+    assert names == ["a.txt", "b.txt", "sub"]
+    all_names = run_command(env, "fs.ls -a /data").splitlines()
+    assert ".hidden" in all_names
+    long = run_command(env, "fs.ls -l /data")
+    assert "a.txt" in long and long.strip().endswith("total 3")
+    # prefix listing
+    assert run_command(env, "fs.ls /data/a").splitlines() == ["a.txt"]
+
+
+def test_fs_cd_pwd(populated):
+    env, _ = populated
+    assert run_command(env, "fs.pwd") == "/"
+    run_command(env, "fs.cd /data")
+    assert run_command(env, "fs.pwd") == "/data"
+    # relative listing from cwd
+    assert "a.txt" in run_command(env, "fs.ls").splitlines()
+    run_command(env, "fs.cd sub")
+    assert run_command(env, "fs.pwd") == "/data/sub"
+    run_command(env, "fs.cd /")
+    with pytest.raises(ValueError):
+        run_command(env, "fs.cd /data/a.txt")
+
+
+def test_fs_cat(populated):
+    env, _ = populated
+    assert run_command(env, "fs.cat /data/a.txt") == "alpha\n"
+    with pytest.raises(ValueError):
+        run_command(env, "fs.cat /data")
+
+
+def test_fs_du(populated):
+    env, _ = populated
+    out = run_command(env, "fs.du /data")
+    # per-file rows plus the directory total on the last line
+    assert out.splitlines()[-1].endswith("/data")
+    total = int(out.splitlines()[-1].split("byte:")[1].split("\t")[0])
+    assert total >= 6 + 12 + 1024
+
+
+def test_fs_tree(populated):
+    env, _ = populated
+    out = run_command(env, "fs.tree /data")
+    assert "├── a.txt" in out or "└── a.txt" in out
+    assert "c.bin" in out
+    assert out.splitlines()[-1].startswith("1 directories, 4 files")
+
+
+def test_fs_mv(populated):
+    env, client = populated
+    client.put_object("/data/mv-me.txt", b"move")
+    run_command(env, "fs.mv /data/mv-me.txt /data/sub")
+    assert client.find_entry("/data", "mv-me.txt") is None
+    assert client.find_entry("/data/sub", "mv-me.txt") is not None
+    run_command(env, "fs.mv /data/sub/mv-me.txt /data/renamed.txt")
+    assert client.find_entry("/data", "renamed.txt") is not None
+    run_command(env, "fs.rm /data/renamed.txt")
+    assert client.find_entry("/data", "renamed.txt") is None
+
+
+def test_fs_meta_cat(populated):
+    env, _ = populated
+    out = run_command(env, "fs.meta.cat /data/a.txt")
+    assert "a.txt" in out and "chunks" in out
+
+
+def test_fs_meta_save_load(populated, tmp_path):
+    env, client = populated
+    meta = tmp_path / "snap.meta"
+    out = run_command(env, f"fs.meta.save -o {meta} /data")
+    assert "saved to" in out
+    # wipe the subtree, then restore the namespace (metadata only)
+    client.delete_entry("/data", "sub", is_delete_data=False,
+                        is_recursive=True)
+    assert client.find_entry("/data", "sub") is None
+    out = run_command(env, f"fs.meta.load {meta}")
+    assert "is loaded." in out
+    assert client.find_entry("/data", "sub") is not None
+    assert client.find_entry("/data/sub", "c.bin") is not None
+
+
+def test_collection_and_buckets(populated):
+    env, client = populated
+    run_command(env, "s3.bucket.create -name shelltest")
+    assert "shelltest" in run_command(env, "s3.bucket.list").splitlines()
+    # objects in the bucket land in collection "shelltest"; the master
+    # learns collections from volume-server heartbeats, so poll a pulse
+    client.put_object("/buckets/shelltest/obj", b"payload" * 100)
+    cols = _poll(lambda: run_command(env, "collection.list"),
+                 lambda out: 'collection:"shelltest"' in out)
+    assert 'collection:"shelltest"' in cols
+    run_command(env, "s3.bucket.delete -name shelltest")
+    assert "shelltest" not in run_command(env, "s3.bucket.list").splitlines()
+    cols = _poll(lambda: run_command(env, "collection.list"),
+                 lambda out: 'collection:"shelltest"' not in out)
+    assert 'collection:"shelltest"' not in cols
+
+
+def test_s3_clean_uploads(populated):
+    env, client = populated
+    run_command(env, "s3.bucket.create -name upbucket")
+    client.mkdir("/buckets/upbucket", ".uploads")
+    client.put_object("/buckets/upbucket/.uploads/stale1/part1", b"x")
+    client.put_object("/buckets/upbucket/.uploads/stale2/part1", b"y")
+    # nothing older than 24h yet
+    assert run_command(env, "s3.clean.uploads") == ""
+    out = run_command(env, "s3.clean.uploads -timeAgo 0s")
+    assert "purge" in out
+    assert client.find_entry("/buckets/upbucket/.uploads", "stale1") is None
+    run_command(env, "s3.bucket.delete -name upbucket")
