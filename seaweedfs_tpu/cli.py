@@ -202,9 +202,19 @@ def cmd_s3(args) -> None:
         port=args.port,
         config_path=args.config,
         domain=args.domainName,
+        iam_config_filer_path=args.iam_config or "",
     )
     s.start()
     print(f"s3 gateway http={args.port} filer={args.filer}")
+    _wait()
+
+
+def cmd_iam(args) -> None:
+    from .iamapi.server import IamApiServer
+
+    s = IamApiServer(filer=args.filer, port=args.port)
+    s.start()
+    print(f"iam api http={args.port} filer={args.filer}")
     _wait()
 
 
@@ -393,7 +403,16 @@ def main(argv=None) -> None:
     s3p.add_argument("-config", default="",
                      help="s3 identities json (empty = auth disabled)")
     s3p.add_argument("-domainName", default="")
+    s3p.add_argument("-iam.config", dest="iam_config",
+                     default="/etc/iam/identity.json",
+                     help="filer path of the IAM-managed identity json "
+                          "('' disables the live-reload loop)")
     s3p.set_defaults(fn=cmd_s3)
+
+    iamp = sub.add_parser("iam")
+    iamp.add_argument("-filer", default="127.0.0.1:8888")
+    iamp.add_argument("-port", type=int, default=8111)
+    iamp.set_defaults(fn=cmd_iam)
 
     sh = sub.add_parser("shell")
     sh.add_argument("-master", default="127.0.0.1:9333")

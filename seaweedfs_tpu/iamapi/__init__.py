@@ -1,0 +1,8 @@
+"""IAM API: user / access-key / policy CRUD persisting s3 identities.
+
+Reference: weed/iamapi/ (iamapi_server.go, iamapi_management_handlers.go).
+"""
+
+from .server import IamApiServer
+
+__all__ = ["IamApiServer"]

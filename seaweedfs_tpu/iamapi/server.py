@@ -1,0 +1,407 @@
+"""AWS IAM Query-protocol API over the filer-persisted s3 identity config.
+
+Reference surface: weed/iamapi/iamapi_server.go (POST / router, config
+stored inside the filer at /etc/iam/identity.json + policies.json) and
+iamapi_management_handlers.go (the Action switch: ListUsers,
+ListAccessKeys, Create/Get/DeleteUser, Create/DeleteAccessKey,
+CreatePolicy, Put/Get/DeleteUserPolicy; s3-statement <-> identity-action
+mapping).  The s3 gateway tails the same identity.json
+(`S3ApiServer.refresh_iam_from_filer`), so changes made here take effect
+on live signed requests within its refresh interval.
+
+Design differences from the reference: responses are built with
+ElementTree against the IAM 2010-05-08 namespace instead of aws-sdk-go
+response structs, and DeleteUserPolicy clears the user's actions rather
+than dropping the whole identity (the reference removes the identity,
+which also deletes its credentials — surprising for an IAM caller).
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import string
+import threading
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
+
+from ..s3api.auth import (
+    ACTION_ADMIN,
+    ACTION_LIST,
+    ACTION_READ,
+    ACTION_TAGGING,
+    ACTION_WRITE,
+    AuthError,
+    IdentityAccessManagement,
+    S3HttpRequest,
+)
+from ..s3api.filer_client import FilerClient
+
+IAM_XMLNS = "https://iam.amazonaws.com/doc/2010-05-08/"
+IAM_CONFIG_DIR = "/etc/iam"
+IAM_IDENTITY_FILE = "identity.json"
+IAM_POLICIES_FILE = "policies.json"
+POLICY_DOCUMENT_VERSION = "2012-10-17"
+
+# s3 policy statement action <-> identity action (the reference's
+# MapToStatementAction / MapToIdentitiesAction tables)
+_STATEMENT_TO_ACTION = {
+    "*": ACTION_ADMIN,
+    "Put*": ACTION_WRITE,
+    "Get*": ACTION_READ,
+    "List*": ACTION_LIST,
+    "Tagging*": ACTION_TAGGING,
+}
+_ACTION_TO_STATEMENT = {v: k for k, v in _STATEMENT_TO_ACTION.items()}
+
+
+class IamError(Exception):
+    def __init__(self, code: str, message: str, status: int = 400):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.status = status
+
+
+def _no_such_entity(kind: str, name: str) -> IamError:
+    return IamError(
+        "NoSuchEntity", f"the {kind} with name {name} cannot be found.", 404
+    )
+
+
+def policy_to_actions(doc: dict) -> list[str]:
+    """Allow-statements -> identity actions ("Read", "Write:bucket", ...)."""
+    actions: list[str] = []
+    for st in doc.get("Statement", []):
+        if st.get("Effect") != "Allow":
+            continue
+        resources = st.get("Resource", [])
+        stmt_actions = st.get("Action", [])
+        if isinstance(resources, str):
+            resources = [resources]
+        if isinstance(stmt_actions, str):
+            stmt_actions = [stmt_actions]
+        for res in resources:
+            parts = res.split(":")
+            if len(parts) != 6 or parts[:3] != ["arn", "aws", "s3"]:
+                continue
+            target = parts[5]
+            for act in stmt_actions:
+                svc, _, name = act.partition(":")
+                if svc != "s3":
+                    continue
+                mapped = _STATEMENT_TO_ACTION.get(name)
+                if not mapped:
+                    continue
+                if target == "*":
+                    actions.append(mapped)
+                    continue
+                bucket, _, rest = target.partition("/")
+                if rest == "*":
+                    actions.append(f"{mapped}:{bucket}")
+    return actions
+
+
+def actions_to_policy(actions: list[str]) -> dict:
+    """Identity actions -> a policy document (GetUserPolicy shape)."""
+    by_resource: dict[str, list[str]] = {}
+    for a in actions:
+        base, _, bucket = a.partition(":")
+        res = f"arn:aws:s3:::{bucket}/*" if bucket else "*"
+        stmt = _ACTION_TO_STATEMENT.get(base)
+        if stmt:
+            by_resource.setdefault(res, []).append(f"s3:{stmt}")
+    return {
+        "Version": POLICY_DOCUMENT_VERSION,
+        "Statement": [
+            {"Effect": "Allow", "Action": acts, "Resource": [res]}
+            for res, acts in by_resource.items()
+        ],
+    }
+
+
+class IamApiServer:
+    """Serves the IAM Query API; state lives in the filer, not here."""
+
+    def __init__(self, filer: str = "127.0.0.1:8888", port: int = 8111):
+        self.port = port
+        self.client = FilerClient(filer)
+        self._httpd: ThreadingHTTPServer | None = None
+        self._lock = threading.Lock()  # config read-modify-write
+
+    # -- filer-persisted config ---------------------------------------------
+
+    def _read_json(self, name: str) -> dict:
+        try:
+            status, _, body = self.client.get_object(
+                f"{IAM_CONFIG_DIR}/{name}")
+        except Exception:
+            return {}
+        if status != 200 or not body:
+            return {}
+        try:
+            return json.loads(body)
+        except ValueError:
+            return {}
+
+    def _write_json(self, name: str, conf: dict) -> None:
+        self.client.put_object(
+            f"{IAM_CONFIG_DIR}/{name}",
+            json.dumps(conf, indent=2).encode(),
+            mime="application/json",
+        )
+
+    def get_s3_config(self) -> dict:
+        conf = self._read_json(IAM_IDENTITY_FILE)
+        conf.setdefault("identities", [])
+        return conf
+
+    def put_s3_config(self, conf: dict) -> None:
+        self._write_json(IAM_IDENTITY_FILE, conf)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        from ..util import glog
+
+        handler = type("BoundIamHandler", (IamHandler,), {"iam_server": self})
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+        glog.info("iam api started port=%d filer=%s",
+                  self.port, self.client.http_address)
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    # -- actions (each takes the live config dict, returns result element) --
+
+    @staticmethod
+    def _find(conf: dict, user: str) -> dict | None:
+        for ident in conf["identities"]:
+            if ident.get("name") == user:
+                return ident
+        return None
+
+    def do_action(self, action: str, params: dict[str, str],
+                  conf: dict | None = None) -> tuple[ET.Element, bool]:
+        """Returns (result XML element, config_changed)."""
+        if conf is None:
+            conf = self.get_s3_config()
+        root = ET.Element(f"{action}Response", xmlns=IAM_XMLNS)
+        result = ET.SubElement(root, f"{action}Result")
+        changed = False
+        user = params.get("UserName", "")
+
+        if action == "ListUsers":
+            users = ET.SubElement(result, "Users")
+            for ident in conf["identities"]:
+                m = ET.SubElement(users, "member")
+                ET.SubElement(m, "UserName").text = ident.get("name", "")
+            ET.SubElement(result, "IsTruncated").text = "false"
+
+        elif action == "ListAccessKeys":
+            keys = ET.SubElement(result, "AccessKeyMetadata")
+            for ident in conf["identities"]:
+                if user and ident.get("name") != user:
+                    continue
+                for cred in ident.get("credentials", []):
+                    m = ET.SubElement(keys, "member")
+                    ET.SubElement(m, "UserName").text = ident.get("name", "")
+                    ET.SubElement(m, "AccessKeyId").text = cred["accessKey"]
+                    ET.SubElement(m, "Status").text = "Active"
+            ET.SubElement(result, "IsTruncated").text = "false"
+
+        elif action == "CreateUser":
+            if self._find(conf, user) is not None:
+                raise IamError(
+                    "EntityAlreadyExists",
+                    f"user with name {user} already exists.", 409)
+            conf["identities"].append(
+                {"name": user, "credentials": [], "actions": []})
+            u = ET.SubElement(result, "User")
+            ET.SubElement(u, "UserName").text = user
+            changed = True
+
+        elif action == "GetUser":
+            if self._find(conf, user) is None:
+                raise _no_such_entity("user", user)
+            u = ET.SubElement(result, "User")
+            ET.SubElement(u, "UserName").text = user
+
+        elif action == "DeleteUser":
+            if self._find(conf, user) is None:
+                raise _no_such_entity("user", user)
+            conf["identities"] = [
+                i for i in conf["identities"] if i.get("name") != user]
+            changed = True
+
+        elif action == "CreateAccessKey":
+            access_key = "".join(
+                secrets.choice(string.ascii_uppercase + string.digits)
+                for _ in range(21))
+            secret_key = "".join(
+                secrets.choice(string.ascii_letters + string.digits + "/")
+                for _ in range(42))
+            ident = self._find(conf, user)
+            if ident is None:
+                ident = {"name": user, "credentials": [], "actions": []}
+                conf["identities"].append(ident)
+            ident.setdefault("credentials", []).append(
+                {"accessKey": access_key, "secretKey": secret_key})
+            k = ET.SubElement(result, "AccessKey")
+            ET.SubElement(k, "UserName").text = user
+            ET.SubElement(k, "AccessKeyId").text = access_key
+            ET.SubElement(k, "Status").text = "Active"
+            ET.SubElement(k, "SecretAccessKey").text = secret_key
+            changed = True
+
+        elif action == "DeleteAccessKey":
+            key_id = params.get("AccessKeyId", "")
+            ident = self._find(conf, user)
+            if ident is not None:
+                before = len(ident.get("credentials", []))
+                ident["credentials"] = [
+                    c for c in ident.get("credentials", [])
+                    if c["accessKey"] != key_id]
+                changed = len(ident["credentials"]) != before
+
+        elif action == "CreatePolicy":
+            name = params.get("PolicyName", "")
+            try:
+                doc = json.loads(params.get("PolicyDocument", ""))
+            except ValueError as e:
+                raise IamError("MalformedPolicyDocument", str(e))
+            policies = self._read_json(IAM_POLICIES_FILE)
+            policies.setdefault("policies", {})[name] = doc
+            self._write_json(IAM_POLICIES_FILE, policies)
+            p = ET.SubElement(result, "Policy")
+            ET.SubElement(p, "PolicyName").text = name
+            ET.SubElement(p, "Arn").text = f"arn:aws:iam:::policy/{name}"
+
+        elif action == "PutUserPolicy":
+            try:
+                doc = json.loads(params.get("PolicyDocument", ""))
+            except ValueError as e:
+                raise IamError("MalformedPolicyDocument", str(e))
+            ident = self._find(conf, user)
+            if ident is None:
+                raise _no_such_entity("user", user)
+            for a in policy_to_actions(doc):
+                if a not in ident.setdefault("actions", []):
+                    ident["actions"].append(a)
+            changed = True
+
+        elif action == "GetUserPolicy":
+            ident = self._find(conf, user)
+            if ident is None or not ident.get("actions"):
+                raise _no_such_entity("user", user)
+            ET.SubElement(result, "UserName").text = user
+            ET.SubElement(result, "PolicyName").text = \
+                params.get("PolicyName", "")
+            ET.SubElement(result, "PolicyDocument").text = json.dumps(
+                actions_to_policy(ident["actions"]))
+
+        elif action == "DeleteUserPolicy":
+            ident = self._find(conf, user)
+            if ident is None:
+                raise _no_such_entity("user", user)
+            ident["actions"] = []
+            changed = True
+
+        else:
+            raise IamError("NotImplemented",
+                           f"action {action} is not implemented", 501)
+
+        if changed:
+            self.put_s3_config(conf)
+        meta = ET.SubElement(root, "ResponseMetadata")
+        ET.SubElement(meta, "RequestId").text = secrets.token_hex(8)
+        return root, changed
+
+
+class IamHandler(BaseHTTPRequestHandler):
+    iam_server: IamApiServer  # bound by IamApiServer.start
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet
+        pass
+
+    def _send_xml(self, status: int, root: ET.Element) -> None:
+        body = b'<?xml version="1.0" encoding="UTF-8"?>\n' + \
+            ET.tostring(root)
+        self.send_response(status)
+        self.send_header("Content-Type", "application/xml")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error(self, err: IamError) -> None:
+        root = ET.Element("ErrorResponse", xmlns=IAM_XMLNS)
+        e = ET.SubElement(root, "Error")
+        ET.SubElement(e, "Code").text = err.code
+        ET.SubElement(e, "Message").text = err.message
+        meta = ET.SubElement(root, "ResponseMetadata")
+        ET.SubElement(meta, "RequestId").text = secrets.token_hex(8)
+        self._send_xml(err.status, root)
+
+    def do_POST(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        params = {
+            k: v[0] for k, v in
+            parse_qs(raw.decode("utf-8", "replace"),
+                     keep_blank_values=True).items()
+        }
+        srv = self.iam_server
+        action = params.get("Action", "")
+        with srv._lock:
+            conf = srv.get_s3_config()
+            # admin-signed requests required once an admin identity CAN
+            # sign (the reference wraps DoActions in iam.Auth(...,
+            # ACTION_ADMIN) over a config snapshot from startup; we re-read
+            # live, so enforcement waits until some identity has both
+            # credentials and Admin — else CreateUser would lock out the
+            # bootstrap sequence)
+            iam = IdentityAccessManagement()
+            iam.load_config(conf)
+            enforce = any(
+                i.credentials and i.can_do(ACTION_ADMIN, "")
+                for i in iam.identities
+            )
+            if enforce:
+                req = S3HttpRequest(
+                    method="POST",
+                    raw_path=self.path.partition("?")[0],
+                    raw_query=self.path.partition("?")[2],
+                    headers={k.lower(): v for k, v in self.headers.items()},
+                )
+                try:
+                    ident = iam.authenticate(req)
+                    iam.authorize(ident, ACTION_ADMIN, "")
+                except AuthError as e:
+                    self._send_error(IamError("AccessDenied", str(e), 403))
+                    return
+                # bind the body to the signature: a signed concrete
+                # payload hash MUST match what was actually sent
+                # (same contract as s3api/server.py's body handler)
+                if req.expected_sha256:
+                    import hashlib
+
+                    if hashlib.sha256(raw).hexdigest() != req.expected_sha256:
+                        self._send_error(IamError(
+                            "AccessDenied",
+                            "request body does not match the signed "
+                            "x-amz-content-sha256", 403))
+                        return
+            try:
+                root, _ = srv.do_action(action, params, conf)
+            except IamError as e:
+                self._send_error(e)
+                return
+            except Exception as e:  # noqa: BLE001
+                self._send_error(IamError("ServiceFailure", str(e), 500))
+                return
+        self._send_xml(200, root)

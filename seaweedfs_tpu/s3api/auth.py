@@ -224,7 +224,9 @@ class IdentityAccessManagement:
             got_sig = fields["Signature"]
         except (KeyError, ValueError):
             raise AuthError("AuthorizationHeaderMalformed", "bad v4 header")
-        if terminal != "aws4_request" or service != "s3":
+        # "iam" scope: the IAM gateway (iamapi/) shares this authenticator,
+        # and AWS SDK/CLI IAM clients sign with service=iam
+        if terminal != "aws4_request" or service not in ("s3", "iam"):
             raise AuthError("AuthorizationHeaderMalformed", "bad scope")
         found = self.lookup(access_key)
         if not found:
@@ -237,7 +239,7 @@ class IdentityAccessManagement:
             req.method, req.raw_path, req.raw_query, req.headers,
             signed_headers, payload_hash,
         )
-        want = sign_v4(secret, date, region, "s3", amz_date, canon)
+        want = sign_v4(secret, date, region, service, amz_date, canon)
         if not hmac.compare_digest(want, got_sig):
             raise AuthError("SignatureDoesNotMatch",
                             "the computed signature does not match")

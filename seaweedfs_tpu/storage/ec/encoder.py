@@ -57,7 +57,10 @@ def generate_ec_files(
     small_block_size: int = SMALL_BLOCK_SIZE,
     codec_name: str = "cpu",
     slice_size: int = DEFAULT_SLICE,
+    progress=None,
 ) -> None:
+    """`progress(volume_bytes_done)` fires after each slice's shard bytes
+    hit the output files — lets callers (bench, shell) report live rates."""
     codec = get_codec(codec_name)
     dat_path = base_name + ".dat"
     dat_size = os.path.getsize(dat_path)
@@ -67,12 +70,12 @@ def generate_ec_files(
             if hasattr(codec, "encode_device"):
                 _encode_stream_pipelined(
                     f, dat_size, outs, codec, large_block_size,
-                    small_block_size, slice_size,
+                    small_block_size, slice_size, progress,
                 )
             else:
                 _encode_stream(
                     f, dat_size, outs, codec, large_block_size,
-                    small_block_size, slice_size,
+                    small_block_size, slice_size, progress,
                 )
     finally:
         for o in outs:
@@ -96,7 +99,10 @@ def _slice_tasks(dat_size: int, large: int, small: int, slice_size: int):
         processed += small * DATA_SHARDS
 
 
-def _encode_stream(f, dat_size, outs, codec, large, small, slice_size) -> None:
+def _encode_stream(
+    f, dat_size, outs, codec, large, small, slice_size, progress=None
+) -> None:
+    done = 0
     for row_start, block, col, width in _slice_tasks(
         dat_size, large, small, slice_size
     ):
@@ -108,10 +114,13 @@ def _encode_stream(f, dat_size, outs, codec, large, small, slice_size) -> None:
             outs[i].write(data[i].tobytes())
         for i in range(parity.shape[0]):
             outs[DATA_SHARDS + i].write(parity[i].tobytes())
+        done += width * DATA_SHARDS
+        if progress is not None:
+            progress(min(done, dat_size))
 
 
 def _encode_stream_pipelined(
-    f, dat_size, outs, codec, large, small, slice_size
+    f, dat_size, outs, codec, large, small, slice_size, progress=None
 ) -> None:
     """Device-codec path: overlap disk reads, HBM transfers, and compute.
 
@@ -189,7 +198,10 @@ def _encode_stream_pipelined(
                 return out32, True
         return codec.encode_device(jnp.asarray(data)), False
 
+    done = 0
+
     def drain(pending) -> None:
+        nonlocal done
         data, parity_dev, packed = pending
         for i in range(DATA_SHARDS):
             outs[i].write(data[i].tobytes())
@@ -198,6 +210,9 @@ def _encode_stream_pipelined(
             parity = parity.view(np.uint8).reshape(parity.shape[0], -1)
         for i in range(parity.shape[0]):
             outs[DATA_SHARDS + i].write(parity[i].tobytes())
+        done += data.shape[1] * DATA_SHARDS
+        if progress is not None:
+            progress(min(done, dat_size))
 
     pending = None
     try:
@@ -235,11 +250,13 @@ def _read_at(f, offset: int, length: int) -> np.ndarray:
 
 
 def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
-                     slice_size: int = DEFAULT_SLICE) -> list[int]:
+                     slice_size: int = DEFAULT_SLICE,
+                     progress=None) -> list[int]:
     """Regenerate whichever .ecNN files are missing (ec_encoder.go:61-62).
 
     Requires >= DATA_SHARDS present shards; streams column slices, runs the
     decode matmul, writes only the missing shards.  Returns rebuilt ids.
+    `progress(shard_bytes_done)` fires after each reconstructed slice.
     """
     codec = get_codec(codec_name)
     present = [i for i in range(TOTAL_SHARDS) if os.path.exists(base_name + to_ext(i))]
@@ -262,6 +279,8 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
             rebuilt = codec.reconstruct(shards)
             for i in missing:
                 outs[i].write(np.asarray(rebuilt[i], dtype=np.uint8).tobytes())
+            if progress is not None:
+                progress(off + width)
     finally:
         for h in ins.values():
             h.close()
