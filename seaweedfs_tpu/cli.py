@@ -14,15 +14,29 @@ import time
 
 def cmd_master(args) -> None:
     from .master.server import MasterServer
+    from .util.config import load_configuration
+
+    # TOML tier: master.toml supplies the maintenance script + sequencer
+    # defaults; explicit CLI flags win (util/config.go two-tier model)
+    mconf = load_configuration("master")
+    interval = args.maintenanceInterval
+    if interval is None:  # flag not given -> TOML, else 0 (disabled)
+        interval = mconf.get_float("master.maintenance.periodic_seconds")
+    script = mconf.get_list("master.maintenance.scripts") or None
+    sequencer = mconf.get_string("master.sequencer.type", "memory")
+    node_id = mconf.get_int("master.sequencer.sequencer_snowflake_id")
 
     m = MasterServer(
         ip=args.ip,
         port=args.port,
         volume_size_limit_mb=args.volumeSizeLimitMB,
         default_replication=args.defaultReplication,
-        maintenance_interval=args.maintenanceInterval,
+        maintenance_interval=interval,
+        maintenance_script=script,
+        sequencer=sequencer,
+        sequencer_node_id=node_id,
         metrics_port=args.metricsPort,
-        jwt_signing_key=args.jwtKey,
+        jwt_signing_key=args.jwtKey or _security_jwt_key(),
         peers=args.peers.split(",") if args.peers else None,
         raft_state_dir=args.raftDir,
     )
@@ -32,8 +46,12 @@ def cmd_master(args) -> None:
 
 
 def cmd_volume(args) -> None:
+    from .util.config import load_configuration
     from .volume.server import VolumeServer
 
+    codec = getattr(args, "ec_codec", "")
+    if not codec:  # flag not given -> master.toml [codec].type, else cpu
+        codec = load_configuration("master").get_string("codec.type", "cpu")
     v = VolumeServer(
         directories=args.dir.split(","),
         master_addresses=[
@@ -43,11 +61,12 @@ def cmd_volume(args) -> None:
         port=args.port,
         data_center=args.dataCenter,
         rack=args.rack,
-        codec_name=getattr(args, "ec_codec", "cpu"),
+        codec_name=codec,
         max_volume_count=args.max,
         metrics_port=args.metricsPort,
-        jwt_signing_key=args.jwtKey,
-        whitelist=args.whiteList.split(",") if args.whiteList else None,
+        jwt_signing_key=args.jwtKey or _security_jwt_key(),
+        whitelist=(args.whiteList.split(",") if args.whiteList
+                   else _security_white_list()),
         tier_backends=_load_tier_backends(args.tierBackends),
     )
     v.start()
@@ -57,8 +76,12 @@ def cmd_volume(args) -> None:
 
 def cmd_server(args) -> None:
     from .master.server import MasterServer
+    from .util.config import load_configuration
     from .volume.server import VolumeServer
 
+    codec = getattr(args, "ec_codec", "")
+    if not codec:
+        codec = load_configuration("master").get_string("codec.type", "cpu")
     m = MasterServer(ip=args.ip, port=args.masterPort)
     m.start()
     v = VolumeServer(
@@ -66,7 +89,7 @@ def cmd_server(args) -> None:
         master_addresses=[f"{args.ip}:{m.grpc_port}"],
         ip=args.ip,
         port=args.port,
-        codec_name=getattr(args, "ec_codec", "cpu"),
+        codec_name=codec,
     )
     v.start()
     print(f"server: master={args.masterPort} volume={args.port}")
@@ -75,12 +98,28 @@ def cmd_server(args) -> None:
 
 def cmd_filer(args) -> None:
     from .filer.server import FilerServer
+    from .util.config import load_configuration
+
+    # filer.toml picks the store backend; the -store flag (a path) keeps
+    # its historical meaning of "sqlite at this path" and wins when given
+    store, store_path = "sqlite", args.store
+    fconf = load_configuration("filer")
+    if fconf.loaded and args.store == "./filer.db":  # flag left at default
+        for kind, path_key in (("sqlite", "dbFile"), ("leveldb", "dir"),
+                               ("memory", "")):
+            if fconf.get_bool(f"{kind}.enabled"):
+                store = kind
+                if path_key:
+                    store_path = fconf.get_string(
+                        f"{kind}.{path_key}", store_path)
+                break
 
     f = FilerServer(
         masters=[_grpc_addr(m) for m in args.master.split(",")],
         ip=args.ip,
         port=args.port,
-        store_path=args.store,
+        store=store,
+        store_path=store_path,
         max_mb=args.maxMB,
         metrics_port=args.metricsPort,
     )
@@ -299,6 +338,66 @@ def _wait() -> None:
         pass
 
 
+def cmd_scaffold(args) -> None:
+    import os
+
+    from .util.scaffold import scaffold
+
+    text = scaffold(args.config)
+    if args.output == "-":
+        print(text, end="")
+    else:
+        path = os.path.join(args.output, f"{args.config}.toml")
+        with open(path, "w") as f:
+            f.write(text)
+        print(f"wrote {path}")
+
+
+# cmd -> the certificate identity its gRPC LISTENER presents; commands
+# absent here are pure clients. "server" hosts master+volume in one
+# process behind one listener credential set.
+_TLS_COMPONENT = {
+    "master": "master", "volume": "volume", "filer": "filer",
+    "server": "master", "msgBroker": "broker",
+}
+
+
+def _security_jwt_key() -> str:
+    """security.toml [jwt.signing].key — the flagless way to arm write
+    JWTs cluster-wide (scaffold.go's security template)."""
+    from .util.config import load_configuration
+
+    return load_configuration("security").get_string("jwt.signing.key")
+
+
+def _security_white_list() -> list[str] | None:
+    from .util.config import load_configuration
+
+    wl = load_configuration("security").get_list("guard.white_list")
+    return [str(ip) for ip in wl] or None
+
+
+def _configure_security(cmd: str) -> None:
+    """Load security.toml and install mTLS credentials for this process
+    (reference: every command resolves LoadServerTLS/LoadClientTLS at
+    boot from the shared security.toml)."""
+    from .pb import rpc as rpclib
+    from .security.tls import load_client_credentials, load_server_credentials
+    from .util.config import load_configuration
+
+    conf = load_configuration("security")
+    if not conf.loaded:
+        return
+    component = _TLS_COMPONENT.get(cmd, "client")
+    server_creds = (
+        load_server_credentials(conf, component)
+        if cmd in _TLS_COMPONENT else None
+    )
+    channel_creds = load_client_credentials(conf, component)
+    if server_creds or channel_creds:
+        rpclib.configure_security(server_creds, channel_creds)
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="seaweedfs_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -308,7 +407,9 @@ def main(argv=None) -> None:
     m.add_argument("-port", type=int, default=9333)
     m.add_argument("-volumeSizeLimitMB", type=int, default=30 * 1024)
     m.add_argument("-defaultReplication", default="000")
-    m.add_argument("-maintenanceInterval", type=float, default=0.0)
+    m.add_argument("-maintenanceInterval", type=float, default=None,
+               help="seconds between maintenance runs; 0 disables "
+                    "(default: master.toml periodic_seconds)")
     m.add_argument("-metricsPort", type=int, default=0)
     m.add_argument("-jwtKey", default="")
     m.add_argument("-peers", default="",
@@ -325,7 +426,7 @@ def main(argv=None) -> None:
     v.add_argument("-dataCenter", default="")
     v.add_argument("-rack", default="")
     v.add_argument("-max", type=int, default=7)
-    v.add_argument("-ec.codec", dest="ec_codec", default="cpu",
+    v.add_argument("-ec.codec", dest="ec_codec", default="",
                    choices=["cpu", "tpu", "tpu_xor", "tpu_mxu"])
     v.add_argument("-metricsPort", type=int, default=0)
     v.add_argument("-jwtKey", default="")
@@ -339,7 +440,7 @@ def main(argv=None) -> None:
     s.add_argument("-ip", default="127.0.0.1")
     s.add_argument("-masterPort", type=int, default=9333)
     s.add_argument("-port", type=int, default=8080)
-    s.add_argument("-ec.codec", dest="ec_codec", default="cpu")
+    s.add_argument("-ec.codec", dest="ec_codec", default="")
     s.set_defaults(fn=cmd_server)
 
     f = sub.add_parser("filer")
@@ -451,7 +552,16 @@ def main(argv=None) -> None:
     ver = sub.add_parser("version")
     ver.set_defaults(fn=lambda a: print("seaweedfs_tpu 0.1.0"))
 
+    sc = sub.add_parser("scaffold")
+    sc.add_argument("-config", default="security",
+                    choices=("security", "master", "filer"))
+    sc.add_argument("-output", default=".",
+                    help="output directory, or - for stdout")
+    sc.set_defaults(fn=cmd_scaffold)
+
     args = p.parse_args(argv)
+    if args.cmd != "scaffold":
+        _configure_security(args.cmd)
     args.fn(args)
 
 

@@ -1,9 +1,10 @@
 """Embedded FilerStore backends; importing registers them.
 
 Reference analogue: weed/filer/<backend>/ dirs registered via blank-import
-init() (weed/server/filer_server.go:23-36).  This build ships the two
-embedded classes: in-memory (tests) and sqlite (the leveldb-class default —
-single-file, transactional, ordered listing).
+init() (weed/server/filer_server.go:23-36).  This build ships three
+embedded classes: in-memory (tests), sqlite (single-file, transactional,
+ordered listing — the abstract_sql class), and leveldb (bitcask-style
+log+snapshot store covering the reference's embedded-leveldb default).
 """
 
-from . import memory_store, sqlite_store  # noqa: F401
+from . import leveldb_store, memory_store, sqlite_store  # noqa: F401

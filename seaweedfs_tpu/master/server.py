@@ -42,8 +42,10 @@ class MasterServer:
         default_replication: str = "000",
         pulse_seconds: float = 3.0,
         sequencer: str = "memory",
+        sequencer_node_id: int = 0,  # snowflake worker id
         garbage_threshold: float = 0.3,
         maintenance_interval: float = 0.0,  # seconds; 0 disables
+        maintenance_script: list[str] | None = None,  # None = default suite
         metrics_port: int = 0,
         jwt_signing_key: bytes | str = b"",
         peers: list[str] | None = None,  # master quorum (ip:port HTTP addrs)
@@ -59,7 +61,8 @@ class MasterServer:
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
         self.maintenance_interval = maintenance_interval
-        self.sequencer = make_sequencer(sequencer)
+        self.maintenance_script = maintenance_script
+        self.sequencer = make_sequencer(sequencer, sequencer_node_id)
         self.layouts: dict[tuple[str, str, str], VolumeLayout] = {}
         self._layout_lock = threading.RLock()
         self._subscribers: list = []
@@ -421,7 +424,7 @@ class MasterServer:
         while not self._stop.wait(self.maintenance_interval):
             try:
                 env = CommandEnv(f"{self.ip}:{self.grpc_port}")
-                run_maintenance(env)
+                run_maintenance(env, script=self.maintenance_script)
             except Exception:
                 pass
 

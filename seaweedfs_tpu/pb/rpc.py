@@ -130,6 +130,27 @@ MESSAGING = Service("messaging_pb.SeaweedMessaging", {
 
 
 # ---------------------------------------------------------------------------
+# mTLS (security/tls.py loads these from security.toml; set once at startup
+# before any server/channel exists — mirrors the reference wiring where
+# every component resolves its grpc credentials from config at boot)
+# ---------------------------------------------------------------------------
+
+_server_credentials: "grpc.ServerCredentials | None" = None
+_channel_credentials: "grpc.ChannelCredentials | None" = None
+
+
+def configure_security(server_credentials=None, channel_credentials=None) -> None:
+    """Install process-wide gRPC credentials (None = plaintext)."""
+    global _server_credentials, _channel_credentials
+    _server_credentials = server_credentials
+    _channel_credentials = channel_credentials
+    with _channel_lock:
+        for ch in _channels.values():
+            ch.close()
+        _channels.clear()
+
+
+# ---------------------------------------------------------------------------
 # Server side
 # ---------------------------------------------------------------------------
 
@@ -180,7 +201,10 @@ def serve(
     )
     for service, impl in service_impls:
         server.add_generic_rpc_handlers((generic_handler(service, impl),))
-    server.add_insecure_port(f"{host}:{port}")
+    if _server_credentials is not None:
+        server.add_secure_port(f"{host}:{port}", _server_credentials)
+    else:
+        server.add_insecure_port(f"{host}:{port}")
     server.start()
     return server
 
@@ -197,13 +221,15 @@ def get_channel(address: str) -> grpc.Channel:
     with _channel_lock:
         ch = _channels.get(address)
         if ch is None:
-            ch = grpc.insecure_channel(
-                address,
-                options=[
-                    ("grpc.max_send_message_length", 128 * 1024 * 1024),
-                    ("grpc.max_receive_message_length", 128 * 1024 * 1024),
-                ],
-            )
+            options = [
+                ("grpc.max_send_message_length", 128 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 128 * 1024 * 1024),
+            ]
+            if _channel_credentials is not None:
+                ch = grpc.secure_channel(
+                    address, _channel_credentials, options=options)
+            else:
+                ch = grpc.insecure_channel(address, options=options)
             _channels[address] = ch
         return ch
 

@@ -1,0 +1,149 @@
+"""mTLS for the gRPC substrate.
+
+Reference: weed/security/tls.go — every component can present a client
+certificate and verify peers against a shared CA; servers require and
+verify client certs ([grpc] section of security.toml: ca, plus
+<component>.cert/.key for master/volume/filer/client/...).
+
+The grpc-python binding expresses the same policy with
+ssl_server_credentials(require_client_auth=True) and
+ssl_channel_credentials; pb/rpc.py consults `configure(...)`'d state when
+opening listening ports and channels, so turning mTLS on is a config
+change, not a code change.  `generate_dev_certs` creates a CA + per-
+component certs for tests and dev clusters (the reference points users at
+openssl for this).
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import os
+
+import grpc
+
+
+def _read_cert_triplet(config, component: str):
+    """Resolve + read (key, cert, ca) bytes for a component, or None.
+
+    Keys follow the reference convention: cert/key at
+    `grpc.<component>.cert/.key` (or bare `<component>.cert/.key`), the
+    shared CA at `grpc.ca`.
+    """
+    if config is None or not config.loaded:
+        return None
+    cert_file = config.get_string(f"grpc.{component}.cert") or \
+        config.get_string(f"{component}.cert")
+    key_file = config.get_string(f"grpc.{component}.key") or \
+        config.get_string(f"{component}.key")
+    ca_file = config.get_string("grpc.ca")
+    if not (cert_file and key_file and ca_file):
+        return None
+    with open(key_file, "rb") as f:
+        key = f.read()
+    with open(cert_file, "rb") as f:
+        cert = f.read()
+    with open(ca_file, "rb") as f:
+        ca = f.read()
+    return key, cert, ca
+
+
+def load_server_credentials(config, component: str):
+    """-> grpc.ServerCredentials or None when the config has no certs.
+
+    Mirrors LoadServerTLS (tls.go:26): client certs required + verified.
+    """
+    triplet = _read_cert_triplet(config, component)
+    if triplet is None:
+        return None
+    key, cert, ca = triplet
+    return grpc.ssl_server_credentials(
+        [(key, cert)], root_certificates=ca, require_client_auth=True
+    )
+
+
+def load_client_credentials(config, component: str = "client"):
+    """-> grpc.ChannelCredentials or None (LoadClientTLS, tls.go:69)."""
+    triplet = _read_cert_triplet(config, component)
+    if triplet is None:
+        return None
+    key, cert, ca = triplet
+    return grpc.ssl_channel_credentials(
+        root_certificates=ca, private_key=key, certificate_chain=cert
+    )
+
+
+def generate_dev_certs(directory: str,
+                       components=("master", "volume", "filer", "client"),
+                       days: int = 365) -> dict[str, tuple[str, str]]:
+    """Create a CA plus one cert/key pair per component under `directory`.
+
+    Certificates carry SANs for localhost/127.0.0.1 so in-process cluster
+    tests can dial by IP.  Returns {"ca": (ca_path, ""), component:
+    (cert_path, key_path), ...}.
+    """
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(directory, exist_ok=True)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    out: dict[str, tuple[str, str]] = {}
+
+    def _key():
+        return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+    def _write(path: str, data: bytes) -> None:
+        with open(path, "wb") as f:
+            f.write(data)
+
+    ca_key = _key()
+    ca_name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "seaweedfs-tpu dev ca")])
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(ca_name).issuer_name(ca_name)
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=days))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None),
+                       critical=True)
+        .sign(ca_key, hashes.SHA256())
+    )
+    ca_path = os.path.join(directory, "ca.crt")
+    _write(ca_path, ca_cert.public_bytes(serialization.Encoding.PEM))
+    out["ca"] = (ca_path, "")
+
+    for comp in components:
+        key = _key()
+        subject = x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, f"{comp}.seaweedfs")])
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(subject).issuer_name(ca_name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=days))
+            .add_extension(
+                x509.SubjectAlternativeName([
+                    x509.DNSName("localhost"),
+                    x509.DNSName(f"{comp}.seaweedfs"),
+                    x509.IPAddress(ipaddress.ip_address("127.0.0.1")),
+                ]),
+                critical=False,
+            )
+            .sign(ca_key, hashes.SHA256())
+        )
+        cert_path = os.path.join(directory, f"{comp}.crt")
+        key_path = os.path.join(directory, f"{comp}.key")
+        _write(cert_path, cert.public_bytes(serialization.Encoding.PEM))
+        _write(key_path, key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        ))
+        out[comp] = (cert_path, key_path)
+    return out

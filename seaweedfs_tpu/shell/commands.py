@@ -88,18 +88,25 @@ def run_command(env: CommandEnv, line: str) -> str:
     return fn(env, args) or ""
 
 
-def run_maintenance(env: CommandEnv) -> list[str]:
-    """The [master.maintenance] script block (scaffold.go:503-518)."""
+DEFAULT_MAINTENANCE_SCRIPT = (
+    "ec.encode -fullPercent=95 -quietFor=1h",
+    "ec.rebuild -force",
+    "ec.balance -force",
+    "volume.fix.replication",
+)
+
+
+def run_maintenance(env: CommandEnv, script=None) -> list[str]:
+    """The [master.maintenance] script block (scaffold.go:503-518).
+
+    `script` is a list of shell command lines (from master.toml's
+    [master.maintenance].scripts); None runs the scaffold default.
+    """
     out = []
     if not env.acquire_lock():
         return ["maintenance: admin lock busy"]
     try:
-        for line in (
-            "ec.encode -fullPercent=95 -quietFor=1h",
-            "ec.rebuild -force",
-            "ec.balance -force",
-            "volume.fix.replication",
-        ):
+        for line in script if script is not None else DEFAULT_MAINTENANCE_SCRIPT:
             try:
                 out.append(f"> {line}\n{run_command(env, line)}")
             except Exception as e:

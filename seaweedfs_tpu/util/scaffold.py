@@ -1,0 +1,109 @@
+"""`weed scaffold` — emit default TOML config files.
+
+Reference: weed/command/scaffold.go:13 (the templates themselves are
+redesigned for this framework: python store backends, the tpu ec codec
+section, and the maintenance scripts that our shell actually implements).
+"""
+
+from __future__ import annotations
+
+SECURITY_TOML = '''\
+# security.toml
+# Discovered from ./, ~/.seaweedfs/, /usr/local/etc/seaweedfs/,
+# /etc/seaweedfs/. All sections are optional; empty values disable the
+# feature.
+
+[jwt.signing]
+# When set, the master mints a JWT with each assignment and volume
+# servers require it on writes (flag -jwtKey overrides).
+key = ""
+
+[guard]
+# Source-IP whitelist for volume-server writes (flag -whiteList overrides).
+white_list = []
+
+# gRPC mTLS: every component presents a cert signed by the shared CA and
+# verifies its peers. Generate a dev set with:
+#   python -c "from seaweedfs_tpu.security import generate_dev_certs; \\
+#              generate_dev_certs('certs')"
+[grpc]
+ca = ""
+
+[grpc.master]
+cert = ""
+key  = ""
+
+[grpc.volume]
+cert = ""
+key  = ""
+
+[grpc.filer]
+cert = ""
+key  = ""
+
+[grpc.broker]
+cert = ""
+key  = ""
+
+[grpc.client]
+cert = ""
+key  = ""
+'''
+
+MASTER_TOML = '''\
+# master.toml
+
+[master.maintenance]
+# Admin-shell lines the leader runs under the exclusive admin lock.
+scripts = [
+  "ec.encode -fullPercent=95 -quietFor=1h",
+  "ec.rebuild -force",
+  "ec.balance -force",
+  "volume.fix.replication",
+]
+# Seconds between runs (the reference's default is ~17 minutes).
+periodic_seconds = 1020
+
+[master.sequencer]
+# memory | snowflake
+type = "memory"
+# Unique per-master worker id stamped into snowflake file ids.
+sequencer_snowflake_id = 0
+
+# The erasure-coding codec volume servers use for bulk encode/rebuild
+# (flag -ec.codec overrides).
+[codec]
+# cpu | tpu | tpu_xor | tpu_mxu
+type = "tpu"
+'''
+
+FILER_TOML = '''\
+# filer.toml
+# Exactly one enabled store backend.
+
+[memory]
+# In-process, non-persistent; tests only.
+enabled = false
+
+[sqlite]
+enabled = true
+dbFile = "./filer.db"
+
+[leveldb]
+# Embedded sorted-file store (pure python SSTable-style).
+enabled = false
+dir = "./filerldb"
+'''
+
+TEMPLATES = {
+    "security": SECURITY_TOML,
+    "master": MASTER_TOML,
+    "filer": FILER_TOML,
+}
+
+
+def scaffold(config: str) -> str:
+    if config not in TEMPLATES:
+        raise ValueError(
+            f"unknown config {config!r}; one of {sorted(TEMPLATES)}")
+    return TEMPLATES[config]
