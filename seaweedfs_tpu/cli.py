@@ -22,7 +22,10 @@ def cmd_master(args) -> None:
     interval = args.maintenanceInterval
     if interval is None:  # flag not given -> TOML, else 0 (disabled)
         interval = mconf.get_float("master.maintenance.periodic_seconds")
-    script = mconf.get_list("master.maintenance.scripts") or None
+    # scripts=[] in the TOML means "run nothing", which run_maintenance
+    # distinguishes from None (= its default suite)
+    raw_scripts = mconf.get("master.maintenance.scripts")
+    script = raw_scripts if isinstance(raw_scripts, list) else None
     sequencer = mconf.get_string("master.sequencer.type", "memory")
     node_id = mconf.get_int("master.sequencer.sequencer_snowflake_id")
 
@@ -49,6 +52,10 @@ def cmd_volume(args) -> None:
     from .util.config import load_configuration
     from .volume.server import VolumeServer
 
+    if getattr(args, "offset5", False):
+        from .storage import types as _t
+
+        _t.set_offset_size(5)
     codec = getattr(args, "ec_codec", "")
     if not codec:  # flag not given -> master.toml [codec].type, else cpu
         codec = load_configuration("master").get_string("codec.type", "cpu")
@@ -122,6 +129,8 @@ def cmd_filer(args) -> None:
         store_path=store_path,
         max_mb=args.maxMB,
         metrics_port=args.metricsPort,
+        peers=args.peers.split(",") if args.peers else None,
+        cipher=args.cipher,
     )
     f.start()
     print(f"filer http={args.port} grpc={f.grpc_port}")
@@ -254,6 +263,57 @@ def cmd_iam(args) -> None:
     s = IamApiServer(filer=args.filer, port=args.port)
     s.start()
     print(f"iam api http={args.port} filer={args.filer}")
+    _wait()
+
+
+def cmd_backup(args) -> None:
+    from .tools.backup import backup_volume
+
+    res = backup_volume(args.server, args.volumeId, args.dir,
+                        collection=args.collection)
+    print(f"volume {args.volumeId}: appended {res['appended']} needles"
+          + (" (full resync)" if res["full_resync"] else ""))
+
+
+def cmd_upload(args) -> None:
+    import json as _json
+
+    from .tools.backup import upload_files
+
+    results = upload_files(args.master, args.files,
+                           collection=args.collection,
+                           replication=args.replication, ttl=args.ttl)
+    print(_json.dumps(results, indent=2))
+
+
+def cmd_download(args) -> None:
+    from .tools.backup import download_files
+
+    for path in download_files(args.server, args.fids, args.dir):
+        print(path)
+
+
+def cmd_filer_cat(args) -> None:
+    import sys as _sys
+
+    from .tools.backup import filer_cat
+
+    _sys.stdout.buffer.write(filer_cat(args.filer, args.path))
+
+
+def cmd_filer_copy(args) -> None:
+    from .tools.backup import filer_copy
+
+    for p in filer_copy(args.filer, args.sources, args.dest):
+        print(p)
+
+
+def cmd_webdav(args) -> None:
+    from .webdav.server import WebDavServer
+
+    s = WebDavServer(filer=args.filer, port=args.port)
+    s.start()
+    print(f"webdav http={args.port} filer={args.filer}")
     _wait()
 
 
@@ -398,8 +458,20 @@ def _configure_security(cmd: str) -> None:
         rpclib.configure_security(server_creds, channel_creds)
 
 
+def _setup_profiling(args) -> None:
+    if getattr(args, "cpuprofile", "") or getattr(args, "memprofile", ""):
+        from .util.grace import setup_profiling
+
+        setup_profiling(args.cpuprofile, args.memprofile)
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="seaweedfs_tpu")
+    p.add_argument("-cpuprofile", default="",
+                   help="write a cProfile dump here at exit")
+    p.add_argument("-memprofile", default="",
+                   help="write a tracemalloc top-allocations report here "
+                        "at exit")
     sub = p.add_subparsers(dest="cmd", required=True)
 
     m = sub.add_parser("master")
@@ -426,6 +498,10 @@ def main(argv=None) -> None:
     v.add_argument("-dataCenter", default="")
     v.add_argument("-rack", default="")
     v.add_argument("-max", type=int, default=7)
+    v.add_argument("-offset.5bytes", dest="offset5", action="store_true",
+                   help="5-byte needle offsets: 8TB volumes instead of "
+                        "32GB (index files are NOT compatible with the "
+                        "default 4-byte layout)")
     v.add_argument("-ec.codec", dest="ec_codec", default="",
                    choices=["cpu", "tpu", "tpu_xor", "tpu_mxu"])
     v.add_argument("-metricsPort", type=int, default=0)
@@ -450,6 +526,13 @@ def main(argv=None) -> None:
     f.add_argument("-store", default="./filer.db")
     f.add_argument("-maxMB", type=int, default=4)
     f.add_argument("-metricsPort", type=int, default=0)
+    f.add_argument("-peers", default="",
+                   help="comma-separated peer filer http addresses for "
+                        "metadata federation")
+    f.add_argument("-encryptVolumeData", dest="cipher",
+                   action="store_true",
+                   help="AES-GCM encrypt chunk data before it reaches "
+                        "volume servers")
     f.set_defaults(fn=cmd_filer)
 
     mnt = sub.add_parser("mount")
@@ -515,6 +598,45 @@ def main(argv=None) -> None:
     iamp.add_argument("-port", type=int, default=8111)
     iamp.set_defaults(fn=cmd_iam)
 
+    wd = sub.add_parser("webdav")
+    wd.add_argument("-filer", default="127.0.0.1:8888")
+    wd.add_argument("-port", type=int, default=7333)
+    wd.set_defaults(fn=cmd_webdav)
+
+    bk = sub.add_parser("backup")
+    bk.add_argument("-server", default="127.0.0.1:9333",
+                    help="master http address")
+    bk.add_argument("-volumeId", type=int, required=True)
+    bk.add_argument("-dir", default=".")
+    bk.add_argument("-collection", default="")
+    bk.set_defaults(fn=cmd_backup)
+
+    up = sub.add_parser("upload")
+    up.add_argument("-master", default="127.0.0.1:9333")
+    up.add_argument("-collection", default="")
+    up.add_argument("-replication", default="")
+    up.add_argument("-ttl", default="")
+    up.add_argument("files", nargs="+")
+    up.set_defaults(fn=cmd_upload)
+
+    dl = sub.add_parser("download")
+    dl.add_argument("-server", default="127.0.0.1:9333",
+                    help="master http address")
+    dl.add_argument("-dir", default=".")
+    dl.add_argument("fids", nargs="+")
+    dl.set_defaults(fn=cmd_download)
+
+    fcat = sub.add_parser("filer.cat")
+    fcat.add_argument("-filer", default="127.0.0.1:8888")
+    fcat.add_argument("path")
+    fcat.set_defaults(fn=cmd_filer_cat)
+
+    fcp = sub.add_parser("filer.copy")
+    fcp.add_argument("-filer", default="127.0.0.1:8888")
+    fcp.add_argument("sources", nargs="+")
+    fcp.add_argument("dest")
+    fcp.set_defaults(fn=cmd_filer_copy)
+
     sh = sub.add_parser("shell")
     sh.add_argument("-master", default="127.0.0.1:9333")
     sh.add_argument("-filer", default="",
@@ -560,6 +682,7 @@ def main(argv=None) -> None:
     sc.set_defaults(fn=cmd_scaffold)
 
     args = p.parse_args(argv)
+    _setup_profiling(args)
     if args.cmd != "scaffold":
         _configure_security(args.cmd)
     args.fn(args)

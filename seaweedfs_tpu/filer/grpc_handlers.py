@@ -178,21 +178,84 @@ class FilerGrpcService:
             collection="",
             replication=self.fs.default_replication,
             signature=self.fs.signature,
+            cipher=self.fs.cipher,
         )
 
     # -- metadata subscription ---------------------------------------------
 
-    def SubscribeMetadata(self, request, context):
+    @staticmethod
+    def _subscribe_log(log, request, context):
         stop = threading.Event()
         context.add_callback(stop.set)
-        for ev in self.filer.meta_log.subscribe(
+        for ev in log.subscribe(
             request.since_ns, request.path_prefix, stop_event=stop
         ):
             if request.signature and request.signature in ev.event_notification.signatures:
                 continue  # skip events this subscriber itself caused
             yield ev
 
-    SubscribeLocalMetadata = SubscribeMetadata
+    def SubscribeMetadata(self, request, context):
+        """The merged stream: with filer peers configured this reads the
+        MetaAggregator's log (events from every peer, self included);
+        stand-alone it reads the local log directly."""
+        agg = self.fs.meta_aggregator
+        log = agg.log if agg is not None else self.filer.meta_log
+        yield from self._subscribe_log(log, request, context)
+
+    def SubscribeLocalMetadata(self, request, context):
+        """Only THIS filer's own mutations (filer.proto:58) — what peer
+        MetaAggregators tail; never includes replayed peer events, which
+        is what keeps replication loop-free.
+
+        The in-memory log is bounded and dies with the process (the
+        reference replays from its persisted /topics/.system/log files);
+        when the subscriber asks for history older than the log can
+        serve, the CURRENT STORE is streamed first as synthetic create
+        events — replays are idempotent upserts, so a follower converges
+        on the full namespace even across restarts/eviction.  Deletions
+        that happened entirely inside the lost window stay unreplicated
+        (documented divergence from the persisted-log design)."""
+        log = self.filer.meta_log
+        if request.since_ns < log.history_start_ns():
+            yield from self._snapshot_events(request.path_prefix)
+        yield from self._subscribe_log(log, request, context)
+
+    def _snapshot_events(self, path_prefix: str):
+        """BFS of the store as create events, emitted in STRICTLY
+        INCREASING ts order (base: each entry's mtime) — consumers
+        (MetaAggregator.ingest gate, resume watermarks) assume a
+        monotonic stream."""
+        store = self.filer.store
+        collected: list[tuple[int, str, filer_pb2.Entry]] = []
+        queue = ["/"]
+        while queue:
+            d = queue.pop(0)
+            start = ""
+            while True:
+                batch = list(store.list_entries(d, start_from=start,
+                                                limit=1024))
+                if not batch:
+                    break
+                for e in batch:
+                    child = d.rstrip("/") + "/" + e.name
+                    if e.is_directory:
+                        queue.append(child)
+                    if path_prefix and not (
+                        child.startswith(path_prefix)
+                        or path_prefix.startswith(child + "/")
+                    ):
+                        continue
+                    ts = (e.attributes.mtime or 1) * 1_000_000_000
+                    collected.append((ts, d, e))
+                start = batch[-1].name
+        last_ts = 0
+        for ts, d, e in sorted(collected, key=lambda x: (x[0], x[1])):
+            ts = max(ts, last_ts + 1)
+            last_ts = ts
+            resp = filer_pb2.SubscribeMetadataResponse(
+                directory=d, ts_ns=ts)
+            resp.event_notification.new_entry.CopyFrom(e)
+            yield resp
 
     def KeepConnected(self, request_iterator, context):
         for req in request_iterator:

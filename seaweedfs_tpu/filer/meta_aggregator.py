@@ -1,0 +1,203 @@
+"""Multi-filer metadata federation.
+
+Reference: weed/filer/meta_aggregator.go — every filer follows each peer's
+SubscribeLocalMetadata stream (self included).  Events land in an
+aggregate log that backs the public SubscribeMetadata rpc, and — when the
+peer runs its OWN store (different store signature) — are replayed
+directly into the local store so the namespaces converge.  Replays write
+to the store, not through the Filer mutation path, so they emit no local
+events: that is the loop prevention.  Per-peer resume offsets persist in
+the store's KV under b"Meta" + the peer's 4-byte signature.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+
+import grpc
+
+from ..pb import filer_pb2
+from ..pb import rpc as rpclib
+from ..util import glog
+from .meta_log import MetaLogBuffer
+
+META_OFFSET_PREFIX = b"Meta"
+RETRY_SECONDS = 1.4
+
+
+def _offset_key(peer_signature: int) -> bytes:
+    return META_OFFSET_PREFIX + struct.pack(">i", peer_signature)
+
+
+def _move_subtree(store, old_path: str, new_path: str) -> None:
+    """Re-root every child of old_path under new_path (replica side of a
+    directory rename, which emits ONE event for the directory itself)."""
+    stack = [(old_path, new_path)]
+    while stack:
+        src, dst = stack.pop()
+        start = ""
+        while True:
+            batch = list(store.list_entries(src, start_from=start,
+                                            limit=1024))
+            if not batch:
+                break
+            for e in batch:
+                store.insert_entry(dst, e)
+                if e.is_directory:
+                    stack.append((f"{src}/{e.name}", f"{dst}/{e.name}"))
+            start = batch[-1].name
+    store.delete_folder_children(old_path)
+
+
+def replay_event(store, resp: filer_pb2.SubscribeMetadataResponse) -> None:
+    """Apply one remote mutation directly to the local store
+    (filer.Replay analogue): delete the old entry, insert the new one at
+    its (possibly moved) parent.  Directory events stand for their whole
+    subtree — the originating filer emits a single event for a recursive
+    delete or rename (filer.py delete_entry/rename_entry), so the replica
+    must mirror the subtree operation here."""
+    n = resp.event_notification
+    directory = resp.directory
+    old_name = n.old_entry.name
+    new_name = n.new_entry.name
+    moved = bool(old_name and new_name and (
+        n.new_parent_path not in ("", directory) or old_name != new_name))
+    if old_name and (not new_name or moved):
+        old_path = f"{directory.rstrip('/')}/{old_name}"
+        if n.old_entry.is_directory:
+            if moved:
+                target_dir = (n.new_parent_path or directory).rstrip("/")
+                _move_subtree(store, old_path, f"{target_dir}/{new_name}")
+            else:
+                store.delete_folder_children(old_path)
+        store.delete_entry(directory, old_name)
+    if new_name:
+        target_dir = n.new_parent_path or directory
+        store.insert_entry(target_dir, n.new_entry)
+
+
+class MetaAggregator:
+    def __init__(self, store, signature: int, self_grpc_address: str,
+                 peer_grpc_addresses: list[str]):
+        self.store = store
+        self.signature = signature
+        self.self_address = self_grpc_address
+        # self is always followed too: the aggregate log then carries the
+        # full merged stream and SubscribeMetadata reads only from it
+        self.peers = list(dict.fromkeys(
+            [self_grpc_address, *peer_grpc_addresses]))
+        self.log = MetaLogBuffer()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def start(self) -> None:
+        for peer in self.peers:
+            t = threading.Thread(
+                target=self._follow, args=(peer,),
+                name=f"meta-aggregate-{peer}", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- one peer ------------------------------------------------------------
+
+    def _peer_signature(self, peer: str) -> int | None:
+        try:
+            resp = rpclib.filer_stub(peer, timeout=10).GetFilerConfiguration(
+                filer_pb2.GetFilerConfigurationRequest())
+            return resp.signature
+        except grpc.RpcError:
+            return None
+
+    def _read_offset(self, peer_signature: int) -> int:
+        raw = self.store.kv_get(_offset_key(peer_signature))
+        if raw and len(raw) == 8:
+            return struct.unpack(">q", raw)[0]
+        return 0
+
+    def _write_offset(self, peer_signature: int, ts_ns: int) -> None:
+        self.store.kv_put(_offset_key(peer_signature),
+                                struct.pack(">q", ts_ns))
+
+    def _follow(self, peer: str) -> None:
+        # resolve the peer's store signature first (retry until up)
+        sig = self._peer_signature(peer)
+        while sig is None and not self._stop.wait(RETRY_SECONDS):
+            sig = self._peer_signature(peer)
+        if sig is None:
+            return
+        replicate = sig != self.signature
+        # self-follow starts from 0 so the aggregate log carries the full
+        # local backlog (SubscribeMetadata must not lose pre-start events)
+        last_ts = self._read_offset(sig) if replicate else 0
+        if replicate:
+            glog.info("filer follows peer %s sig=%d since=%d",
+                      peer, sig, last_ts)
+        fail_ts, fail_count = 0, 0
+        ingest_ts = 0
+        persisted_ts = last_ts
+        pending = 0
+        last_persist = time.monotonic()
+
+        def persist(ts: int, force: bool = False) -> None:
+            # offset writes are throttled (replay is idempotent over the
+            # re-delivery window) — per-event kv_puts would double the
+            # store write load during bulk replication
+            nonlocal persisted_ts, pending, last_persist
+            pending += 1
+            if force or pending >= 100 or \
+                    time.monotonic() - last_persist > 2.0:
+                if ts > persisted_ts:
+                    self._write_offset(sig, ts)
+                    persisted_ts = ts
+                pending = 0
+                last_persist = time.monotonic()
+
+        while not self._stop.is_set():
+            try:
+                stream = rpclib.filer_stub(peer).SubscribeLocalMetadata(
+                    filer_pb2.SubscribeMetadataRequest(
+                        client_name=f"filer:{self.self_address}",
+                        path_prefix="/",
+                        since_ns=last_ts,
+                    )
+                )
+                for resp in stream:
+                    if self._stop.is_set():
+                        return
+                    # a replay-retry reconnect re-delivers events already
+                    # ingested; only new timestamps enter the aggregate
+                    if resp.ts_ns > ingest_ts:
+                        self.log.ingest(resp)
+                        ingest_ts = resp.ts_ns
+                    if replicate:
+                        try:
+                            replay_event(self.store, resp)
+                        except Exception as e:  # noqa: BLE001
+                            # do NOT advance the offset past a failed
+                            # replay — reconnect and retry it, giving up
+                            # only on a poison event (3 strikes)
+                            if resp.ts_ns == fail_ts:
+                                fail_count += 1
+                            else:
+                                fail_ts, fail_count = resp.ts_ns, 1
+                            if fail_count < 3:
+                                glog.warning(
+                                    "replay from %s failed (try %d): %s",
+                                    peer, fail_count, e)
+                                break
+                            glog.error(
+                                "replay from %s failed 3x, skipping "
+                                "event ts=%d: %s", peer, resp.ts_ns, e)
+                        persist(resp.ts_ns)
+                    last_ts = resp.ts_ns
+            except grpc.RpcError:
+                pass
+            if replicate:
+                persist(last_ts, force=True)
+            if self._stop.wait(RETRY_SECONDS):
+                return

@@ -16,10 +16,24 @@ from ..pb import filer_pb2
 
 class MetaLogBuffer:
     def __init__(self, capacity: int = 1 << 16):
+        # (arrival_seq, event): the cursor protocol tracks ARRIVAL order,
+        # not ts_ns — an aggregated peer event can arrive late with an
+        # older timestamp and must still reach live subscribers exactly
+        # once (ts_ns stays the cross-filer resume key in since_ns)
         self._events: deque = deque(maxlen=capacity)
         self._cond = threading.Condition()
         self._last_ts = 0
+        self._seq = 0
         self._listeners: list = []
+        # events before this instant (process start) or evicted from the
+        # bounded deque are gone; subscribers asking for older history
+        # must bootstrap from a store snapshot instead
+        self._created_ts = time.time_ns()
+        self._evicted_ts = 0
+
+    def history_start_ns(self) -> int:
+        """Oldest timestamp this buffer can still replay faithfully."""
+        return max(self._created_ts, self._evicted_ts)
 
     def append(self, directory: str,
                old_entry: filer_pb2.Entry | None,
@@ -45,7 +59,10 @@ class MetaLogBuffer:
                 directory=directory, ts_ns=ts
             )
             resp.event_notification.CopyFrom(event)
-            self._events.append(resp)
+            self._seq += 1
+            if len(self._events) == self._events.maxlen:
+                self._evicted_ts = self._events[0][1].ts_ns
+            self._events.append((self._seq, resp))
             self._cond.notify_all()
             for fn in self._listeners:
                 try:
@@ -53,6 +70,21 @@ class MetaLogBuffer:
                 except Exception:
                     pass
         return ts
+
+    def ingest(self, resp: filer_pb2.SubscribeMetadataResponse) -> None:
+        """Insert an event from another filer AS-IS (aggregation path):
+        the original ts_ns is the cross-cluster ordering key, so it must
+        not be re-stamped."""
+        with self._cond:
+            self._seq += 1
+            self._events.append((self._seq, resp))
+            self._last_ts = max(self._last_ts, resp.ts_ns)
+            self._cond.notify_all()
+            for fn in self._listeners:
+                try:
+                    fn(resp)
+                except Exception:
+                    pass
 
     def add_listener(self, fn) -> None:
         """Synchronous callback per event (notification sinks)."""
@@ -62,18 +94,22 @@ class MetaLogBuffer:
     def subscribe(self, since_ns: int, path_prefix: str = "",
                   stop_event: threading.Event | None = None,
                   poll_interval: float = 0.2):
-        """Yield events with ts_ns > since_ns, then tail until stopped."""
-        cursor = since_ns
+        """Yield events with ts_ns > since_ns, then tail until stopped.
+
+        The live cursor advances over arrival sequence numbers, so an
+        aggregated event ingested late with an older ts_ns is neither
+        skipped nor double-delivered."""
+        cursor = 0  # arrival seq of the last yielded event
         while stop_event is None or not stop_event.is_set():
             batch = []
             with self._cond:
-                for ev in self._events:
-                    if ev.ts_ns > cursor:
-                        batch.append(ev)
+                for seq, ev in self._events:
+                    if seq > cursor and ev.ts_ns > since_ns:
+                        batch.append((seq, ev))
                 if not batch:
                     self._cond.wait(timeout=poll_interval)
-            for ev in batch:
-                cursor = ev.ts_ns
+            for seq, ev in batch:
+                cursor = seq
                 if path_prefix and not _matches_prefix(ev, path_prefix):
                     continue
                 yield ev

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import random
+import struct
 import time
 
 from ..operation import delete_file_ids, download, upload_data
@@ -24,6 +25,8 @@ from .filer import Filer, split_path
 from .filerstore import make_store
 from .grpc_handlers import FilerGrpcService
 from .http_handlers import serve_http
+
+from ..util.http_util import grpc_address as _peer_grpc_addr
 
 GRPC_PORT_OFFSET = 10000
 
@@ -43,6 +46,8 @@ class FilerServer:
         chunk_cache_dir: str = "",
         chunk_cache_mem_mb: int = 32,
         manifest_batch: int = filechunk_manifest.MANIFEST_BATCH,
+        peers: list[str] | None = None,  # peer filer HTTP addresses
+        cipher: bool = False,  # AES-GCM encrypt chunk blobs (cipher.go)
     ):
         self.masters = list(masters)
         self.ip = ip
@@ -50,7 +55,13 @@ class FilerServer:
         self.grpc_port = port + GRPC_PORT_OFFSET
         self.max_mb = max_mb
         self.default_replication = default_replication
-        self.signature = random.randint(1, 2**31 - 1)
+        self.cipher = cipher
+        self.peers = [p.strip() for p in (peers or []) if p.strip()]
+        for p in self.peers:
+            peer_host, _, peer_port = p.partition(":")
+            if not peer_host or not peer_port.isdigit():
+                raise ValueError(
+                    f"filer peer {p!r} must be host:port (http address)")
         self.metrics_port = metrics_port
         self.master_client = MasterClient(f"filer@{ip}:{port}", self.masters)
         if store == "memory":
@@ -60,6 +71,25 @@ class FilerServer:
             self.filer = Filer(
                 make_store(store, path=store_path), self._delete_chunks,
                 resolve_chunks_fn=self.resolve_chunks,
+            )
+        # the store signature identifies THIS store across restarts
+        # (meta_aggregator.go: "filer.store.id"); peers replicate only
+        # from stores whose signature differs from their own
+        sig_raw = self.filer.store.kv_get(b"filer.store.id")
+        if sig_raw and len(sig_raw) == 4:
+            self.signature = struct.unpack(">i", sig_raw)[0]
+        else:
+            self.signature = random.randint(1, 2**31 - 1)
+            self.filer.store.kv_put(b"filer.store.id",
+                                    struct.pack(">i", self.signature))
+        self.meta_aggregator = None
+        if self.peers:
+            from .meta_aggregator import MetaAggregator
+
+            self.meta_aggregator = MetaAggregator(
+                self.filer.store, self.signature,
+                f"{ip}:{self.grpc_port}",
+                [_peer_grpc_addr(p) for p in self.peers],
             )
         self._brokers: dict[str, list[str]] = {}
         self._grpc_server = None
@@ -98,9 +128,14 @@ class FilerServer:
         self._httpd = serve_http(self, "0.0.0.0", self.port)
         if self.metrics_port:
             self._metricsd = serve_metrics(self.metrics_port)
-        glog.info("filer started http=%d grpc=%d", self.port, self.grpc_port)
+        if self.meta_aggregator is not None:
+            self.meta_aggregator.start()
+        glog.info("filer started http=%d grpc=%d peers=%d",
+                  self.port, self.grpc_port, len(self.peers))
 
     def stop(self) -> None:
+        if self.meta_aggregator is not None:
+            self.meta_aggregator.stop()
         self.master_client.stop()
         if self._httpd:
             self._httpd.shutdown()
@@ -178,12 +213,22 @@ class FilerServer:
             self._master_order(), count=1, collection=collection,
             replication=replication or self.default_replication, ttl=ttl,
         )
+        cipher_key = b""
+        stored = blob
+        if self.cipher:
+            from ..util.cipher import encrypt, gen_cipher_key
+
+            cipher_key = gen_cipher_key()
+            stored = encrypt(blob, cipher_key)
         up = upload_data(
-            result.fid_url(), blob, filename=name, mime=mime, jwt=result.auth
+            result.fid_url(), stored, filename=name, mime=mime,
+            jwt=result.auth,
         )
-        return filechunks.make_chunk(
+        chunk = filechunks.make_chunk(
             result.fid, offset, len(blob), time.time_ns(), e_tag=up.etag
         )
+        chunk.cipher_key = cipher_key
+        return chunk
 
     def append_file(self, path: str, data: bytes, mime: str = "",
                     collection: str = "", replication: str = "",
@@ -245,6 +290,14 @@ class FilerServer:
         raise IOError(f"chunk {file_id} unreadable: {last_err}")
 
     def _fetch_view(self, view: filechunks.ChunkView) -> bytes:
+        if view.cipher_key:
+            # GCM cannot be ranged: fetch the whole stored blob (cached
+            # as ciphertext), decrypt, then slice the logical view
+            from ..util.cipher import decrypt
+
+            blob = decrypt(self._fetch_whole(view.file_id),
+                           bytes(view.cipher_key))
+            return blob[view.offset : view.offset + view.size]
         cached = self.chunk_cache.get(view.file_id)
         if cached is not None:
             return cached[view.offset : view.offset + view.size]

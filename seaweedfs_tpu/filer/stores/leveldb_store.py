@@ -81,19 +81,37 @@ class LevelDbStore(FilerStore):
         self._wal = open(self._path(1), "ab")
 
     def _replay(self, file_no: int) -> None:
-        with open(self._path(file_no), "rb") as f:
+        """Replay records; a torn tail (crash mid-append) truncates the
+        file at the last complete record instead of refusing to start —
+        the same load-time healing discipline as volume torn-tail fix."""
+        path = self._path(file_no)
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            record_start = 0
             while True:
                 head = f.read(5)
                 if len(head) < 5:
                     break
                 op, dlen = struct.unpack("<BI", head)
-                directory = f.read(dlen).decode()
-                (nlen,) = struct.unpack("<I", f.read(4))
+                directory_b = f.read(dlen)
+                nlen_b = f.read(4)
+                if len(directory_b) < dlen or len(nlen_b) < 4:
+                    break
+                (nlen,) = struct.unpack("<I", nlen_b)
                 name_b = f.read(nlen)
-                (vlen,) = struct.unpack("<I", f.read(4))
+                vlen_b = f.read(4)
+                if len(name_b) < nlen or len(vlen_b) < 4:
+                    break
+                (vlen,) = struct.unpack("<I", vlen_b)
                 off = f.tell()
+                if off + vlen > size:
+                    break
                 f.seek(vlen, os.SEEK_CUR)
-                self._apply(op, directory, name_b, (file_no, off, vlen))
+                self._apply(op, directory_b.decode(), name_b,
+                            (file_no, off, vlen))
+                record_start = f.tell()
+        if record_start < size:
+            os.truncate(path, record_start)
 
     def _apply(self, op: int, directory: str, name_b: bytes, loc) -> None:
         name = name_b.decode()

@@ -536,6 +536,10 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
                     for url, public_url in locations
                 ],
             })
+        if u.path == "/debug/profile":
+            from ..util.grace import profile_status
+
+            return self._json(200, profile_status())
         if u.path in ("/cluster/status", "/dir/status"):
             with self.master.topo.lock:
                 return self._json(200, {

@@ -386,6 +386,12 @@ class WFS:
         """Whole-chunk read-through cache, sliced to the view window
         (reader_at.go:88-104 fetches and caches full chunks)."""
         whole = self.fetch_whole_chunk(view.file_id)
+        if view.cipher_key:
+            # chunks written through a -encryptVolumeData filer are
+            # AES-GCM sealed; the cache holds ciphertext
+            from ..util.cipher import decrypt
+
+            whole = decrypt(whole, bytes(view.cipher_key))
         return whole[view.offset : view.offset + view.size]
 
     def resolve_chunks(self, chunks: list) -> list:

@@ -93,6 +93,7 @@ VOLUME_SERVER = Service("volume_server_pb.VolumeServer", {
     "VolumeServerStatus": _m(UU, _V.VolumeServerStatusRequest, _V.VolumeServerStatusResponse),
     "VolumeServerLeave": _m(UU, _V.VolumeServerLeaveRequest, _V.VolumeServerLeaveResponse),
     "Query": _m(US, _V.QueryRequest, _V.QueriedStripe),
+    "VolumeNeedleStatus": _m(UU, _V.VolumeNeedleStatusRequest, _V.VolumeNeedleStatusResponse),
 })
 
 _F = filer_pb2

@@ -26,6 +26,7 @@ import shutil
 import urllib.error
 
 from ..pb import filer_pb2
+from ..util.http_util import read_chunked_body
 from .auth import (
     ACTION_ADMIN,
     ACTION_LIST,
@@ -243,16 +244,11 @@ class S3Handler(BaseHTTPRequestHandler):
     def _read_body(self) -> bytes:
         te = (self.headers.get("Transfer-Encoding") or "").lower()
         if "chunked" in te:
-            out = bytearray()
-            while True:
-                line = self.rfile.readline().strip()
-                size = int(line.split(b";")[0], 16)
-                if size == 0:
-                    self.rfile.readline()
-                    break
-                out += self.rfile.read(size)
-                self.rfile.read(2)
-            return bytes(out)
+            try:
+                return read_chunked_body(self.rfile)
+            except ValueError as e:
+                # client framing error, not a server fault
+                raise S3Error(400, "IncompleteBody", str(e))
         length = int(self.headers.get("Content-Length") or 0)
         return self.rfile.read(length) if length else b""
 

@@ -45,13 +45,18 @@ def parse_index_arrays(path: str | os.PathLike):
 
     with open(path, "rb") as f:
         blob = f.read()
-    n = len(blob) // t.NEEDLE_MAP_ENTRY_SIZE
-    raw = np.frombuffer(blob, dtype=np.uint8, count=n * 16).reshape(n, 16)
+    esz = t.NEEDLE_MAP_ENTRY_SIZE
+    off_end = 8 + t.OFFSET_SIZE
+    n = len(blob) // esz
+    raw = np.frombuffer(blob, dtype=np.uint8, count=n * esz).reshape(n, esz)
     # explicit big-endian dtypes keep this host-endianness-independent
     keys = raw[:, 0:8].copy().view(">u8").reshape(n).astype(np.uint64)
-    stored = raw[:, 8:12].copy().view(">u4").reshape(n).astype(np.uint32)
-    offsets = stored.astype(np.int64) * t.NEEDLE_PADDING_SIZE
-    sizes = raw[:, 12:16].copy().view(">i4").reshape(n).astype(np.int32)
+    stored = raw[:, 8:12].copy().view(">u4").reshape(n).astype(np.int64)
+    if t.OFFSET_SIZE == 5:  # high byte appended after the BE lower word
+        stored = stored | (raw[:, 12].astype(np.int64) << 32)
+    offsets = stored * t.NEEDLE_PADDING_SIZE
+    sizes = raw[:, off_end : off_end + 4].copy().view(">i4") \
+        .reshape(n).astype(np.int32)
     return keys, offsets, sizes
 
 

@@ -218,11 +218,16 @@ class NeedleMap:
         vectorised big-endian pack of the merged base arrays."""
         self._merge()
         n = len(self._keys)
-        out = np.empty((n, 16), dtype=np.uint8)
+        esz = t.NEEDLE_MAP_ENTRY_SIZE
+        off_end = 8 + t.OFFSET_SIZE
+        out = np.empty((n, esz), dtype=np.uint8)
         out[:, 0:8] = self._keys.astype(">u8")[:, None].view(np.uint8).reshape(n, 8)
-        stored_off = (self._offsets // t.NEEDLE_PADDING_SIZE).astype(">u4")
-        out[:, 8:12] = stored_off[:, None].view(np.uint8).reshape(n, 4)
-        out[:, 12:16] = (
+        stored = self._offsets // t.NEEDLE_PADDING_SIZE
+        out[:, 8:12] = (stored & 0xFFFFFFFF).astype(">u4")[:, None] \
+            .view(np.uint8).reshape(n, 4)
+        if t.OFFSET_SIZE == 5:
+            out[:, 12] = (stored >> 32).astype(np.uint8)
+        out[:, off_end : off_end + 4] = (
             self._sizes.astype(np.uint32).astype(">u4")[:, None]
             .view(np.uint8).reshape(n, 4)
         )

@@ -3,9 +3,14 @@
 Byte-compatible with the reference formats (all integers big-endian):
   * needle id: uint64 (reference: weed/storage/types/needle_id_type.go)
   * offset: 4 bytes storing actual_offset/8 -> 32GB max volume
-    (weed/storage/types/offset_4bytes.go:12-15)
+    (weed/storage/types/offset_4bytes.go:12-15); `set_offset_size(5)`
+    switches the process to the 5-byte variant (offset_5bytes.go:
+    4 big-endian lower bytes + 1 high byte appended, 17-byte index
+    entries, 8TB volumes) — the runtime analogue of the reference's
+    `5BytesOffset` build tag, so consumers must read these constants via
+    module attribute access (`t.OFFSET_SIZE`), never `from ... import`.
   * size: int32 with tombstone -1 (weed/storage/types/needle_types.go:16-39)
-  * .idx entry: 8+4+4 = 16 bytes (NeedleMapEntrySize)
+  * .idx entry: 8+OFFSET_SIZE+4 bytes (NeedleMapEntrySize)
 """
 
 from __future__ import annotations
@@ -29,6 +34,19 @@ _U32 = struct.Struct(">I")
 _U16 = struct.Struct(">H")
 
 
+def set_offset_size(n: int) -> None:
+    """Switch the process between 4-byte (32GB volumes) and 5-byte (8TB
+    volumes) offsets.  Must run before any volume/index is opened; the
+    two widths are NOT file-compatible (same constraint as rebuilding
+    the reference with the 5BytesOffset tag)."""
+    global OFFSET_SIZE, NEEDLE_MAP_ENTRY_SIZE, MAX_POSSIBLE_VOLUME_SIZE
+    if n not in (4, 5):
+        raise ValueError("offset size must be 4 or 5")
+    OFFSET_SIZE = n
+    NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE
+    MAX_POSSIBLE_VOLUME_SIZE = (4 << 30) * 8 * (256 if n == 5 else 1)
+
+
 def size_is_deleted(size: int) -> bool:
     return size < 0 or size == TOMBSTONE_FILE_SIZE
 
@@ -38,15 +56,23 @@ def size_is_valid(size: int) -> bool:
 
 
 def offset_to_bytes(actual_offset: int) -> bytes:
-    """Store actual byte offset / 8 in 4 big-endian bytes."""
+    """Store actual byte offset / 8 in OFFSET_SIZE big-endian-ish bytes
+    (5-byte layout: 4 BE lower bytes then the high byte, matching
+    offset_5bytes.go OffsetToBytes)."""
     if actual_offset % NEEDLE_PADDING_SIZE:
         raise ValueError(f"offset {actual_offset} not 8-byte aligned")
-    return _U32.pack(actual_offset // NEEDLE_PADDING_SIZE)
+    stored = actual_offset // NEEDLE_PADDING_SIZE
+    if OFFSET_SIZE == 4:
+        return _U32.pack(stored)
+    return _U32.pack(stored & 0xFFFFFFFF) + bytes([(stored >> 32) & 0xFF])
 
 
 def bytes_to_offset(b: bytes) -> int:
     """Return the *actual* byte offset (stored value * 8)."""
-    return _U32.unpack(b[:4])[0] * NEEDLE_PADDING_SIZE
+    stored = _U32.unpack(b[:4])[0]
+    if OFFSET_SIZE == 5:
+        stored |= b[4] << 32
+    return stored * NEEDLE_PADDING_SIZE
 
 
 def size_to_bytes(size: int) -> bytes:
@@ -74,6 +100,6 @@ def unpack_index_entry(b: bytes) -> tuple[int, int, int]:
     """-> (needle_id, actual_offset, size)"""
     return (
         bytes_to_needle_id(b[0:8]),
-        bytes_to_offset(b[8:12]),
-        bytes_to_size(b[12:16]),
+        bytes_to_offset(b[8 : 8 + OFFSET_SIZE]),
+        bytes_to_size(b[8 + OFFSET_SIZE : 8 + OFFSET_SIZE + 4]),
     )

@@ -119,8 +119,14 @@ class Volume:
                 self._idx.put(n.id, offset, n.size)
             return offset, n.size
 
-    def delete_needle(self, needle_id: int) -> int:
-        """Append a tombstone marker needle; returns freed byte count."""
+    def delete_needle(self, needle_id: int,
+                      at_ns: int | None = None) -> int:
+        """Append a tombstone marker needle; returns freed byte count.
+
+        `at_ns` preserves the ORIGIN's tombstone timestamp when the
+        delete is replayed from another server (tail receivers, backup
+        mirrors) — a locally-stamped tombstone would poison tail
+        watermarks under clock skew."""
         with self._lock:
             if self.read_only:
                 raise PermissionError(f"volume {self.volume_id} is read-only")
@@ -129,7 +135,7 @@ class Volume:
                 return 0
             marker = Needle(id=needle_id, cookie=0, data=b"")
             offset = self._dat.file_size()
-            marker.append_at_ns = time.time_ns()
+            marker.append_at_ns = at_ns or time.time_ns()
             self._dat.write_at(offset, marker.to_bytes(self.version))
             self.needle_map.delete(needle_id)
             self._idx.delete(needle_id, offset)

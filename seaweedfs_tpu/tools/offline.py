@@ -116,3 +116,15 @@ def export_volume(directory: str, volume_id: int, collection: str = "",
             tar.addfile(info, io.BytesIO(bytes(n.data)))
             count += 1
     return count
+
+
+def tail_watermark_ns(dat_path: str) -> int:
+    """Max append_at_ns across a .dat (incl. tombstones) — the since_ns
+    resume point for tail subscriptions and incremental backup."""
+    import os as _os
+
+    last = 0
+    if _os.path.exists(dat_path):
+        for _off, n in scan_dat_file(dat_path):
+            last = max(last, n.append_at_ns)
+    return last

@@ -1,0 +1,32 @@
+"""AES-256-GCM chunk encryption.
+
+Reference: weed/util/cipher.go — each chunk gets its own random 32-byte
+key stored in the chunk's metadata (FileChunk.cipher_key); the stored
+blob is nonce || ciphertext || tag, so possession of the volume files
+alone reveals nothing.  Wire layout matches the reference (gcm.Seal with
+the nonce prepended), standard 12-byte GCM nonce and 16-byte tag.
+"""
+
+from __future__ import annotations
+
+import os
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+KEY_SIZE = 32
+NONCE_SIZE = 12
+
+
+def gen_cipher_key() -> bytes:
+    return os.urandom(KEY_SIZE)
+
+
+def encrypt(plaintext: bytes, key: bytes) -> bytes:
+    nonce = os.urandom(NONCE_SIZE)
+    return nonce + AESGCM(key).encrypt(nonce, plaintext, None)
+
+
+def decrypt(blob: bytes, key: bytes) -> bytes:
+    if len(blob) < NONCE_SIZE:
+        raise ValueError("ciphertext too short")
+    return AESGCM(key).decrypt(blob[:NONCE_SIZE], blob[NONCE_SIZE:], None)

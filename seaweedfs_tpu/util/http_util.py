@@ -1,0 +1,54 @@
+"""Shared HTTP plumbing for the http.server-based gateways.
+
+Reference analogue: weed/util/http_util.go (request helpers shared by
+every server).
+"""
+
+from __future__ import annotations
+
+
+def read_chunked_body(rfile, max_bytes: int = 1 << 30) -> bytes:
+    """Decode a Transfer-Encoding: chunked request body.
+
+    Raises ValueError on a malformed or truncated stream — callers must
+    answer 400, never store a silently-truncated body.  Trailer headers
+    after the last chunk are consumed so a keep-alive connection stays
+    framed correctly.
+    """
+    out = bytearray()
+    while True:
+        size_line = rfile.readline()
+        if not size_line:
+            raise ValueError("chunked body: EOF before last chunk")
+        try:
+            size = int(size_line.strip().split(b";")[0], 16)
+        except ValueError:
+            raise ValueError(
+                f"chunked body: bad chunk size {size_line[:20]!r}")
+        if size == 0:
+            break
+        if len(out) + size > max_bytes:
+            raise ValueError("chunked body: too large")
+        data = rfile.read(size)
+        if len(data) < size:
+            raise ValueError("chunked body: truncated chunk")
+        out += data
+        crlf = rfile.read(2)
+        if crlf not in (b"\r\n", b"\n"):
+            raise ValueError("chunked body: missing chunk CRLF")
+    # consume optional trailer section up to the blank line
+    while True:
+        line = rfile.readline()
+        if line in (b"\r\n", b"\n", b""):
+            break
+    return bytes(out)
+
+
+GRPC_PORT_OFFSET = 10000
+
+
+def grpc_address(http_address: str, offset: int = GRPC_PORT_OFFSET) -> str:
+    """Every server exposes gRPC at http_port + 10000 (the convention the
+    reference sets with its -port.grpc defaults)."""
+    host, _, port = http_address.partition(":")
+    return f"{host}:{int(port) + offset}"

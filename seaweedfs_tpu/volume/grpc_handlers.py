@@ -423,14 +423,10 @@ class VolumeGrpcService:
         yield vs.VolumeTailSenderResponse(is_last_chunk=True)
 
     def _last_append_ns(self, v) -> int:
-        """Max append_at_ns across the local .dat (incl. tombstones)."""
-        from ..tools.offline import scan_dat_file
+        from ..tools.offline import tail_watermark_ns
 
         v.flush()
-        last = 0
-        for _off, n in scan_dat_file(v.file_name() + ".dat"):
-            last = max(last, n.append_at_ns)
-        return last
+        return tail_watermark_ns(v.file_name() + ".dat")
 
     def VolumeTailReceiver(self, request, context):
         """Pull missing appends from a replica peer into the local volume
@@ -458,14 +454,16 @@ class VolumeGrpcService:
             if not resp.needle_header:
                 continue
             n = Needle.parse_header(bytes(resp.needle_header))
+            full = Needle.from_bytes(
+                bytes(resp.needle_header) + bytes(resp.needle_body),
+                v.version, verify=False,
+            )
             if n.size > 0:
-                full = Needle.from_bytes(
-                    bytes(resp.needle_header) + bytes(resp.needle_body),
-                    v.version, verify=False,
-                )
                 v.append_needle(full)
             else:
-                v.delete_needle(n.id)
+                # carry the origin's tombstone timestamp — a local stamp
+                # would poison since_ns watermarks under clock skew
+                v.delete_needle(n.id, at_ns=full.append_at_ns)
         return vs.VolumeTailReceiverResponse()
 
     # -- remote tier -------------------------------------------------------
@@ -508,6 +506,58 @@ class VolumeGrpcService:
             context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         yield vs.VolumeTierMoveDatFromRemoteResponse(
             processed=got, processedPercentage=100.0
+        )
+
+    # -- SQL-on-blob query (volume_grpc_query.go:12 + weed/query/) ---------
+
+    def Query(self, request, context):
+        from ..query import query_csv_lines, query_json_lines
+        from ..storage.file_id import FileId
+
+        filt = request.filter
+        for fid_str in request.from_file_ids:
+            fid = FileId.parse(fid_str)
+            try:
+                n = self.store.read_needle(fid.volume_id, fid.key)
+            except KeyError:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"{fid_str} not found")
+            if n.cookie != fid.cookie:
+                context.abort(grpc.StatusCode.PERMISSION_DENIED,
+                              f"cookie mismatch for {fid_str}")
+            data = bytes(n.data)
+            ins = request.input_serialization
+            if ins.HasField("json_input"):
+                records = query_json_lines(
+                    data, list(request.selections),
+                    field=filt.field, op=filt.operand, value=filt.value,
+                    document=(ins.json_input.type.upper() == "DOCUMENT"),
+                )
+            elif ins.HasField("csv_input"):
+                records = query_csv_lines(
+                    data, list(request.selections),
+                    field=filt.field, op=filt.operand, value=filt.value,
+                    header=ins.csv_input.file_header_info,
+                    delimiter=ins.csv_input.field_delimiter or ",",
+                    comment=ins.csv_input.comments or "#",
+                )
+            else:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              "need csv_input or json_input")
+            yield vs.QueriedStripe(records=records)
+
+    def VolumeNeedleStatus(self, request, context):
+        try:
+            n = self.store.read_needle(request.volume_id, request.needle_id)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return vs.VolumeNeedleStatusResponse(
+            needle_id=request.needle_id,
+            cookie=n.cookie,
+            size=len(n.data),
+            last_modified=n.last_modified,
+            crc=n.checksum & 0xFFFFFFFF,
+            ttl=str(n.ttl) if n.ttl else "",
         )
 
     # -- server status / membership ---------------------------------------

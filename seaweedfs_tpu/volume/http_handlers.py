@@ -15,6 +15,7 @@ import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .. import images
 from ..security.jwt import token_from_header, verify_write_jwt
 from ..stats.metrics import REQUEST_COUNTER, REQUEST_HISTOGRAM
 from ..storage.file_id import FileId
@@ -87,6 +88,10 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
         path = urllib.parse.urlparse(self.path)
         if path.path in ("/status", "/healthz"):
             return self._send_json(200, {"Version": "seaweedfs-tpu", **self.store.status()})
+        if path.path == "/debug/profile":
+            from ..util.grace import profile_status
+
+            return self._send_json(200, profile_status())
         try:
             fid = FileId.parse(path.path.lstrip("/"))
         except ValueError:
@@ -113,6 +118,24 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
             return self._send_json(404, {"error": "cookie mismatch"})
         mime = n.mime.decode() if n.has(FLAG_HAS_MIME) and n.mime else "application/octet-stream"
         data = n.data
+        # image GETs: EXIF orientation fix + ?width/?height/?mode resize
+        # on read (volume_server_handlers_read.go -> images/resizing.go)
+        q = urllib.parse.parse_qs(path.query)
+        ext = ""
+        name = n.name.decode(errors="replace") if n.name else path.path
+        if "." in name:
+            ext = "." + name.rsplit(".", 1)[1].lower()
+        if images.is_image(ext, mime):
+            data = images.fix_orientation(bytes(data))
+            try:
+                w = int(q.get("width", ["0"])[0] or 0)
+                h = int(q.get("height", ["0"])[0] or 0)
+            except ValueError:
+                return self._send_json(400, {"error": "bad width/height"})
+            if w or h:
+                data, _, _ = images.resized(
+                    bytes(data), ext or "." + mime.rpartition("/")[2],
+                    w, h, q.get("mode", [""])[0])
         rng = self.headers.get("Range")
         extra = {
             "Etag": f'"{n.checksum:x}"',

@@ -18,6 +18,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..s3api.filer_client import FilerClient
 from ..util import glog
+from ..util.http_util import read_chunked_body
 
 DAV_NS = "DAV:"
 
@@ -85,6 +86,11 @@ class DavHandler(BaseHTTPRequestHandler):
         return self.dav.client.find_entry(directory or "/", name)
 
     def _read_body(self) -> bytes:
+        if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
+            # curl -T - and several DAV clients stream uploads chunked;
+            # a malformed stream raises and the verb answers 400 rather
+            # than storing a truncated body
+            return read_chunked_body(self.rfile)
         length = int(self.headers.get("Content-Length") or 0)
         return self.rfile.read(length) if length else b""
 
@@ -98,7 +104,10 @@ class DavHandler(BaseHTTPRequestHandler):
         })
 
     def do_PROPFIND(self):
-        self._read_body()  # propfind body ignored: we return allprop
+        try:
+            self._read_body()  # propfind body ignored: we return allprop
+        except ValueError as e:
+            return self._send(400, str(e).encode())
         path = self._path()
         entry = self._find(path)
         if entry is None:
@@ -178,7 +187,11 @@ class DavHandler(BaseHTTPRequestHandler):
 
     def do_PUT(self):
         path = self._path()
-        body = self._read_body()
+        try:
+            body = self._read_body()
+        except ValueError as e:
+            self._send(400, str(e).encode())
+            return
         existed = self._find(path) is not None
         self.dav.client.put_object(
             path, body, mime=self.headers.get("Content-Type", "")

@@ -35,3 +35,19 @@ def make_volume(
             n.mime = b"application/octet-stream"
         vol.append_needle(n)
     return vol
+
+
+def free_port(limit: int = 55000) -> int:
+    """A free TCP port whose +10000 gRPC sibling stays below 65536.
+
+    Every server derives grpc_port = port + 10000; an ephemeral port
+    above 55535 silently wraps modulo 65536 inside grpc and dials the
+    wrong place."""
+    import socket
+
+    while True:
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        if port <= limit:
+            return port

@@ -1,0 +1,123 @@
+"""Client utilities: backup / upload / download / filer.cat / filer.copy.
+
+Reference: weed/command/backup.go, upload.go:51, download.go:32,
+filer_cat.go:54, filer_copy.go:65.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from helpers import free_port
+from seaweedfs_tpu.tools.backup import (
+    backup_volume,
+    download_files,
+    filer_cat,
+    filer_copy,
+    upload_files,
+)
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    master = MasterServer(ip="127.0.0.1", port=free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    vsrv = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("ctvol"))],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+        max_volume_count=100,
+    )
+    vsrv.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and len(master.topo.nodes) < 1:
+        time.sleep(0.1)
+    filer = FilerServer(
+        masters=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=free_port(), store="memory", max_mb=1,
+    )
+    filer.start()
+    yield master, vsrv, filer
+    filer.stop()
+    vsrv.stop()
+    master.stop()
+
+
+def test_upload_download_roundtrip(stack, tmp_path):
+    master, _, _ = stack
+    src = tmp_path / "up.bin"
+    src.write_bytes(b"upload-download-payload" * 100)
+    results = upload_files(f"127.0.0.1:{master.port}", [str(src)])
+    assert len(results) == 1 and results[0]["fid"]
+    outdir = tmp_path / "dl"
+    outdir.mkdir()
+    paths = download_files(f"127.0.0.1:{master.port}",
+                           [results[0]["fid"]], str(outdir))
+    assert len(paths) == 1
+    assert open(paths[0], "rb").read() == src.read_bytes()
+
+
+def test_filer_copy_and_cat(stack, tmp_path):
+    _, _, filer = stack
+    d = tmp_path / "tree"
+    (d / "sub").mkdir(parents=True)
+    (d / "top.txt").write_bytes(b"top-level")
+    (d / "sub" / "deep.txt").write_bytes(b"deep-file")
+    created = filer_copy(f"127.0.0.1:{filer.port}", [str(d)], "/copied")
+    assert sorted(created) == [
+        "/copied/tree/sub/deep.txt", "/copied/tree/top.txt"]
+    assert filer_cat(f"127.0.0.1:{filer.port}",
+                     "/copied/tree/top.txt") == b"top-level"
+    assert filer_cat(f"127.0.0.1:{filer.port}",
+                     "/copied/tree/sub/deep.txt") == b"deep-file"
+    with pytest.raises(FileNotFoundError):
+        filer_cat(f"127.0.0.1:{filer.port}", "/copied/absent")
+
+
+def test_backup_incremental(stack, tmp_path):
+    master, _, _ = stack
+    maddr = f"127.0.0.1:{master.port}"
+    f1 = tmp_path / "b1.bin"
+    f1.write_bytes(b"backup-one" * 50)
+    r1 = upload_files(maddr, [str(f1)])
+    vid = int(r1[0]["fid"].partition(",")[0])
+
+    bdir = str(tmp_path / "mirror")
+    res = backup_volume(maddr, vid, bdir)
+    assert res["appended"] >= 1
+    assert os.path.exists(os.path.join(bdir, f"{vid}.dat"))
+
+    # incremental: a second backup after another write to the SAME volume
+    # appends only the delta
+    f2 = tmp_path / "b2.bin"
+    f2.write_bytes(b"backup-two" * 50)
+    # force same volume by writing directly via assign loop until vid matches
+    for _ in range(20):
+        r2 = upload_files(maddr, [str(f2)])
+        if int(r2[0]["fid"].partition(",")[0]) == vid:
+            break
+    else:
+        pytest.skip("assigner never placed the second blob on the volume")
+    res2 = backup_volume(maddr, vid, bdir)
+    assert res2["appended"] >= 1 and not res2["full_resync"]
+    # an immediate third run has nothing new
+    res3 = backup_volume(maddr, vid, bdir)
+    assert res3["appended"] == 0
+
+    # the mirrored volume is readable offline and contains both payloads
+    from seaweedfs_tpu.storage.volume import Volume
+
+    vol = Volume(bdir, "", vid)
+    payloads = [bytes(vol.read_needle(nv.key).data)
+                for nv in vol.needle_map.items_ascending()]
+    vol.close()
+    assert any(b"backup-one" in p for p in payloads)
+    assert any(b"backup-two" in p for p in payloads)

@@ -1,0 +1,201 @@
+"""Multi-filer metadata federation: two filers with separate stores
+converge via SubscribeLocalMetadata + MetaAggregator replay.
+
+Reference: weed/filer/meta_aggregator.go, filer.proto SubscribeLocalMetadata.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.pb import filer_pb2
+from seaweedfs_tpu.pb import rpc as rpclib
+from seaweedfs_tpu.s3api.filer_client import FilerClient
+
+
+def _free_port() -> int:
+    from helpers import free_port
+
+    return free_port()
+
+
+@pytest.fixture(scope="module")
+def federation(tmp_path_factory):
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    master = MasterServer(ip="127.0.0.1", port=_free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("fedvol"))],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=_free_port(), pulse_seconds=0.5,
+        max_volume_count=100,
+    )
+    vs.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and len(master.topo.nodes) < 1:
+        time.sleep(0.1)
+
+    pa, pb = _free_port(), _free_port()
+    fa = FilerServer(
+        masters=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=pa, store="memory", max_mb=1,
+        peers=[f"127.0.0.1:{pb}"],
+    )
+    fb = FilerServer(
+        masters=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=pb, store="memory", max_mb=1,
+        peers=[f"127.0.0.1:{pa}"],
+    )
+    fa.start()
+    fb.start()
+    yield fa, fb
+    fb.stop()
+    fa.stop()
+    vs.stop()
+    master.stop()
+
+
+def _wait_entry(client: FilerClient, directory: str, name: str,
+                timeout: float = 15.0):
+    from seaweedfs_tpu.s3api.filer_client import FilerUnavailable
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            e = client.find_entry(directory, name)
+        except FilerUnavailable:
+            # each filer's aggregator dialed its peer before that peer
+            # was listening; the shared channel cache is in reconnect
+            # backoff for a moment
+            e = None
+        if e is not None:
+            return e
+        time.sleep(0.2)
+    return None
+
+
+def test_namespaces_converge_both_ways(federation):
+    fa, fb = federation
+    ca = FilerClient(f"127.0.0.1:{fa.port}")
+    cb = FilerClient(f"127.0.0.1:{fb.port}")
+
+    # distinct stores: different signatures drive replication
+    assert fa.signature != fb.signature
+
+    ca.put_object("/fed/a-born.txt", b"written on A")
+    cb.put_object("/fed/b-born.txt", b"written on B")
+
+    # each side sees the other's write (metadata replayed via aggregator)
+    ea = _wait_entry(cb, "/fed", "a-born.txt")
+    eb = _wait_entry(ca, "/fed", "b-born.txt")
+    assert ea is not None, "B never saw A's entry"
+    assert eb is not None, "A never saw B's entry"
+
+    # the chunks reference the same blobs, so bytes read through EITHER
+    # filer are identical
+    code, _, body = cb.get_object("/fed/a-born.txt")
+    assert code == 200 and body == b"written on A"
+    code, _, body = ca.get_object("/fed/b-born.txt")
+    assert code == 200 and body == b"written on B"
+
+
+def test_deletes_propagate(federation):
+    fa, fb = federation
+    ca = FilerClient(f"127.0.0.1:{fa.port}")
+    cb = FilerClient(f"127.0.0.1:{fb.port}")
+    ca.put_object("/fed/del-me.txt", b"x")
+    assert _wait_entry(cb, "/fed", "del-me.txt") is not None
+    # delete on B (metadata only: blobs are shared, A's replay must not
+    # double-free) and verify A converges
+    cb.delete_entry("/fed", "del-me.txt", is_delete_data=False)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if ca.find_entry("/fed", "del-me.txt") is None:
+            break
+        time.sleep(0.2)
+    assert ca.find_entry("/fed", "del-me.txt") is None
+
+
+def test_subscribe_metadata_is_merged_stream(federation):
+    fa, fb = federation
+    ca = FilerClient(f"127.0.0.1:{fa.port}")
+    cb = FilerClient(f"127.0.0.1:{fb.port}")
+    since = time.time_ns()
+    ca.put_object("/merge/on-a.txt", b"1")
+    cb.put_object("/merge/on-b.txt", b"2")
+    # A's public SubscribeMetadata must carry BOTH events
+    stub = rpclib.filer_stub(f"127.0.0.1:{fa.grpc_port}")
+    stream = stub.SubscribeMetadata(
+        filer_pb2.SubscribeMetadataRequest(
+            client_name="test", path_prefix="/merge", since_ns=since),
+        timeout=20,
+    )
+    seen = set()
+    for resp in stream:
+        seen.add(resp.event_notification.new_entry.name)
+        if {"on-a.txt", "on-b.txt"} <= seen:
+            break
+    assert {"on-a.txt", "on-b.txt"} <= seen
+
+
+def test_directory_delete_and_rename_propagate(federation):
+    """Recursive deletes and directory renames emit ONE event for the
+    directory; the replica must mirror the whole subtree."""
+    fa, fb = federation
+    ca = FilerClient(f"127.0.0.1:{fa.port}")
+    cb = FilerClient(f"127.0.0.1:{fb.port}")
+
+    ca.put_object("/tree/sub/deep.txt", b"deep")
+    assert _wait_entry(cb, "/tree/sub", "deep.txt") is not None
+
+    # rename the whole directory on A
+    ca.stub().AtomicRenameEntry(filer_pb2.AtomicRenameEntryRequest(
+        old_directory="/", old_name="tree",
+        new_directory="/", new_name="forest"))
+    e = _wait_entry(cb, "/forest/sub", "deep.txt")
+    assert e is not None, "renamed subtree child missing on replica"
+    deadline = time.time() + 10
+    while time.time() < deadline and cb.find_entry("/tree", "sub"):
+        time.sleep(0.2)
+    assert cb.find_entry("/tree", "sub") is None
+
+    # recursive delete on A drops the subtree on B too
+    ca.delete_entry("/", "forest", is_delete_data=False, is_recursive=True)
+    deadline = time.time() + 10
+    while time.time() < deadline and cb.find_entry("/forest/sub", "deep.txt"):
+        time.sleep(0.2)
+    assert cb.find_entry("/forest/sub", "deep.txt") is None
+
+
+def test_new_peer_bootstraps_preexisting_namespace(federation, tmp_path_factory):
+    """A filer joining AFTER entries already exist must converge on them:
+    SubscribeLocalMetadata snapshots the store when the requested history
+    predates the in-memory log."""
+    from seaweedfs_tpu.filer.server import FilerServer
+
+    fa, fb = federation
+    ca = FilerClient(f"127.0.0.1:{fa.port}")
+    ca.put_object("/boot/old.txt", b"pre-existing")
+
+    pc = _free_port()
+    fc = FilerServer(
+        masters=fa.masters, ip="127.0.0.1", port=pc,
+        store="memory", max_mb=1,
+        peers=[f"127.0.0.1:{fa.port}"],
+    )
+    fc.start()
+    try:
+        cc = FilerClient(f"127.0.0.1:{pc}")
+        e = _wait_entry(cc, "/boot", "old.txt")
+        assert e is not None, "late joiner never bootstrapped the namespace"
+        code, _, body = cc.get_object("/boot/old.txt")
+        assert code == 200 and body == b"pre-existing"
+    finally:
+        fc.stop()

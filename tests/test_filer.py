@@ -434,3 +434,81 @@ def test_leveldb_store_persistence_and_compaction(tmp_path):
     # must be smaller than the data ever written
     assert os.path.getsize(os.path.join(path, "wal.log")) < 30 * 130
     s2.close()
+
+
+def test_leveldb_store_torn_tail_heals(tmp_path):
+    """A crash mid-append leaves a partial WAL record; the store must
+    truncate it at load instead of refusing to start."""
+    import os
+
+    from seaweedfs_tpu.filer.filerstore import make_store
+
+    path = str(tmp_path / "torn")
+    s = make_store("leveldb", path=path)
+    s.insert_entry("/d", entry("keep.txt", content=b"kept"))
+    s.close()
+    with open(os.path.join(path, "wal.log"), "ab") as f:
+        f.write(b"\x01\x10\x00\x00\x00/partial")  # torn record
+    s2 = make_store("leveldb", path=path)
+    assert s2.find_entry("/d", "keep.txt").content == b"kept"
+    # the torn bytes are gone and appends work again
+    s2.insert_entry("/d", entry("after.txt", content=b"ok"))
+    s2.close()
+    s3 = make_store("leveldb", path=path)
+    assert s3.find_entry("/d", "after.txt").content == b"ok"
+    s3.close()
+
+
+def test_cipher_round_trip_and_opaque_volume_bytes(tmp_path_factory):
+    """-encryptVolumeData: chunks are AES-GCM sealed on upload, decrypted
+    transparently on read; the bytes on the volume server reveal nothing
+    (util/cipher.go)."""
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+    from helpers import free_port
+
+    master = MasterServer(ip="127.0.0.1", port=free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    vdir = tmp_path_factory.mktemp("ciphervol")
+    vs = VolumeServer(
+        directories=[str(vdir)],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+        max_volume_count=100,
+    )
+    vs.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and len(master.topo.nodes) < 1:
+        time.sleep(0.1)
+    filer = FilerServer(
+        masters=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=free_port(), store="memory", max_mb=1,
+        cipher=True,
+    )
+    filer.start()
+    try:
+        from seaweedfs_tpu.s3api.filer_client import FilerClient
+
+        client = FilerClient(f"127.0.0.1:{filer.port}")
+        secret = b"TOP-SECRET-" * 400  # spans the 1MB chunk? no, one chunk
+        client.put_object("/sec/plan.txt", secret)
+        # read back through the filer: plaintext
+        code, _, body = client.get_object("/sec/plan.txt")
+        assert code == 200 and body == secret
+        # ranged read decrypts too
+        code, _, body = client.get_object("/sec/plan.txt",
+                                          range_header="bytes=4-13")
+        assert code == 206 and body == secret[4:14]
+        # chunk metadata carries the key; stored blob is opaque
+        e = client.find_entry("/sec", "plan.txt")
+        assert e.chunks and e.chunks[0].cipher_key
+        dats = list(vdir.glob("*.dat"))
+        assert dats
+        raw = b"".join(p.read_bytes() for p in dats)
+        assert b"TOP-SECRET-" not in raw
+    finally:
+        filer.stop()
+        vs.stop()
+        master.stop()

@@ -25,9 +25,9 @@ from seaweedfs_tpu.iamapi.server import (
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    from helpers import free_port
+
+    return free_port()
 
 
 def _strip_ns(root: ET.Element) -> ET.Element:

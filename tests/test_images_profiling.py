@@ -1,0 +1,136 @@
+"""Image resize-on-read + profiling hooks.
+
+Reference: weed/images/resizing.go + orientation.go;
+weed/command/volume.go:117-120 (-cpuprofile/-memprofile) and the pprof
+handlers.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import time
+import urllib.request
+
+import pytest
+
+from helpers import free_port
+from seaweedfs_tpu.images import fix_orientation, is_image, resized
+from seaweedfs_tpu.util.grace import profile_status, setup_profiling
+
+
+def _png(w: int, h: int) -> bytes:
+    from PIL import Image
+
+    img = Image.new("RGB", (w, h), (200, 30, 30))
+    out = io.BytesIO()
+    img.save(out, format="PNG")
+    return out.getvalue()
+
+
+def _dims(data: bytes) -> tuple[int, int]:
+    from PIL import Image
+
+    return Image.open(io.BytesIO(data)).size
+
+
+def test_resize_modes():
+    src = _png(400, 200)
+    out, w, h = resized(src, ".png", width=100, height=100, mode="fit")
+    assert (w, h) == _dims(out) and w <= 100 and h <= 100
+    out, w, h = resized(src, ".png", width=100, height=100, mode="fill")
+    assert _dims(out) == (100, 100)
+    # default square thumbnail on non-square input
+    out, w, h = resized(src, ".png", width=50, height=50)
+    assert _dims(out) == (50, 50)
+    # width-only preserves aspect
+    out, w, h = resized(src, ".png", width=200)
+    assert _dims(out) == (200, 100)
+    # no upscale: smaller than requested passes through
+    out, w, h = resized(src, ".png", width=4000)
+    assert out == src
+    # non-image data passes through untouched
+    blob = b"not an image"
+    assert resized(blob, ".png", width=10)[0] == blob
+    assert is_image(".jpg") and is_image("", "image/png")
+    assert not is_image(".txt", "text/plain")
+    assert fix_orientation(blob) == blob
+    # orientation-free JPEGs must pass through BYTE-IDENTICAL (no silent
+    # recompression on every read)
+    import io as _io
+
+    from PIL import Image as _Image
+
+    j = _io.BytesIO()
+    _Image.new("RGB", (20, 20), (1, 2, 3)).save(j, format="JPEG")
+    assert fix_orientation(j.getvalue()) == j.getvalue()
+
+
+def test_volume_server_resizes_on_read(tmp_path_factory):
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    master = MasterServer(ip="127.0.0.1", port=free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("imgvol"))],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+    )
+    vs.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and len(master.topo.nodes) < 1:
+            time.sleep(0.1)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{master.port}/dir/assign",
+                timeout=10) as r:
+            a = json.loads(r.read())
+        png = _png(300, 300)
+        boundary = "imgb"
+        body = (f"--{boundary}\r\nContent-Disposition: form-data; "
+                f'name="file"; filename="pic.png"\r\n'
+                f"Content-Type: image/png\r\n\r\n").encode() + png + \
+            f"\r\n--{boundary}--\r\n".encode()
+        req = urllib.request.Request(
+            f"http://{a['url']}/{a['fid']}", data=body, method="POST",
+            headers={"Content-Type":
+                     f"multipart/form-data; boundary={boundary}"})
+        urllib.request.urlopen(req, timeout=10).read()
+        with urllib.request.urlopen(
+                f"http://{a['url']}/{a['fid']}?width=64&height=64",
+                timeout=10) as r:
+            small = r.read()
+        assert _dims(small) == (64, 64)
+        with urllib.request.urlopen(
+                f"http://{a['url']}/{a['fid']}", timeout=10) as r:
+            assert r.read() == png  # no params: original bytes
+        # /debug/profile works on both servers
+        for port in (master.port, vs.port):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/profile",
+                    timeout=10) as r:
+                st = json.loads(r.read())
+            assert st["threads"] >= 1 and st["max_rss_kb"] > 0
+    finally:
+        vs.stop()
+        master.stop()
+
+
+def test_profiling_dumps(tmp_path):
+    cpu = tmp_path / "cpu.pprof"
+    setup_profiling(cpuprofile=str(cpu))
+    st = profile_status()
+    assert st["cpu_profiler_armed"] is True
+    # the atexit dump is process-global; emulate it here
+    import atexit  # noqa: F401 — documented path
+    from seaweedfs_tpu.util import grace
+
+    grace._cpu_profiler.disable()
+    grace._cpu_profiler.dump_stats(str(cpu))
+    import pstats
+
+    stats = pstats.Stats(str(cpu))
+    assert stats.total_calls >= 0
+    grace._cpu_profiler = None

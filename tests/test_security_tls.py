@@ -25,9 +25,9 @@ from seaweedfs_tpu.util.scaffold import scaffold
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    from helpers import free_port
+
+    return free_port()
 
 
 @pytest.fixture()

@@ -18,9 +18,9 @@ from seaweedfs_tpu.shell.commands import CommandEnv, run_command
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    from helpers import free_port
+
+    return free_port()
 
 
 def _poll(fn, ok, timeout=10.0):

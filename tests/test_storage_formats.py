@@ -181,3 +181,49 @@ def test_parse_reference_fixture():
             assert n.size == v.size
             checked += 1
         assert checked > 10
+
+
+def test_five_byte_offsets_lift_32gb_cap(tmp_path):
+    """offset_5bytes.go analogue: 17-byte index entries round-trip
+    offsets beyond the 4-byte 32GB limit."""
+    from seaweedfs_tpu.storage import types as t
+    from seaweedfs_tpu.storage.idx import IndexWriter, parse_index_arrays
+    from seaweedfs_tpu.storage.needle_map import NeedleMap
+
+    t.set_offset_size(5)
+    try:
+        assert t.NEEDLE_MAP_ENTRY_SIZE == 17
+        big = 40 * (1 << 30)  # 40GB: beyond the 4-byte cap
+        b = t.offset_to_bytes(big)
+        assert len(b) == 5 and t.bytes_to_offset(b) == big
+        # entry pack/unpack round-trip
+        entry = t.pack_index_entry(7, big, 1234)
+        assert len(entry) == 17
+        assert t.unpack_index_entry(entry) == (7, big, 1234)
+        # .idx writer + vectorized parser agree
+        p = tmp_path / "big.idx"
+        w = IndexWriter(str(p))
+        w.put(1, 8, 10)
+        w.put(2, big, 20)
+        w.close()
+        keys, offsets, sizes = parse_index_arrays(str(p))
+        assert list(keys) == [1, 2]
+        assert list(offsets) == [8, big]
+        # sorted .ecx write/read round-trip at >32GB offsets
+        nm = NeedleMap()
+        nm.put(5, big, 99)
+        ecx = tmp_path / "big.ecx"
+        nm.write_sorted_index(str(ecx))
+        raw = ecx.read_bytes()
+        assert len(raw) == 17
+        assert t.unpack_index_entry(raw) == (5, big, 99)
+    finally:
+        t.set_offset_size(4)
+
+
+def test_four_byte_offsets_reject_beyond_cap():
+    from seaweedfs_tpu.storage import types as t
+
+    assert t.OFFSET_SIZE == 4
+    b = t.offset_to_bytes(32 * (1 << 30) - 8)  # top of the 4-byte range
+    assert t.bytes_to_offset(b) == 32 * (1 << 30) - 8
