@@ -56,6 +56,10 @@ def cmd_volume(args) -> None:
         from .storage import types as _t
 
         _t.set_offset_size(5)
+    if getattr(args, "index", "memory") != "memory":
+        from .storage.volume import set_needle_map_kind
+
+        set_needle_map_kind(args.index)
     codec = getattr(args, "ec_codec", "")
     if not codec:  # flag not given -> master.toml [codec].type, else cpu
         codec = load_configuration("master").get_string("codec.type", "cpu")
@@ -498,6 +502,11 @@ def main(argv=None) -> None:
     v.add_argument("-dataCenter", default="")
     v.add_argument("-rack", default="")
     v.add_argument("-max", type=int, default=7)
+    v.add_argument("-index", default="memory",
+                   choices=("memory", "disk"),
+                   help="needle map kind: in-RAM compact map, or "
+                        "disk-backed sorted file for RAM-constrained "
+                        "servers")
     v.add_argument("-offset.5bytes", dest="offset5", action="store_true",
                    help="5-byte needle offsets: 8TB volumes instead of "
                         "32GB (index files are NOT compatible with the "

@@ -12,9 +12,11 @@ from __future__ import annotations
 import concurrent.futures
 import random
 import struct
+import threading
 import time
 
 from ..operation import delete_file_ids, download, upload_data
+from ..util import glog
 from ..operation.assign import AssignResult, assign_any
 from ..pb import filer_pb2
 from ..pb import rpc as rpclib
@@ -106,14 +108,36 @@ class FilerServer:
         self.notification = notification
         if notification is not None:
             # every metadata mutation fans out to the configured queue
-            # (filer_notify.go -> notification.Queue.SendMessage)
-            def _notify(resp):
-                name = (resp.event_notification.new_entry.name
-                        or resp.event_notification.old_entry.name)
-                key = f"{resp.directory.rstrip('/')}/{name}"
-                notification.publish(key, resp.event_notification)
+            # (filer_notify.go -> notification.Queue.SendMessage).
+            # Publishing happens on a dedicated worker: listeners run
+            # under the meta-log lock, and a slow network backend (SQS,
+            # Pub/Sub) must never stall metadata mutations.
+            import queue as _queue
 
-            self.filer.meta_log.add_listener(_notify)
+            self._notify_q: _queue.Queue = _queue.Queue(maxsize=4096)
+
+            def _enqueue(resp):
+                try:
+                    self._notify_q.put_nowait(resp)
+                except _queue.Full:
+                    glog.warning("notification queue full; dropping event")
+
+            def _drain():
+                while True:
+                    resp = self._notify_q.get()
+                    if resp is None:
+                        return
+                    n = resp.event_notification
+                    name = n.new_entry.name or n.old_entry.name
+                    key = f"{resp.directory.rstrip('/')}/{name}"
+                    try:
+                        notification.publish(key, n)
+                    except Exception as e:  # noqa: BLE001
+                        glog.error("notification publish %s: %s", key, e)
+
+            self.filer.meta_log.add_listener(_enqueue)
+            threading.Thread(target=_drain, daemon=True,
+                             name="filer-notify").start()
 
     # -- lifecycle ---------------------------------------------------------
 

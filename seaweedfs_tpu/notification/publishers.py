@@ -86,12 +86,145 @@ class FilePublisher(Publisher):
         return out
 
 
-_GATED = {
-    "kafka": "kafka-python",
-    "aws_sqs": "boto3",
-    "google_pub_sub": "google-cloud-pubsub",
-    "gocdk_pub_sub": "gocloud",
-}
+class KafkaPublisher(Publisher):
+    """Kafka adapter (notification/kafka/kafka_queue.go): events map to
+    (key=file path, value=serialized EventNotification) records.  Config
+    parsing and event mapping are library-free; only the wire transport
+    needs kafka-python, resolved lazily at first publish."""
+
+    def __init__(self, hosts: list[str] | str, topic: str):
+        if isinstance(hosts, str):
+            hosts = [h.strip() for h in hosts.split(",") if h.strip()]
+        if not hosts or not topic:
+            raise ConfigurationError("kafka needs hosts + topic")
+        # fail at STARTUP when the client library is absent — a publish-
+        # time error would be swallowed by the meta-log listener loop
+        try:
+            import kafka  # type: ignore  # noqa: F401
+        except ImportError:
+            raise ConfigurationError(
+                "kafka backend needs the kafka-python client library")
+        self.hosts = hosts
+        self.topic = topic
+        self._producer = None
+
+    def map_event(self, key: str,
+                  event: filer_pb2.EventNotification) -> tuple[bytes, bytes]:
+        return key.encode(), event.SerializeToString()
+
+    def publish(self, key: str, event: filer_pb2.EventNotification) -> None:
+        if self._producer is None:
+            from kafka import KafkaProducer  # type: ignore
+
+            self._producer = KafkaProducer(bootstrap_servers=self.hosts)
+        k, v = self.map_event(key, event)
+        self._producer.send(self.topic, key=k, value=v).add_errback(
+            lambda e: glog.error("kafka publish %s failed: %s", key, e))
+
+    def close(self) -> None:
+        if self._producer is not None:
+            self._producer.flush()
+            self._producer.close()
+
+
+class SqsPublisher(Publisher):
+    """AWS SQS adapter (notification/aws_sqs/aws_sqs_pub.go) built on the
+    framework's own SigV4 signer — no boto3.  Events go out as
+    SendMessage calls whose body is the base64 serialized notification
+    with the file path as a message attribute."""
+
+    def __init__(self, queue_url: str, region: str,
+                 access_key: str = "", secret_key: str = ""):
+        if not queue_url or not region:
+            raise ConfigurationError("aws_sqs needs queue_url + region")
+        self.queue_url = queue_url
+        self.region = region
+        self.access_key = access_key or os.environ.get(
+            "AWS_ACCESS_KEY_ID", "")
+        self.secret_key = secret_key or os.environ.get(
+            "AWS_SECRET_ACCESS_KEY", "")
+        if not self.access_key or not self.secret_key:
+            raise ConfigurationError(
+                "aws_sqs needs credentials (config or AWS_ACCESS_KEY_ID/"
+                "AWS_SECRET_ACCESS_KEY)")
+
+    def build_request(self, key: str, event: filer_pb2.EventNotification):
+        """-> (url, signed headers, form body) — split out so the signed
+        request shape is testable without network egress."""
+        import urllib.parse as _up
+
+        from ..s3api.auth import sign_request
+
+        body = _up.urlencode({
+            "Action": "SendMessage",
+            "MessageBody": base64.b64encode(
+                event.SerializeToString()).decode(),
+            "MessageAttribute.1.Name": "key",
+            "MessageAttribute.1.Value.DataType": "String",
+            "MessageAttribute.1.Value.StringValue": key,
+            "Version": "2012-11-05",
+        }).encode()
+        u = _up.urlparse(self.queue_url)
+        headers = sign_request("POST", u.netloc, u.path or "/", "sqs",
+                               self.region, self.access_key,
+                               self.secret_key, body)
+        headers["Content-Type"] = "application/x-www-form-urlencoded"
+        return self.queue_url, headers, body
+
+    def publish(self, key: str, event: filer_pb2.EventNotification) -> None:
+        import urllib.request
+
+        url, headers, body = self.build_request(key, event)
+        req = urllib.request.Request(url, data=body, method="POST",
+                                     headers=headers)
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
+
+
+class GcpPubSubPublisher(Publisher):
+    """Google Pub/Sub adapter (notification/google_pub_sub) over the
+    public REST surface: messages carry the serialized notification
+    base64'd with the path as an attribute.  A bearer token supplier
+    (metadata server / service-account flow) is injected; payload
+    construction is library-free and testable."""
+
+    def __init__(self, project_id: str, topic: str, token_source=None):
+        if not project_id or not topic:
+            raise ConfigurationError(
+                "google_pub_sub needs project_id + topic")
+        if token_source is None:
+            raise ConfigurationError(
+                "google_pub_sub needs a token source (no default "
+                "credential chain in this deployment)")
+        self.project_id = project_id
+        self.topic = topic
+        self.token_source = token_source
+
+    @property
+    def endpoint(self) -> str:
+        return (f"https://pubsub.googleapis.com/v1/projects/"
+                f"{self.project_id}/topics/{self.topic}:publish")
+
+    def build_payload(self, key: str,
+                      event: filer_pb2.EventNotification) -> bytes:
+        return json.dumps({
+            "messages": [{
+                "data": base64.b64encode(
+                    event.SerializeToString()).decode(),
+                "attributes": {"key": key},
+            }]
+        }).encode()
+
+    def publish(self, key: str, event: filer_pb2.EventNotification) -> None:
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.endpoint, data=self.build_payload(key, event),
+            method="POST",
+            headers={"Authorization": f"Bearer {self.token_source()}",
+                     "Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
 
 
 def make_publisher(kind: str, **opts) -> Publisher:
@@ -101,10 +234,22 @@ def make_publisher(kind: str, **opts) -> Publisher:
         return MemoryPublisher()
     if kind == "file":
         return FilePublisher(opts["path"])
-    if kind in _GATED:
-        raise ConfigurationError(
-            f"notification backend {kind!r} needs the {_GATED[kind]} client "
-            "library, which is not available in this deployment; use "
-            "'log' or 'file', or install the dependency"
+    if kind == "kafka":
+        return KafkaPublisher(opts.get("hosts", ""), opts.get("topic", ""))
+    if kind == "aws_sqs":
+        return SqsPublisher(
+            opts.get("sqs_queue_url", opts.get("queue_url", "")),
+            opts.get("region", ""),
+            opts.get("aws_access_key_id", ""),
+            opts.get("aws_secret_access_key", ""),
         )
+    if kind == "google_pub_sub":
+        return GcpPubSubPublisher(
+            opts.get("project_id", ""), opts.get("topic", ""),
+            opts.get("token_source"),
+        )
+    if kind == "gocdk_pub_sub":
+        raise ConfigurationError(
+            "gocdk_pub_sub is a Go-CDK construct with no python "
+            "equivalent; use kafka, aws_sqs, or google_pub_sub")
     raise ConfigurationError(f"unknown notification backend {kind!r}")

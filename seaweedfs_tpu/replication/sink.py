@@ -161,3 +161,151 @@ class S3Sink(Sink):
         except urllib.error.HTTPError as e:
             if e.code != 404:
                 raise
+
+
+class SignedS3Sink(S3Sink):
+    """S3Sink with SigV4 signing — the adapter shape the cloud sinks
+    share (replication/sink/s3sink with credentials)."""
+
+    def __init__(self, endpoint: str, bucket: str, access_key: str,
+                 secret_key: str, region: str = "us-east-1",
+                 prefix: str = "", scheme: str = "https"):
+        super().__init__(endpoint, bucket, prefix)
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+        self.scheme = scheme
+
+    def _url(self, key: str) -> str:
+        return (f"{self.scheme}://{self.endpoint}/{self.bucket}/"
+                f"{urllib.parse.quote(key)}")
+
+    def signed_headers(self, method: str, key: str,
+                       body: bytes = b"") -> dict:
+        from ..s3api.auth import sign_request
+
+        return sign_request(
+            method, self.endpoint,
+            f"/{self.bucket}/{urllib.parse.quote(key)}", "s3",
+            self.region, self.access_key, self.secret_key, body)
+
+    def create_entry(self, directory, entry, data):
+        if entry.is_directory:
+            return
+        key = self._key(directory, entry.name)
+        headers = self.signed_headers("PUT", key, data)
+        headers["Content-Type"] = (entry.attributes.mime
+                                   or "application/octet-stream")
+        req = urllib.request.Request(self._url(key), data=data,
+                                     method="PUT", headers=headers)
+        with urllib.request.urlopen(req, timeout=120) as r:
+            r.read()
+
+    def delete_entry(self, directory, name, is_directory):
+        key = self._key(directory, name)
+        req = urllib.request.Request(
+            self._url(key), method="DELETE",
+            headers=self.signed_headers("DELETE", key))
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                r.read()
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+
+class GcsSink(SignedS3Sink):
+    """Google Cloud Storage via its S3-interoperability XML API with HMAC
+    keys (replication/sink/gcssink's role; own transport)."""
+
+    def __init__(self, bucket: str, access_key: str, secret_key: str,
+                 prefix: str = ""):
+        super().__init__("storage.googleapis.com", bucket, access_key,
+                         secret_key, region="auto", prefix=prefix)
+
+
+class B2Sink(SignedS3Sink):
+    """Backblaze B2 via its S3-compatible endpoint
+    (replication/sink/b2sink's role; own transport)."""
+
+    def __init__(self, region: str, bucket: str, key_id: str,
+                 application_key: str, prefix: str = ""):
+        super().__init__(f"s3.{region}.backblazeb2.com", bucket, key_id,
+                         application_key, region=region, prefix=prefix)
+
+
+class AzureSink(Sink):
+    """Azure Blob Storage with SharedKey signing
+    (replication/sink/azuresink; the signature construction follows the
+    public SharedKey spec and is testable offline)."""
+
+    def __init__(self, account: str, account_key_b64: str, container: str,
+                 prefix: str = ""):
+        import base64 as _b64
+
+        self.account = account
+        self.key = _b64.b64decode(account_key_b64)
+        self.container = container
+        self.prefix = prefix.strip("/")
+
+    def _key(self, directory: str, name: str = "") -> str:
+        rel = f"{directory.strip('/')}/{name}".strip("/")
+        return f"{self.prefix}/{rel}".strip("/") if self.prefix else rel
+
+    def _url(self, key: str) -> str:
+        return (f"https://{self.account}.blob.core.windows.net/"
+                f"{self.container}/{urllib.parse.quote(key)}")
+
+    def signed_headers(self, method: str, key: str, body: bytes = b"",
+                       content_type: str = "") -> dict:
+        import base64 as _b64
+        import hashlib
+        import hmac as _hmac
+        import time as _time
+
+        date = _time.strftime("%a, %d %b %Y %H:%M:%S GMT", _time.gmtime())
+        headers = {
+            "x-ms-date": date,
+            "x-ms-version": "2020-10-02",
+        }
+        if method == "PUT":
+            headers["x-ms-blob-type"] = "BlockBlob"
+        canon_headers = "".join(
+            f"{k}:{headers[k]}\n" for k in sorted(headers))
+        canon_resource = (f"/{self.account}/{self.container}/"
+                          f"{urllib.parse.quote(key)}")
+        string_to_sign = "\n".join([
+            method, "", "",
+            str(len(body)) if body else "", "",
+            content_type, "", "", "", "", "", "",
+        ]) + "\n" + canon_headers + canon_resource
+        sig = _b64.b64encode(_hmac.new(
+            self.key, string_to_sign.encode(), hashlib.sha256).digest()
+        ).decode()
+        headers["Authorization"] = f"SharedKey {self.account}:{sig}"
+        if content_type:
+            headers["Content-Type"] = content_type
+        return headers
+
+    def create_entry(self, directory, entry, data):
+        if entry.is_directory:
+            return
+        key = self._key(directory, entry.name)
+        ctype = entry.attributes.mime or "application/octet-stream"
+        req = urllib.request.Request(
+            self._url(key), data=data, method="PUT",
+            headers=self.signed_headers("PUT", key, data, ctype))
+        with urllib.request.urlopen(req, timeout=120) as r:
+            r.read()
+
+    def delete_entry(self, directory, name, is_directory):
+        key = self._key(directory, name)
+        req = urllib.request.Request(
+            self._url(key), method="DELETE",
+            headers=self.signed_headers("DELETE", key))
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                r.read()
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise

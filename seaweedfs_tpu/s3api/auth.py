@@ -488,3 +488,32 @@ def decode_streaming_body(body: bytes, req: S3HttpRequest | None = None) -> byte
             raise AuthError("IncompleteBody",
                             "bad x-amz-decoded-content-length", status=400)
     return bytes(out)
+
+
+def sign_request(method: str, host: str, path: str, service: str,
+                 region: str, access_key: str, secret: str,
+                 body: bytes = b"", query: str = "") -> dict:
+    """Build the signed header set for an outbound SigV4 request (the
+    client-side counterpart of this module's verifier; shared by the SQS
+    publisher and the signed replication sinks)."""
+    import hashlib
+    import time as _time
+
+    amz_date = _time.strftime("%Y%m%dT%H%M%SZ", _time.gmtime())
+    headers = {
+        "host": host,
+        "x-amz-date": amz_date,
+        "x-amz-content-sha256": hashlib.sha256(body).hexdigest(),
+    }
+    canon = canonical_request(method, path, query, headers,
+                              sorted(headers),
+                              headers["x-amz-content-sha256"])
+    signature = sign_v4(secret, amz_date[:8], region, service, amz_date,
+                        canon)
+    headers["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{amz_date[:8]}/"
+        f"{region}/{service}/aws4_request, "
+        f"SignedHeaders={';'.join(sorted(headers))}, "
+        f"Signature={signature}"
+    )
+    return headers

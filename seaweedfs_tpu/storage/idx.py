@@ -61,17 +61,27 @@ def parse_index_arrays(path: str | os.PathLike):
 
 
 class IndexWriter:
-    """Append-only .idx writer."""
+    """Append-only .idx writer.
+
+    Every entry is flushed to the KERNEL immediately (no fsync): the
+    .dat append reaches the page cache per write, and the load-time
+    torn-tail healer treats unindexed .dat bytes as garbage — a
+    userspace-buffered .idx lagging by many entries would turn a plain
+    SIGTERM into real data loss (the reference's Go writes are
+    unbuffered syscalls, so its index never lags more than one entry).
+    """
 
     def __init__(self, path: str | os.PathLike):
         self._f: io.BufferedWriter = open(path, "ab")
 
     def put(self, key: int, actual_offset: int, size: int) -> None:
         self._f.write(t.pack_index_entry(key, actual_offset, size))
+        self._f.flush()
 
     def delete(self, key: int, actual_offset: int) -> None:
         """Tombstone entry: offset of the delete marker, size -1."""
         self._f.write(t.pack_index_entry(key, actual_offset, t.TOMBSTONE_FILE_SIZE))
+        self._f.flush()
 
     def flush(self) -> None:
         self._f.flush()

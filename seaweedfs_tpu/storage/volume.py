@@ -24,6 +24,18 @@ from .backend import DiskFile, get_backend
 from .idx import IndexWriter, walk_index_file
 from .needle import Needle, actual_size, body_length
 from .needle_map import NeedleMap
+
+# process-wide index kind (needle_map.go:13-19 NeedleMapKind): "memory"
+# (compact in-RAM map) or "disk" (sorted-file map with bounded RAM);
+# selected by the volume server's -index flag before volumes load
+DEFAULT_NEEDLE_MAP_KIND = "memory"
+
+
+def set_needle_map_kind(kind: str) -> None:
+    global DEFAULT_NEEDLE_MAP_KIND
+    if kind not in ("memory", "disk"):
+        raise ValueError("index kind must be memory or disk")
+    DEFAULT_NEEDLE_MAP_KIND = kind
 from .super_block import CURRENT_VERSION, SUPER_BLOCK_SIZE, SuperBlock
 from .vif import load_volume_info, save_volume_info
 
@@ -58,11 +70,21 @@ class Volume:
                     self._dat.read_at(0, 64)
                 )
         self.version = self.super_block.version
-        self.needle_map = (
-            NeedleMap.load_from_idx(base + ".idx")
-            if os.path.exists(base + ".idx")
-            else NeedleMap()
-        )
+        kind = DEFAULT_NEEDLE_MAP_KIND
+        if kind == "disk":
+            from .disk_needle_map import DiskNeedleMap
+
+            self.needle_map = (
+                DiskNeedleMap.load_from_idx(base + ".idx")
+                if os.path.exists(base + ".idx")
+                else DiskNeedleMap(base + ".sdx")
+            )
+        else:
+            self.needle_map = (
+                NeedleMap.load_from_idx(base + ".idx")
+                if os.path.exists(base + ".idx")
+                else NeedleMap()
+            )
         self.check_and_fix_integrity()
         self._idx = IndexWriter(base + ".idx")
 
@@ -251,6 +273,8 @@ class Volume:
         with self._lock:
             self._dat.close()
             self._idx.close()
+            if hasattr(self.needle_map, "close"):
+                self.needle_map.close()
 
     # -- integrity --------------------------------------------------------
 

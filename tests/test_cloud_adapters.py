@@ -1,0 +1,139 @@
+"""Cloud notification publishers + replication sinks: config parsing and
+wire-format construction (the parts that run in ANY deployment; the
+network sends need egress/credentials).
+
+Reference: weed/notification/{kafka,aws_sqs,google_pub_sub},
+weed/replication/sink/{s3sink,gcssink,azuresink,b2sink}.
+"""
+
+from __future__ import annotations
+
+import base64
+import urllib.parse
+
+import pytest
+
+from seaweedfs_tpu.notification.publishers import (
+    ConfigurationError,
+    GcpPubSubPublisher,
+    KafkaPublisher,
+    SqsPublisher,
+    make_publisher,
+)
+from seaweedfs_tpu.pb import filer_pb2
+from seaweedfs_tpu.replication.sink import (
+    AzureSink,
+    B2Sink,
+    GcsSink,
+    SignedS3Sink,
+)
+
+
+def _event(name: str = "f.txt") -> filer_pb2.EventNotification:
+    ev = filer_pb2.EventNotification()
+    ev.new_entry.name = name
+    return ev
+
+
+def test_kafka_config_and_mapping(monkeypatch):
+    # without the client library, construction fails LOUDLY at startup
+    # (a publish-time error would vanish in the meta-log listener)
+    with pytest.raises(ConfigurationError):
+        KafkaPublisher("broker1:9092", "fs-events")
+    import sys
+    import types
+
+    monkeypatch.setitem(sys.modules, "kafka", types.ModuleType("kafka"))
+    p = KafkaPublisher("broker1:9092, broker2:9092", "fs-events")
+    assert p.hosts == ["broker1:9092", "broker2:9092"]
+    k, v = p.map_event("/d/f.txt", _event())
+    assert k == b"/d/f.txt"
+    parsed = filer_pb2.EventNotification()
+    parsed.ParseFromString(v)
+    assert parsed.new_entry.name == "f.txt"
+    with pytest.raises(ConfigurationError):
+        KafkaPublisher("", "topic")
+
+
+def test_sqs_signed_request_shape():
+    p = SqsPublisher(
+        "https://sqs.us-west-2.amazonaws.com/123456789/fs-events",
+        "us-west-2", access_key="AKIDEXAMPLE", secret_key="SECRET")
+    url, headers, body = p.build_request("/d/f.txt", _event())
+    assert url.startswith("https://sqs.us-west-2")
+    auth = headers["Authorization"]
+    assert auth.startswith("AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/")
+    assert "/us-west-2/sqs/aws4_request" in auth
+    assert "Signature=" in auth
+    form = urllib.parse.parse_qs(body.decode())
+    assert form["Action"] == ["SendMessage"]
+    ev = filer_pb2.EventNotification()
+    ev.ParseFromString(base64.b64decode(form["MessageBody"][0]))
+    assert ev.new_entry.name == "f.txt"
+    assert form["MessageAttribute.1.Value.StringValue"] == ["/d/f.txt"]
+    with pytest.raises(ConfigurationError):
+        SqsPublisher("", "us-west-2")
+
+
+def test_gcp_pubsub_payload():
+    p = GcpPubSubPublisher("my-proj", "fs-events",
+                           token_source=lambda: "tok")
+    assert "projects/my-proj/topics/fs-events:publish" in p.endpoint
+    import json
+
+    payload = json.loads(p.build_payload("/d/f.txt", _event()))
+    msg = payload["messages"][0]
+    assert msg["attributes"]["key"] == "/d/f.txt"
+    ev = filer_pb2.EventNotification()
+    ev.ParseFromString(base64.b64decode(msg["data"]))
+    assert ev.new_entry.name == "f.txt"
+    with pytest.raises(ConfigurationError):
+        GcpPubSubPublisher("", "t", token_source=lambda: "x")
+    with pytest.raises(ConfigurationError):
+        GcpPubSubPublisher("p", "t")  # token source required at startup
+
+
+def test_make_publisher_dispatch(monkeypatch):
+    import sys
+    import types
+
+    monkeypatch.setitem(sys.modules, "kafka", types.ModuleType("kafka"))
+    p = make_publisher("kafka", hosts="h:9092", topic="t")
+    assert isinstance(p, KafkaPublisher)
+    p = make_publisher("aws_sqs", queue_url="https://sqs.x/y",
+                       region="r", aws_access_key_id="a",
+                       aws_secret_access_key="s")
+    assert isinstance(p, SqsPublisher)
+    with pytest.raises(ConfigurationError):
+        make_publisher("nope")
+    with pytest.raises(ConfigurationError, match="Go-CDK"):
+        make_publisher("gocdk_pub_sub")
+
+
+def test_signed_s3_sink_headers():
+    s = SignedS3Sink("s3.amazonaws.com", "bkt", "AK", "SK",
+                     region="eu-central-1", prefix="mirror")
+    assert s._key("/dir", "f.bin") == "mirror/dir/f.bin"
+    h = s.signed_headers("PUT", "mirror/dir/f.bin", b"data")
+    assert "/eu-central-1/s3/aws4_request" in h["Authorization"]
+    assert h["x-amz-content-sha256"] != ""
+
+
+def test_gcs_b2_sink_endpoints():
+    g = GcsSink("bkt", "AK", "SK")
+    assert g.endpoint == "storage.googleapis.com"
+    b = B2Sink("us-west-004", "bkt", "KID", "APPKEY")
+    assert b.endpoint == "s3.us-west-004.backblazeb2.com"
+    assert "/us-west-004/s3/aws4_request" in \
+        b.signed_headers("PUT", "k", b"x")["Authorization"]
+
+
+def test_azure_shared_key_headers():
+    key = base64.b64encode(b"0" * 32).decode()
+    a = AzureSink("myacct", key, "container", prefix="mirror")
+    h = a.signed_headers("PUT", "mirror/d/f.txt", b"data",
+                         "text/plain")
+    assert h["Authorization"].startswith("SharedKey myacct:")
+    assert h["x-ms-blob-type"] == "BlockBlob"
+    assert a._url("k") == \
+        "https://myacct.blob.core.windows.net/container/k"
