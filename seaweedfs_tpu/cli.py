@@ -79,6 +79,7 @@ def cmd_volume(args) -> None:
         whitelist=(args.whiteList.split(",") if args.whiteList
                    else _security_white_list()),
         tier_backends=_load_tier_backends(args.tierBackends),
+        tcp_port=args.tcpPort,
     )
     v.start()
     print(f"volume server http={args.port} grpc={v.grpc_port} dirs={args.dir}")
@@ -312,6 +313,19 @@ def cmd_filer_copy(args) -> None:
         print(p)
 
 
+def cmd_gateway(args) -> None:
+    from .gateway import GatewayServer
+
+    g = GatewayServer(
+        masters=args.master.split(","),
+        filers=args.filer.split(",") if args.filer else None,
+        port=args.port,
+    )
+    g.start()
+    print(f"gateway http={args.port} masters={args.master}")
+    _wait()
+
+
 def cmd_webdav(args) -> None:
     from .webdav.server import WebDavServer
 
@@ -502,6 +516,8 @@ def main(argv=None) -> None:
     v.add_argument("-dataCenter", default="")
     v.add_argument("-rack", default="")
     v.add_argument("-max", type=int, default=7)
+    v.add_argument("-port.tcp", dest="tcpPort", type=int, default=0,
+                   help="experimental raw-TCP needle data path (0=off)")
     v.add_argument("-index", default="memory",
                    choices=("memory", "disk"),
                    help="needle map kind: in-RAM compact map, or "
@@ -606,6 +622,14 @@ def main(argv=None) -> None:
     iamp.add_argument("-filer", default="127.0.0.1:8888")
     iamp.add_argument("-port", type=int, default=8111)
     iamp.set_defaults(fn=cmd_iam)
+
+    gwp = sub.add_parser("gateway")
+    gwp.add_argument("-master", default="127.0.0.1:9333",
+                     help="comma-separated master http addresses")
+    gwp.add_argument("-filer", default="",
+                     help="comma-separated filer http addresses")
+    gwp.add_argument("-port", type=int, default=5647)
+    gwp.set_defaults(fn=cmd_gateway)
 
     wd = sub.add_parser("webdav")
     wd.add_argument("-filer", default="127.0.0.1:8888")

@@ -1,0 +1,201 @@
+"""Generic REST gateway: one stable front for blobs, files, and topics.
+
+Reference: weed/command/gateway.go + weed/server/gateway_server.go —
+  POST   /blobs/            -> assign + upload, returns the chunk (file) id
+  DELETE /blobs/<fid>       -> delete the chunk wherever it lives
+  POST   /files/<path>      -> save bytes at the filer path
+  DELETE /files/<path>      -> delete the filer path
+  POST   /topics/<ns>/<t>   -> append a message to the topic log
+Masters and filers are picked round-robin per request.  The reference
+left /files and /topics as empty stubs (gateway_server.go:95-103); here
+they are functional: files proxy to the filer HTTP plane, topics append
+to the filer-backed topic log the message broker reads.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .util import glog
+
+
+class GatewayServer:
+    def __init__(self, masters: list[str], filers: list[str] | None = None,
+                 port: int = 5647):
+        if not masters:
+            raise ValueError("gateway needs at least one master")
+        self.port = port
+        self._masters = itertools.cycle(masters)
+        self._filers = itertools.cycle(filers) if filers else None
+        self._httpd: ThreadingHTTPServer | None = None
+
+    def master(self) -> str:
+        return next(self._masters)
+
+    def filer(self) -> str:
+        if self._filers is None:
+            raise LookupError("no filers configured")
+        return next(self._filers)
+
+    def start(self) -> None:
+        handler = type("BoundGatewayHandler", (GatewayHandler,),
+                       {"gw": self})
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        glog.info("gateway started port=%d", self.port)
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+
+class GatewayHandler(BaseHTTPRequestHandler):
+    gw: GatewayServer
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _send_json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> bytes:
+        from .util.http_util import read_chunked_body
+
+        if "chunked" in (self.headers.get("Transfer-Encoding") or "").lower():
+            return read_chunked_body(self.rfile)
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    # -- verbs ---------------------------------------------------------------
+
+    def do_POST(self):
+        path = urllib.parse.unquote(self.path.partition("?")[0])
+        try:
+            if path.startswith("/blobs"):
+                return self._post_blob()
+            if path.startswith("/files/"):
+                return self._proxy_filer("PUT", path[len("/files"):])
+            if path.startswith("/topics/"):
+                return self._post_topic(path[len("/topics/"):])
+        except urllib.error.HTTPError as e:
+            return self._send_json(e.code, {"error": e.reason})
+        except Exception as e:  # noqa: BLE001
+            return self._send_json(500, {"error": str(e)})
+        self._send_json(404, {"error": "unknown route"})
+
+    do_PUT = do_POST
+
+    def do_DELETE(self):
+        path = urllib.parse.unquote(self.path.partition("?")[0])
+        try:
+            if path.startswith("/blobs/"):
+                return self._delete_blob(path[len("/blobs/"):])
+            if path.startswith("/files/"):
+                return self._proxy_filer("DELETE", path[len("/files"):])
+        except urllib.error.HTTPError as e:
+            return self._send_json(e.code, {"error": e.reason})
+        except Exception as e:  # noqa: BLE001
+            return self._send_json(500, {"error": str(e)})
+        self._send_json(404, {"error": "unknown route"})
+
+    def do_GET(self):
+        path = urllib.parse.unquote(self.path.partition("?")[0])
+        if path in ("/status", "/healthz"):
+            return self._send_json(200, {"gateway": "ok"})
+        try:
+            if path.startswith("/files/"):
+                return self._proxy_filer("GET", path[len("/files"):])
+        except Exception as e:  # noqa: BLE001
+            return self._send_json(500, {"error": str(e)})
+        self._send_json(404, {"error": "unknown route"})
+
+    # -- blobs ---------------------------------------------------------------
+
+    def _post_blob(self) -> None:
+        from .operation.upload import upload_data
+
+        data = self._body()
+        master = self.gw.master()
+        with urllib.request.urlopen(
+                f"http://{master}/dir/assign", timeout=30) as r:
+            a = json.loads(r.read())
+        if a.get("error"):
+            return self._send_json(500, {"error": a["error"]})
+        # operation.upload_data: random boundary (payloads containing a
+        # fixed boundary string would truncate), jwt, retries
+        up = upload_data(f"http://{a['url']}/{a['fid']}", data,
+                         filename="blob", jwt=a.get("auth", ""))
+        self._send_json(201, {"fid": a["fid"],
+                              "url": f"{a['url']}/{a['fid']}",
+                              "size": up.size or len(data)})
+
+    def _lookup_locations(self, vid: int):
+        from .pb import master_pb2
+
+        master = self.gw.master()
+        with urllib.request.urlopen(
+                f"http://{master}/dir/lookup?volumeId={vid}",
+                timeout=30) as r:
+            locations = json.loads(r.read()).get("locations", [])
+        return [master_pb2.Location(url=loc["url"],
+                                    public_url=loc.get("publicUrl", ""))
+                for loc in locations]
+
+    def _delete_blob(self, fid: str) -> None:
+        from .operation.delete import delete_file_id
+
+        ok = delete_file_id(self._lookup_locations, fid)
+        if ok:
+            self._send_json(202, {"fid": fid, "deleted": True})
+        else:
+            self._send_json(404, {"fid": fid, "deleted": False})
+
+    # -- files (filer proxy) -------------------------------------------------
+
+    def _proxy_filer(self, method: str, path: str) -> None:
+        filer = self.gw.filer()
+        data = self._body() if method == "PUT" else None
+        req = urllib.request.Request(
+            f"http://{filer}{urllib.parse.quote(path)}", data=data,
+            method=method,
+            headers={"Content-Type":
+                     self.headers.get("Content-Type")
+                     or "application/octet-stream"} if data else {})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                body = r.read()
+                self.send_response(r.status)
+                ct = r.headers.get("Content-Type", "application/json")
+                self.send_header("Content-Type", ct)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+        except urllib.error.HTTPError as e:
+            self._send_json(e.code, {"error": str(e.reason)})
+
+    # -- topics (append to the broker's filer-backed log) --------------------
+
+    def _post_topic(self, topic_path: str) -> None:
+        data = self._body()
+        filer = self.gw.filer()
+        url = (f"http://{filer}/topics/{urllib.parse.quote(topic_path)}"
+               f"/messages.log?op=append")
+        req = urllib.request.Request(url, data=data, method="POST",
+                                     headers={"Content-Type":
+                                              "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            self._send_json(r.status, json.loads(r.read() or b"{}"))

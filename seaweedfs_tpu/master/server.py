@@ -540,6 +540,35 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
             from ..util.grace import profile_status
 
             return self._json(200, profile_status())
+        if u.path in ("/ui", "/ui/", "/ui/index.html"):
+            from ..util.ui import render_status_page
+
+            with self.master.topo.lock:
+                page = render_status_page(
+                    f"seaweedfs-tpu master {self.master.ip}:{self.master.port}",
+                    {
+                        "Cluster": {
+                            "IsLeader": self.master.is_leader(),
+                            "Leader": self.master.leader(),
+                            "MaxVolumeId": self.master.topo.max_volume_id,
+                        },
+                        "DataNodes": [
+                            {
+                                "id": n.id,
+                                "dataCenter": n.data_center,
+                                "rack": n.rack,
+                                "volumes": len(n.volumes),
+                                "ecVolumes": len(n.ec_shards),
+                            }
+                            for n in self.master.topo.nodes.values()
+                        ],
+                    })
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(page)))
+            self.end_headers()
+            self.wfile.write(page)
+            return
         if u.path in ("/cluster/status", "/dir/status"):
             with self.master.topo.lock:
                 return self._json(200, {

@@ -120,7 +120,9 @@ def _flags(
             if "=" in key:
                 k, _, v = key.partition("=")
                 opts[k] = v
-            elif key in bools or all(c in bools for c in key):
+            elif key in bools:
+                short.add(key)
+            elif all(c in bools for c in key):  # combined -la style
                 short.update(key)
             elif i + 1 < len(args):
                 opts[key] = args[i + 1]
@@ -498,3 +500,67 @@ def s3_clean_uploads(env: CommandEnv, args: list[str]) -> str:
                     updir, up.name, is_delete_data=True, is_recursive=True)
                 out.append(f"purge {updir}/{up.name}")
     return "\n".join(out)
+
+
+@register("s3.configure")
+def s3_configure(env: CommandEnv, args: list[str]) -> str:
+    """Manage the s3 identity config stored in the filer
+    (command_s3_configure.go; same /etc/iam/identity.json the IAM API and
+    the gateway's live reload use).  Without -apply the (modified) config
+    is only shown."""
+    import json
+
+    bools = ("l", "a", "r", "v", "force", "delete", "apply")
+    short, opts, _pos = _flags(args, bools=bools)
+    client = _filer(env)
+    try:
+        status, _, body = client.get_object("/etc/iam/identity.json")
+        conf = json.loads(body) if status == 200 and body else {}
+    except Exception:
+        conf = {}
+    conf.setdefault("identities", [])
+
+    user = opts.get("user", "")
+    actions = [a for a in opts.get("actions", "").split(",") if a]
+    buckets = [b for b in opts.get("buckets", "").split(",") if b]
+    if buckets:
+        actions = [f"{a}:{b}" for a in actions for b in buckets]
+    access_key = opts.get("access_key", "")
+    secret_key = opts.get("secret_key", "")
+    delete = "delete" in short
+
+    if user:
+        ident = next((i for i in conf["identities"]
+                      if i.get("name") == user), None)
+        if delete and not actions and not access_key:
+            conf["identities"] = [i for i in conf["identities"]
+                                  if i.get("name") != user]
+        else:
+            if ident is None:
+                ident = {"name": user, "credentials": [], "actions": []}
+                conf["identities"].append(ident)
+            if access_key:
+                if delete:
+                    ident["credentials"] = [
+                        c for c in ident.get("credentials", [])
+                        if c.get("accessKey") != access_key]
+                else:
+                    ident.setdefault("credentials", []).append(
+                        {"accessKey": access_key,
+                         "secretKey": secret_key})
+            if actions:
+                if delete:
+                    ident["actions"] = [
+                        a for a in ident.get("actions", [])
+                        if a not in actions]
+                else:
+                    for a in actions:
+                        if a not in ident.setdefault("actions", []):
+                            ident["actions"].append(a)
+
+    rendered = json.dumps(conf, indent=2)
+    if "apply" in short:
+        client.put_object("/etc/iam/identity.json", rendered.encode(),
+                          mime="application/json")
+        return rendered + "\napplied."
+    return rendered

@@ -92,6 +92,14 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
             from ..util.grace import profile_status
 
             return self._send_json(200, profile_status())
+        if path.path in ("/ui", "/ui/", "/ui/index.html"):
+            from ..util.ui import render_status_page
+
+            page = render_status_page(
+                f"seaweedfs-tpu volume {self.volume_server.ip}:"
+                f"{self.volume_server.port}",
+                {"Status": self.store.status()})
+            return self._send(200, page, "text/html")
         try:
             fid = FileId.parse(path.path.lstrip("/"))
         except ValueError:

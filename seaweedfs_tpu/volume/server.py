@@ -45,6 +45,7 @@ class VolumeServer:
         jwt_signing_key: bytes | str = b"",
         whitelist: list[str] | None = None,
         tier_backends: dict | None = None,
+        tcp_port: int = 0,  # experimental raw-TCP data path; 0 disables
     ):
         # remote-tier backends: {"s3.default": {"endpoint": ..., ...}}
         # (the [storage.backend] config tier; backend.go:32-46)
@@ -59,6 +60,7 @@ class VolumeServer:
                     glog.warning("unknown tier backend type %s", btype)
         self.ip = ip
         self.port = port
+        self.tcp_port = tcp_port
         self.grpc_port = port + GRPC_PORT_OFFSET
         self.master_addresses = master_addresses
         self.pulse_seconds = pulse_seconds
@@ -101,6 +103,11 @@ class VolumeServer:
         )
         if self.metrics_port:
             self._metricsd = serve_metrics(self.metrics_port)
+        self._tcpd = None
+        if self.tcp_port:
+            from .tcp_handlers import serve_tcp
+
+            self._tcpd = serve_tcp(self, self.tcp_port)
         self._hb_thread = threading.Thread(target=self._heartbeat_loop, daemon=True)
         self._hb_thread.start()
         glog.info("volume server started http=%d grpc=%d dirs=%s",
@@ -109,6 +116,9 @@ class VolumeServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if getattr(self, "_tcpd", None):
+            self._tcpd.shutdown()
+            self._tcpd.server_close()
         if self._httpd:
             self._httpd.shutdown()
             self._httpd.server_close()

@@ -37,17 +37,26 @@ def make_volume(
     return vol
 
 
+_used_ports: set[int] = set()
+
+
 def free_port(limit: int = 55000) -> int:
-    """A free TCP port whose +10000 gRPC sibling stays below 65536.
+    """A free TCP port whose +10000 gRPC sibling stays below 65536,
+    never handed out twice in one test session.
 
     Every server derives grpc_port = port + 10000; an ephemeral port
     above 55535 silently wraps modulo 65536 inside grpc and dials the
-    wrong place."""
+    wrong place.  Reuse matters because pb/rpc.py caches one channel per
+    address process-wide: a port recycled from an earlier module's dead
+    server would serve its stale, backed-off channel to the new one."""
     import socket
 
     while True:
         with socket.socket() as s:
             s.bind(("127.0.0.1", 0))
             port = s.getsockname()[1]
-        if port <= limit:
+        if port <= limit and port not in _used_ports \
+                and port + 10000 not in _used_ports:
+            _used_ports.add(port)
+            _used_ports.add(port + 10000)
             return port

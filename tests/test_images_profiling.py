@@ -134,3 +134,32 @@ def test_profiling_dumps(tmp_path):
     stats = pstats.Stats(str(cpu))
     assert stats.total_calls >= 0
     grace._cpu_profiler = None
+
+
+def test_server_ui_pages(tmp_path_factory):
+    """Per-server /ui status pages (server/*_ui analogue)."""
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    master = MasterServer(ip="127.0.0.1", port=free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("uivol"))],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+    )
+    vs.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and len(master.topo.nodes) < 1:
+            time.sleep(0.1)
+        for port, marker in ((master.port, b"master"), (vs.port, b"volume")):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/ui", timeout=10) as r:
+                page = r.read()
+            assert r.headers["Content-Type"].startswith("text/html")
+            assert marker in page and b"<table>" in page
+    finally:
+        vs.stop()
+        master.stop()

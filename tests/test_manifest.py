@@ -15,12 +15,9 @@ from seaweedfs_tpu.pb import filer_pb2
 
 
 def _free_port() -> int:
-    while True:
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        if port < 50000:
-            return port
+    from helpers import free_port
+
+    return free_port()
 
 
 def chunk(fid, offset, size, mtime=1):

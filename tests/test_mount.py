@@ -19,12 +19,9 @@ from seaweedfs_tpu.util.chunk_cache import TieredChunkCache
 
 
 def _free_port() -> int:
-    while True:
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        if port < 50000:
-            return port
+    from helpers import free_port
+
+    return free_port()
 
 
 # -- dirty-page intervals (dirty_page_interval_test.go analogues) -----------

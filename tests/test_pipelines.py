@@ -21,13 +21,10 @@ from seaweedfs_tpu.replication import FilerSource, LocalSink, Replicator
 from seaweedfs_tpu.messaging.broker import hash_ring_owner
 
 
-def _free_port():
-    while True:
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        if port < 50000:
-            return port
+def _free_port() -> int:
+    from helpers import free_port
+
+    return free_port()
 
 
 # -- notification ------------------------------------------------------------

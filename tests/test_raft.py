@@ -222,14 +222,9 @@ def test_master_peers_mismatch_rejected(tmp_path):
 
 
 def _free_port():
-    import socket
+    from helpers import free_port
 
-    while True:
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        if port < 50000:
-            return port
+    return free_port()
 
 
 def test_master_quorum_failover(tmp_path):

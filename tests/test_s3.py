@@ -20,13 +20,10 @@ import pytest
 from seaweedfs_tpu.s3api import auth as s3auth
 
 
-def _free_port():
-    while True:
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        if port < 50000:
-            return port
+def _free_port() -> int:
+    from helpers import free_port
+
+    return free_port()
 
 
 # -- signature primitives ----------------------------------------------------

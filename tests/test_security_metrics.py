@@ -122,12 +122,9 @@ def test_glog_levels_and_format():
 
 
 def _free_port():
-    while True:
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            p = s.getsockname()[1]
-        if p < 50000:
-            return p
+    from helpers import free_port
+
+    return free_port()
 
 
 def _http(method, url, data=None, headers=None):

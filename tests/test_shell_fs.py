@@ -192,3 +192,37 @@ def test_s3_clean_uploads(populated):
     assert "purge" in out
     assert client.find_entry("/buckets/upbucket/.uploads", "stale1") is None
     run_command(env, "s3.bucket.delete -name upbucket")
+
+
+def test_s3_configure(populated):
+    """s3.configure manages the shared identity json (command_s3_configure.go);
+    the IAM API and gateway read the same file."""
+    env, client = populated
+    out = run_command(
+        env, "s3.configure -user carol -access_key AKCAROL "
+             "-secret_key SKCAROL -actions Read,Write -apply")
+    assert "applied." in out
+    import json
+
+    code, _, body = client.get_object("/etc/iam/identity.json")
+    assert code == 200
+    conf = json.loads(body)
+    carol = next(i for i in conf["identities"] if i["name"] == "carol")
+    assert carol["credentials"][0]["accessKey"] == "AKCAROL"
+    assert set(carol["actions"]) == {"Read", "Write"}
+    # bucket-scoped grants
+    run_command(env, "s3.configure -user carol -actions List "
+                     "-buckets photos -apply")
+    code, _, body = client.get_object("/etc/iam/identity.json")
+    conf = json.loads(body)
+    carol = next(i for i in conf["identities"] if i["name"] == "carol")
+    assert "List:photos" in carol["actions"]
+    # delete a key, then the whole user
+    run_command(env, "s3.configure -user carol -access_key AKCAROL "
+                     "-delete -apply")
+    conf = json.loads(client.get_object("/etc/iam/identity.json")[2])
+    carol = next(i for i in conf["identities"] if i["name"] == "carol")
+    assert carol["credentials"] == []
+    run_command(env, "s3.configure -user carol -delete -apply")
+    conf = json.loads(client.get_object("/etc/iam/identity.json")[2])
+    assert all(i["name"] != "carol" for i in conf["identities"])

@@ -1,0 +1,225 @@
+"""Systematic concurrency stress tier (SURVEY §5.2).
+
+The reference leans on `go test -race`; Python has no race detector, so
+this tier hammers the lock-based invariants directly: parallel writers/
+readers/deleters on one volume, mixed filer namespace mutation, and
+vacuum racing live appends.  Each test bounds its runtime (~seconds) and
+asserts full consistency afterwards.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from helpers import free_port
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    master = MasterServer(ip="127.0.0.1", port=free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("stressvol"))],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+        max_volume_count=100,
+    )
+    vs.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and len(master.topo.nodes) < 1:
+        time.sleep(0.1)
+    filer = FilerServer(
+        masters=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=free_port(), store="memory", max_mb=1,
+    )
+    filer.start()
+    yield master, vs, filer
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def _assign(master) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{master.port}/dir/assign", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post(url: str, data: bytes) -> dict:
+    boundary = "stressb"
+    body = (f"--{boundary}\r\nContent-Disposition: form-data; "
+            f'name="file"; filename="s.bin"\r\n\r\n').encode() + data + \
+        f"\r\n--{boundary}--\r\n".encode()
+    req = urllib.request.Request(
+        f"http://{url}", data=body, method="POST",
+        headers={"Content-Type":
+                 f"multipart/form-data; boundary={boundary}"})
+    with urllib.request.urlopen(req, timeout=20) as r:
+        return json.loads(r.read() or b"{}")
+
+
+def test_parallel_volume_writers_and_readers(stack):
+    """32 threads × assign+write, interleaved with reads: every blob must
+    come back byte-exact; the needle map never loses an entry."""
+    master, vs, _ = stack
+    n = 64
+    payloads = {}
+    lock = threading.Lock()
+
+    def write_one(i: int):
+        a = _assign(master)
+        data = (f"payload-{i}-".encode()) * 50
+        _post(f"{a['url']}/{a['fid']}", data)
+        with lock:
+            payloads[a["fid"]] = data
+        return a["fid"]
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=16) as ex:
+        fids = list(ex.map(write_one, range(n)))
+    assert len(set(fids)) == n
+
+    def read_one(fid: str) -> bool:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{vs.port}/{fid}", timeout=20) as r:
+            return r.read() == payloads[fid]
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=16) as ex:
+        assert all(ex.map(read_one, fids))
+
+
+def test_mixed_write_delete_read_storm(stack):
+    """Concurrent writers + deleters on the same volumes: reads after the
+    storm agree exactly with the surviving set."""
+    master, vs, _ = stack
+    alive: dict[str, bytes] = {}
+    dead: list[str] = []
+    lock = threading.Lock()
+
+    def worker(i: int):
+        a = _assign(master)
+        data = f"storm-{i}".encode() * 20
+        _post(f"{a['url']}/{a['fid']}", data)
+        if i % 3 == 0:
+            req = urllib.request.Request(
+                f"http://{a['url']}/{a['fid']}", method="DELETE")
+            with urllib.request.urlopen(req, timeout=20):
+                pass
+            with lock:
+                dead.append(a["fid"])
+        else:
+            with lock:
+                alive[a["fid"]] = data
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=16) as ex:
+        list(ex.map(worker, range(48)))
+
+    for fid, data in alive.items():
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{vs.port}/{fid}", timeout=20) as r:
+            assert r.read() == data
+    for fid in dead:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{vs.port}/{fid}", timeout=20) as r:
+                assert r.status == 404
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+
+
+def test_filer_namespace_storm(stack):
+    """Parallel creates/overwrites/deletes across shared directories; the
+    final listing matches the computed survivor set."""
+    _, _, filer = stack
+    from seaweedfs_tpu.s3api.filer_client import FilerClient
+
+    client = FilerClient(f"127.0.0.1:{filer.port}")
+    survivors: dict[str, bytes] = {}
+    lock = threading.Lock()
+
+    def worker(i: int):
+        d = f"/storm/d{i % 4}"
+        name = f"f{i}.bin"
+        data = f"content-{i}-v2".encode()
+        client.put_object(f"{d}/{name}", f"content-{i}-v1".encode())
+        client.put_object(f"{d}/{name}", data)  # overwrite
+        if i % 4 == 0:
+            client.delete_entry(d, name)
+        else:
+            with lock:
+                survivors[f"{d}/{name}"] = data
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=12) as ex:
+        list(ex.map(worker, range(48)))
+
+    for path, data in survivors.items():
+        code, _, body = client.get_object(path)
+        assert code == 200 and body == data, path
+    listed = set()
+    for i in range(4):
+        for e in client.list_entries(f"/storm/d{i}", limit=1000):
+            listed.add(f"/storm/d{i}/{e.name}")
+    assert listed == set(survivors)
+
+
+def test_vacuum_races_live_appends(tmp_path):
+    """Compaction with concurrent appends must keep every needle written
+    before AND during the vacuum (makeupDiff replay,
+    volume_vacuum.go:179)."""
+    import numpy as np
+
+    from seaweedfs_tpu.storage import SuperBlock
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.vacuum import commit_compact, compact
+    from seaweedfs_tpu.storage.volume import Volume
+
+    vol = Volume(str(tmp_path), "", 1, super_block=SuperBlock())
+    rng = np.random.default_rng(3)
+    expect: dict[int, bytes] = {}
+    for i in range(1, 101):
+        data = rng.integers(0, 256, 200).astype(np.uint8).tobytes()
+        vol.append_needle(Needle(cookie=7, id=i, data=data))
+        expect[i] = data
+    for i in range(1, 51):  # delete half -> garbage to reclaim
+        vol.delete_needle(i)
+        del expect[i]
+
+    stop = threading.Event()
+    racer_ids = []
+
+    def racer():
+        i = 1000
+        while not stop.is_set():
+            data = f"racer-{i}".encode() * 3
+            vol.append_needle(Needle(cookie=7, id=i, data=data))
+            expect[i] = data
+            racer_ids.append(i)
+            i += 1
+            time.sleep(0.001)
+
+    t = threading.Thread(target=racer)
+    t.start()
+    time.sleep(0.02)
+    _base, snapshot = compact(vol)
+    time.sleep(0.05)  # let more appends race the shadow copy
+    stop.set()
+    t.join()
+    commit_compact(vol, snapshot)
+
+    assert len(racer_ids) > 0
+    for nid, data in expect.items():
+        assert bytes(vol.read_needle(nid).data) == data, nid
+    for nid in range(1, 51):
+        with pytest.raises(KeyError):
+            vol.read_needle(nid)
+    vol.close()

@@ -27,12 +27,9 @@ from helpers import make_volume
 
 
 def _free_port() -> int:
-    while True:
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        if port < 50000:
-            return port
+    from helpers import free_port
+
+    return free_port()
 
 
 class DirBackend(BackendStorage):
