@@ -84,11 +84,13 @@ class GatewayHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         path = urllib.parse.unquote(self.path.partition("?")[0])
+        query = self.path.partition("?")[2]
         try:
             if path.startswith("/blobs"):
                 return self._post_blob()
             if path.startswith("/files/"):
-                return self._proxy_filer("PUT", path[len("/files"):])
+                return self._proxy_filer("PUT", path[len("/files"):],
+                                         query)
             if path.startswith("/topics/"):
                 return self._post_topic(path[len("/topics/"):])
         except urllib.error.HTTPError as e:
@@ -101,11 +103,13 @@ class GatewayHandler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         path = urllib.parse.unquote(self.path.partition("?")[0])
+        query = self.path.partition("?")[2]
         try:
             if path.startswith("/blobs/"):
                 return self._delete_blob(path[len("/blobs/"):])
             if path.startswith("/files/"):
-                return self._proxy_filer("DELETE", path[len("/files"):])
+                return self._proxy_filer("DELETE", path[len("/files"):],
+                                         query)
         except urllib.error.HTTPError as e:
             return self._send_json(e.code, {"error": e.reason})
         except Exception as e:  # noqa: BLE001
@@ -118,7 +122,8 @@ class GatewayHandler(BaseHTTPRequestHandler):
             return self._send_json(200, {"gateway": "ok"})
         try:
             if path.startswith("/files/"):
-                return self._proxy_filer("GET", path[len("/files"):])
+                return self._proxy_filer("GET", path[len("/files"):],
+                                         self.path.partition("?")[2])
         except Exception as e:  # noqa: BLE001
             return self._send_json(500, {"error": str(e)})
         self._send_json(404, {"error": "unknown route"})
@@ -166,11 +171,13 @@ class GatewayHandler(BaseHTTPRequestHandler):
 
     # -- files (filer proxy) -------------------------------------------------
 
-    def _proxy_filer(self, method: str, path: str) -> None:
+    def _proxy_filer(self, method: str, path: str,
+                     query: str = "") -> None:
         filer = self.gw.filer()
         data = self._body() if method == "PUT" else None
+        qs = f"?{query}" if query else ""
         req = urllib.request.Request(
-            f"http://{filer}{urllib.parse.quote(path)}", data=data,
+            f"http://{filer}{urllib.parse.quote(path)}{qs}", data=data,
             method=method,
             headers={"Content-Type":
                      self.headers.get("Content-Type")

@@ -39,7 +39,25 @@ class ReedSolomon:
     # -- core matmul ------------------------------------------------------
 
     def _apply(self, rows: np.ndarray, inputs: list[np.ndarray]) -> list[np.ndarray]:
-        """outputs[i] = XOR_j mul(rows[i,j], inputs[j]) via table lookups."""
+        """outputs[i] = XOR_j mul(rows[i,j], inputs[j]) via table lookups.
+
+        Uses the C++ SSSE3 nibble-table codec when available — decode/
+        rebuild matrices go through the same kernel as encode parity, so
+        reconstruction is not left on the slow numpy path."""
+        from ..native import lib as native
+
+        if len(inputs) > 1 and any(len(x) != len(inputs[0])
+                                   for x in inputs[1:]):
+            # the C kernel indexes every input by len(inputs[0]) — a
+            # shorter shard would be read out of bounds
+            raise ValueError("input shards must be the same length")
+        if native.available() and rows.size and len(inputs):
+            outs = native.gf_apply(
+                np.ascontiguousarray(rows, dtype=np.uint8),
+                [np.ascontiguousarray(x).tobytes() for x in inputs],
+                rows.shape[0],
+            )
+            return [np.frombuffer(o, dtype=np.uint8) for o in outs]
         n = len(inputs)
         outs = []
         for i in range(rows.shape[0]):
@@ -58,17 +76,9 @@ class ReedSolomon:
     # -- public API -------------------------------------------------------
 
     def parity_of(self, data: np.ndarray) -> np.ndarray:
-        """(data_shards, B) -> (parity_shards, B), the bulk-pipeline entry."""
+        """(data_shards, B) -> (parity_shards, B), the bulk-pipeline entry;
+        _apply picks the C++ SSSE3 kernel when available."""
         assert data.shape[0] == self.data_shards
-        from ..native import lib as native
-
-        if native.available():
-            outs = native.gf_apply(
-                self.parity_matrix,
-                [np.ascontiguousarray(row).tobytes() for row in data],
-                self.parity_shards,
-            )
-            return np.stack([np.frombuffer(o, dtype=np.uint8) for o in outs])
         return np.stack(self._apply(self.parity_matrix, list(data)))
 
     def encode(self, shards: list[np.ndarray]) -> None:

@@ -536,6 +536,9 @@ def s3_configure(env: CommandEnv, args: list[str]) -> str:
             conf["identities"] = [i for i in conf["identities"]
                                   if i.get("name") != user]
         else:
+            if ident is None and delete:
+                # nothing to delete — do NOT materialise a phantom user
+                return json.dumps(conf, indent=2)
             if ident is None:
                 ident = {"name": user, "credentials": [], "actions": []}
                 conf["identities"].append(ident)

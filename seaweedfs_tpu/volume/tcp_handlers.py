@@ -72,6 +72,11 @@ class _Handler(socketserver.StreamRequestHandler):
                             "deletes require a jwt; the tcp protocol "
                             "carries none — use the http data path")
                     fid = FileId.parse(fid_str)
+                    # same anti-tamper contract as the HTTP delete path:
+                    # the cookie must match before anything is removed
+                    n = store.read_needle(fid.volume_id, fid.key)
+                    if n.cookie != fid.cookie:
+                        raise PermissionError("cookie mismatch")
                     store.delete_needle(fid.volume_id, fid.key)
                     server.replicate_delete(fid, f"/{fid_str}")
                     self.wfile.write(b"+OK\n")

@@ -226,3 +226,17 @@ def test_s3_configure(populated):
     run_command(env, "s3.configure -user carol -delete -apply")
     conf = json.loads(client.get_object("/etc/iam/identity.json")[2])
     assert all(i["name"] != "carol" for i in conf["identities"])
+
+
+def test_lock_unlock(stack):
+    """lock/unlock take and release the exclusive admin lease
+    (command_fs_lock_unlock.go); a second holder is refused."""
+    env, _ = stack
+    from seaweedfs_tpu.shell.commands import CommandEnv
+
+    assert run_command(env, "lock") == "locked"
+    other = CommandEnv(env.master_grpc)
+    assert run_command(other, "lock") == "lock busy"
+    assert run_command(env, "unlock") == "unlocked"
+    assert run_command(other, "lock") == "locked"
+    run_command(other, "unlock")
