@@ -116,12 +116,18 @@ class FilerGrpcService:
         collection = request.collection or self.filer.bucket_collection(
             request.path
         )
+        # filer.conf path rules fill whatever the client left unset
+        from .server import _ttl_seconds
+
+        collection, replication, rule_ttl = self.fs.apply_path_conf(
+            request.path, collection, request.replication,
+            "set" if request.ttl_sec else "")
         try:
             result = self.fs.assign(
                 count=request.count or 1,
                 collection=collection,
-                replication=request.replication,
-                ttl_sec=request.ttl_sec,
+                replication=replication,
+                ttl_sec=request.ttl_sec or _ttl_seconds(rule_ttl),
                 data_center=request.data_center,
                 rack=request.rack,
             )

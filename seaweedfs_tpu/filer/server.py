@@ -105,6 +105,22 @@ class FilerServer:
             disk_dir=chunk_cache_dir or None,
         )
         self.manifest_batch = manifest_batch
+        # per-path storage rules at /etc/seaweedfs/filer.conf
+        # (filer_conf.go); consulted on every write without explicit
+        # collection/replication/ttl
+        from .filer_conf import FilerConfHolder
+
+        def _read_conf(path: str) -> bytes | None:
+            d, n = split_path(path)
+            entry = self.filer.store.find_entry(d, n)
+            if entry is None:
+                return None
+            if entry.content:
+                return bytes(entry.content)
+            return self.read_entry_range(
+                entry, 0, filechunks.total_size(entry.chunks))
+
+        self.filer_conf = FilerConfHolder(_read_conf)
         self.notification = notification
         if notification is not None:
             # every metadata mutation fans out to the configured queue
@@ -205,6 +221,8 @@ class FilerServer:
                    signatures: list[int] | None = None) -> filer_pb2.Entry:
         """Auto-chunking upload: split, assign+upload each chunk, CreateEntry."""
         directory, name = split_path(path)
+        collection, replication, ttl = self.apply_path_conf(
+            path, collection, replication, ttl)
         chunk_size = self.max_mb << 20
         ttl_sec = _ttl_seconds(ttl)
         chunks = []
@@ -229,6 +247,24 @@ class FilerServer:
         entry.attributes.ttl_sec = ttl_sec
         self.filer.create_entry(directory, entry, signatures=signatures)
         return entry
+
+    def apply_path_conf(self, path: str, collection: str,
+                        replication: str, ttl: str) -> tuple[str, str, str]:
+        """Fill unset storage knobs from the matching filer.conf rule.
+
+        /etc/ is exempt: the conf file itself (and the IAM identity
+        json) must never land on a TTL'd or deletable-collection volume
+        a broad rule selects — that would self-destruct the config."""
+        if path.startswith("/etc/"):
+            return collection, replication, ttl
+        if collection and replication and ttl:
+            return collection, replication, ttl
+        rule = self.filer_conf.match(path)
+        if rule is None:
+            return collection, replication, ttl
+        return (collection or rule.get("collection", ""),
+                replication or rule.get("replication", ""),
+                ttl or rule.get("ttl", ""))
 
     def _upload_chunk(self, blob: bytes, offset: int, name: str, mime: str,
                       collection: str, replication: str, ttl: str
@@ -260,6 +296,8 @@ class FilerServer:
         """Append bytes as a new chunk (AppendToEntry semantics over HTTP;
         used by log-style writers like the message broker)."""
         directory, name = split_path(path)
+        collection, replication, ttl = self.apply_path_conf(
+            path, collection, replication, ttl)
         chunk = self._upload_chunk(
             data, 0, name, mime, collection or self.filer.bucket_collection(path),
             replication, ttl,

@@ -567,3 +567,65 @@ def s3_configure(env: CommandEnv, args: list[str]) -> str:
                           mime="application/json")
         return rendered + "\napplied."
     return rendered
+
+
+@register("fs.configure")
+def fs_configure(env: CommandEnv, args: list[str]) -> str:
+    """Per-path storage rules stored at /etc/seaweedfs/filer.conf
+    (command_fs_configure.go): writes under locationPrefix get the
+    rule's collection/replication/ttl.  Without -apply the modified
+    config is only displayed."""
+    from ..filer.filer_conf import CONF_PATH, FilerConf
+
+    bools = ("l", "a", "r", "v", "force", "delete", "apply")
+    short, opts, _pos = _flags(args, bools=bools)
+    client = _filer(env)
+    status, _, body = client.get_object(CONF_PATH)
+    if status == 200:
+        conf = FilerConf.from_bytes(body)
+    elif status == 404:
+        conf = FilerConf()
+    else:
+        # a transient read error must NOT silently become an empty
+        # config that -apply then persists, wiping every rule
+        raise IOError(f"read {CONF_PATH}: HTTP {status}")
+
+    prefix = opts.get("locationPrefix", "")
+    if prefix:
+        if opts.get("collection") and prefix.startswith("/buckets/"):
+            raise ValueError(
+                "one s3 bucket goes to one collection and is not "
+                "customizable")
+        # reject values the storage layer cannot parse BEFORE they can
+        # break every write under the prefix (the reference validates
+        # too, command_fs_configure.go)
+        replication = opts.get("replication", "")
+        if replication:
+            from ..storage.replica_placement import ReplicaPlacement
+
+            ReplicaPlacement.parse(replication)  # raises on bad input
+        ttl = opts.get("ttl", "")
+        if ttl:
+            from ..storage.ttl import TTL
+
+            parsed = TTL.parse(ttl)  # raises on non-numeric counts
+            if str(parsed) != ttl:
+                raise ValueError(
+                    f"bad ttl {ttl!r}: units are m/h/d/w/M/y "
+                    f"(parsed back as {str(parsed) or 'empty'!r})")
+        if "delete" in short:
+            conf.delete(prefix)
+        else:
+            conf.upsert({
+                "locationPrefix": prefix,
+                "collection": opts.get("collection", ""),
+                "replication": replication,
+                "ttl": ttl,
+            })
+
+    rendered = conf.to_bytes().decode()
+    if "apply" in short:
+        client.put_object(CONF_PATH, conf.to_bytes(),
+                          mime="application/json")
+        return rendered + "\napplied."
+    return rendered

@@ -240,3 +240,45 @@ def test_lock_unlock(stack):
     assert run_command(env, "unlock") == "unlocked"
     assert run_command(other, "lock") == "locked"
     run_command(other, "unlock")
+
+
+def test_fs_configure_path_rules(populated):
+    """fs.configure rules steer writes: files under the prefix land in
+    the rule's collection (filer_conf.go + command_fs_configure.go)."""
+    env, client = populated
+    out = run_command(
+        env, "fs.configure -locationPrefix /ruled/ -collection ruledcoll "
+             "-apply")
+    assert "applied." in out and "ruledcoll" in out
+    # keep writing until the conf holder refreshes (~2s) and the
+    # master's heartbeat reports the grown collection
+    def write_and_list():
+        client.put_object("/ruled/file.bin", b"steered" * 100)
+        return run_command(env, "collection.list")
+
+    cols = _poll(write_and_list,
+                 lambda o: 'collection:"ruledcoll"' in o, timeout=20)
+    assert 'collection:"ruledcoll"' in cols
+    # un-ruled paths stay in the default collection
+    run_command(env, "fs.configure -locationPrefix /ruled/ -delete -apply")
+    out = run_command(env, "fs.configure")
+    assert "ruledcoll" not in out
+
+
+def test_fs_configure_validation(populated):
+    env, client = populated
+    with pytest.raises(Exception):
+        run_command(env, "fs.configure -locationPrefix /x/ -ttl banana")
+    with pytest.raises(Exception):
+        run_command(env, "fs.configure -locationPrefix /x/ -ttl 300s")
+    with pytest.raises(Exception):
+        run_command(env, "fs.configure -locationPrefix /buckets/b/ "
+                         "-collection other")
+    with pytest.raises(Exception):
+        run_command(env, "fs.configure -locationPrefix /x/ "
+                         "-replication 9z9")
+    # the conf file itself is exempt from path rules: a broad TTL rule
+    # must not place /etc/seaweedfs/filer.conf on an expiring volume
+    run_command(env, "fs.configure -locationPrefix / -ttl 1h -apply")
+    out = run_command(env, "fs.configure -locationPrefix / -delete -apply")
+    assert '"locationPrefix": "/"' not in out
