@@ -273,13 +273,9 @@ class FilerServer:
             self._master_order(), count=1, collection=collection,
             replication=replication or self.default_replication, ttl=ttl,
         )
-        cipher_key = b""
-        stored = blob
-        if self.cipher:
-            from ..util.cipher import encrypt, gen_cipher_key
+        from ..util.cipher import maybe_seal
 
-            cipher_key = gen_cipher_key()
-            stored = encrypt(blob, cipher_key)
+        stored, cipher_key = maybe_seal(blob, self.cipher)
         up = upload_data(
             result.fid_url(), stored, filename=name, mime=mime,
             jwt=result.auth,

@@ -404,6 +404,24 @@ class WFS:
             return chunks
         return resolve_chunk_manifest(self.fetch_whole_chunk, chunks)
 
+    def _filer_cipher(self) -> bool:
+        """Whether the filer runs with -encryptVolumeData — mount writes
+        then seal chunks the same way (GetFilerConfiguration.cipher).
+
+        Fails CLOSED: if the filer's answer is unknown, the write errors
+        instead of silently storing plaintext on a cluster the operator
+        configured to encrypt."""
+        if not hasattr(self, "_cipher_flag"):
+            try:
+                resp = self._stub().GetFilerConfiguration(
+                    filer_pb2.GetFilerConfigurationRequest())
+            except Exception as e:
+                raise FuseError(
+                    errno.EIO,
+                    f"cannot resolve filer cipher config: {e}")
+            self._cipher_flag = bool(resp.cipher)
+        return self._cipher_flag
+
     def assign_and_upload(self, path: str, data: bytes) -> filer_pb2.FileChunk:
         resp = self._stub().AssignVolume(
             filer_pb2.AssignVolumeRequest(
@@ -416,13 +434,18 @@ class WFS:
         )
         if resp.error:
             raise FuseError(errno.EIO, resp.error)
+        from ..util.cipher import maybe_seal
+
+        stored, cipher_key = maybe_seal(data, self._filer_cipher())
         up = upload_data(
-            f"http://{resp.url}/{resp.file_id}", data, jwt=resp.auth
+            f"http://{resp.url}/{resp.file_id}", stored, jwt=resp.auth
         )
-        self.chunks.set(resp.file_id, data)  # freshly written = hot
-        return filechunks.make_chunk(
+        self.chunks.set(resp.file_id, stored)  # freshly written = hot
+        chunk = filechunks.make_chunk(
             resp.file_id, 0, len(data), time.time_ns(), e_tag=up.etag
         )
+        chunk.cipher_key = cipher_key
+        return chunk
 
     # -- remote-change subscription ---------------------------------------
 

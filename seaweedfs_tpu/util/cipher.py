@@ -30,3 +30,13 @@ def decrypt(blob: bytes, key: bytes) -> bytes:
     if len(blob) < NONCE_SIZE:
         raise ValueError("ciphertext too short")
     return AESGCM(key).decrypt(blob[:NONCE_SIZE], blob[NONCE_SIZE:], None)
+
+
+def maybe_seal(data: bytes, enabled: bool) -> tuple[bytes, bytes]:
+    """-> (stored_bytes, cipher_key): seal with a fresh per-chunk key
+    when enabled, pass through otherwise.  Shared by every chunk writer
+    (filer autochunk, FUSE mount) so the sealing format cannot drift."""
+    if not enabled:
+        return data, b""
+    key = gen_cipher_key()
+    return encrypt(data, key), key
