@@ -21,6 +21,21 @@ def _so_path() -> str:
     return os.path.join(os.path.dirname(__file__), "libseaweed_native.so")
 
 
+def _host_simd_tier() -> int:
+    """Best sw_gf_impl tier this host can run: 2 GFNI+AVX512, 1 SSSE3,
+    0 scalar — the heal target for stale/portable builds."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = f.read()
+    except OSError:
+        return 0
+    if "gfni" in flags and "avx512bw" in flags and "avx512f" in flags:
+        return 2
+    if "ssse3" in flags:
+        return 1
+    return 0
+
+
 def _load() -> ctypes.CDLL | None:
     global _lib, _tried
     with _lock:
@@ -39,6 +54,40 @@ def _load() -> ctypes.CDLL | None:
             lib = ctypes.CDLL(path)
         except OSError:
             return None
+        # self-heal a stale/portable build: a lib without sw_gf_impl, or
+        # one reporting the scalar path on an SSE-capable x86 host, was
+        # compiled before the SIMD kernels (or with a failed
+        # -march=native) — rebuild once and reload.  This exact staleness
+        # silently cost 4x codec throughput for three rounds.
+        try:
+            impl = lib.sw_gf_impl()
+        except AttributeError:
+            impl = -1
+        if impl < _host_simd_tier():
+            try:
+                import shutil
+                import tempfile
+
+                from . import build
+
+                path = build.build(force=True)
+                # dlopen caches the old mapping for the original path in
+                # this process; load the healed build via a unique copy
+                fd, fresh = tempfile.mkstemp(suffix=".so")
+                os.close(fd)
+                try:
+                    shutil.copy(path, fresh)
+                    lib = ctypes.CDLL(fresh)
+                finally:
+                    try:
+                        os.unlink(fresh)  # mapping stays valid
+                    except OSError:
+                        pass
+            except Exception:
+                try:
+                    lib = ctypes.CDLL(path)
+                except OSError:
+                    return None
         lib.sw_crc32c_update.restype = ctypes.c_uint32
         lib.sw_crc32c_update.argtypes = [
             ctypes.c_uint32,
@@ -93,3 +142,35 @@ def gf_apply(matrix_rows, inputs: list[bytes], out_count: int) -> list[bytearray
     )
     lib.sw_gf_apply(m.tobytes(), r, s, in_ptrs, out_ptrs, n)
     return outs
+
+
+def gf_apply_arrays(matrix_rows, inputs, out=None):
+    """Zero-copy variant of gf_apply over numpy uint8 arrays.
+
+    `inputs` are 1-D contiguous uint8 arrays of equal length (validated);
+    returns a list of fresh uint8 arrays (or fills `out` when given).
+    Pointers are passed straight to the C kernel — no tobytes copies.
+    """
+    lib = _load()
+    assert lib is not None
+    import numpy as np
+
+    m = np.ascontiguousarray(matrix_rows, dtype=np.uint8)
+    r, s = m.shape
+    if len(inputs) != s:
+        raise ValueError(f"matrix has {s} cols, got {len(inputs)} inputs")
+    n = len(inputs[0])
+    arrs = []
+    for x in inputs:
+        a = np.ascontiguousarray(x, dtype=np.uint8)
+        if a.ndim != 1 or len(a) != n:
+            raise ValueError("inputs must be equal-length 1-D u8 arrays")
+        arrs.append(a)
+    if out is None:
+        out = [np.empty(n, dtype=np.uint8) for _ in range(r)]
+    in_ptrs = (ctypes.c_char_p * s)(
+        *[ctypes.cast(a.ctypes.data, ctypes.c_char_p) for a in arrs])
+    out_ptrs = (ctypes.c_char_p * r)(
+        *[ctypes.cast(o.ctypes.data, ctypes.c_char_p) for o in out])
+    lib.sw_gf_apply(m.tobytes(), r, s, in_ptrs, out_ptrs, n)
+    return out

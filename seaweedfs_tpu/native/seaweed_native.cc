@@ -10,12 +10,17 @@
 #include <cstddef>
 #include <cstdint>
 #include <cstring>
+#include <initializer_list>
 
 #if defined(__SSE4_2__)
 #include <nmmintrin.h>
 #endif
 #if defined(__SSSE3__)
 #include <tmmintrin.h>
+#endif
+#if defined(__GFNI__) && defined(__AVX512F__) && defined(__AVX512BW__)
+#define SW_HAVE_GFNI 1
+#include <immintrin.h>
 #endif
 
 extern "C" {
@@ -133,14 +138,88 @@ static void gf_mul_acc_ssse3(uint8_t c, const uint8_t* in, uint8_t* out,
 }
 #endif
 
+#if defined(SW_HAVE_GFNI)
+// GFNI path: multiply-by-constant in ANY GF(2^8) representation is a
+// GF(2)-linear map on the byte's bits, so it is one vgf2p8affineqb with a
+// per-constant 8x8 bit matrix — 64 bytes per instruction under AVX512,
+// no table lookups.  (The same technique modern klauspost/reedsolomon
+// and ISA-L use; the reference pins v1.9.2, which predates it.)
+static uint64_t gf_affine_matrix[256];
+static int gfni_state = 0;  // 0 = untested, 1 = ok, -1 = unusable
+
+static uint64_t gf_build_affine(uint8_t c) {
+  // out_bit_i = parity(A.byte[7-i] & x); want out = c*x, so byte (7-i)
+  // collects bit i of c*2^j across the basis j.
+  uint64_t a = 0;
+  for (int i = 0; i < 8; i++) {
+    uint8_t rowbyte = 0;
+    for (int j = 0; j < 8; j++) {
+      if ((gf_mul_table[c][(uint8_t)(1u << j)] >> i) & 1) rowbyte |= (uint8_t)(1u << j);
+    }
+    a |= (uint64_t)rowbyte << (8 * (7 - i));
+  }
+  return a;
+}
+
+static void gfni_init() {
+  if (gfni_state != 0) return;
+  // the .so may have been built on a GFNI host and copied to one
+  // without it: gate at RUNTIME before executing any AVX512 instruction
+  if (!__builtin_cpu_supports("gfni") ||
+      !__builtin_cpu_supports("avx512f") ||
+      !__builtin_cpu_supports("avx512bw")) {
+    gfni_state = -1;
+    return;
+  }
+  for (int c = 0; c < 256; c++) gf_affine_matrix[c] = (uint64_t)gf_build_affine((uint8_t)c);
+  // self-check the bit-layout convention against the table codec before
+  // trusting it for real data
+  alignas(64) uint8_t in[64], out[64];
+  for (int i = 0; i < 64; i++) in[i] = (uint8_t)(i * 7 + 3);
+  for (int c : {2, 29, 71, 142, 255}) {
+    __m512i A = _mm512_set1_epi64((long long)gf_affine_matrix[c]);
+    __m512i v = _mm512_loadu_si512((const void*)in);
+    _mm512_storeu_si512((void*)out, _mm512_gf2p8affine_epi64_epi8(v, A, 0));
+    for (int i = 0; i < 64; i++) {
+      if (out[i] != gf_mul_table[c][in[i]]) { gfni_state = -1; return; }
+    }
+  }
+  gfni_state = 1;
+}
+
+static void gf_mul_acc_gfni(uint8_t c, const uint8_t* in, uint8_t* out,
+                            size_t n, bool first) {
+  __m512i A = _mm512_set1_epi64((long long)gf_affine_matrix[c]);
+  size_t i = 0;
+  for (; i + 64 <= n; i += 64) {
+    __m512i v = _mm512_loadu_si512((const void*)(in + i));
+    __m512i r = _mm512_gf2p8affine_epi64_epi8(v, A, 0);
+    if (!first)
+      r = _mm512_xor_si512(r, _mm512_loadu_si512((const void*)(out + i)));
+    _mm512_storeu_si512((void*)(out + i), r);
+  }
+  if (i < n) gf_mul_acc_scalar(c, in + i, out + i, n - i, first);
+}
+#endif
+
 void sw_gf_apply(const uint8_t* matrix, int r, int s, const uint8_t** inputs,
                  uint8_t** outputs, size_t n) {
   gf_init();
+#if defined(SW_HAVE_GFNI)
+  gfni_init();
+#endif
   for (int i = 0; i < r; i++) {
     bool first = true;
     for (int j = 0; j < s; j++) {
       uint8_t c = matrix[i * s + j];
       if (c == 0) continue;
+#if defined(SW_HAVE_GFNI)
+      if (gfni_state == 1) {
+        gf_mul_acc_gfni(c, inputs[j], outputs[i], n, first);
+        first = false;
+        continue;
+      }
+#endif
 #if defined(__SSSE3__)
       gf_mul_acc_ssse3(c, inputs[j], outputs[i], n, first);
 #else
@@ -153,3 +232,17 @@ void sw_gf_apply(const uint8_t* matrix, int r, int s, const uint8_t** inputs,
 }
 
 }  // extern "C"
+
+extern "C" int sw_gf_impl() {
+  // 2 = GFNI+AVX512, 1 = SSSE3, 0 = scalar (introspection for tests)
+  gf_init();
+#if defined(SW_HAVE_GFNI)
+  gfni_init();
+  if (gfni_state == 1) return 2;
+#endif
+#if defined(__SSSE3__)
+  return 1;
+#else
+  return 0;
+#endif
+}

@@ -52,12 +52,7 @@ class ReedSolomon:
             # shorter shard would be read out of bounds
             raise ValueError("input shards must be the same length")
         if native.available() and rows.size and len(inputs):
-            outs = native.gf_apply(
-                np.ascontiguousarray(rows, dtype=np.uint8),
-                [np.ascontiguousarray(x).tobytes() for x in inputs],
-                rows.shape[0],
-            )
-            return [np.frombuffer(o, dtype=np.uint8) for o in outs]
+            return native.gf_apply_arrays(rows, list(inputs))
         n = len(inputs)
         outs = []
         for i in range(rows.shape[0]):
@@ -77,8 +72,16 @@ class ReedSolomon:
 
     def parity_of(self, data: np.ndarray) -> np.ndarray:
         """(data_shards, B) -> (parity_shards, B), the bulk-pipeline entry;
-        _apply picks the C++ SSSE3 kernel when available."""
+        _apply picks the native GFNI/SSSE3 kernel when available."""
         assert data.shape[0] == self.data_shards
+        from ..native import lib as native
+
+        if native.available() and data.flags["C_CONTIGUOUS"]:
+            # rows of a preallocated output avoid the np.stack copy
+            out = np.empty((self.parity_shards, data.shape[1]), np.uint8)
+            native.gf_apply_arrays(self.parity_matrix, list(data),
+                                   out=list(out))
+            return out
         return np.stack(self._apply(self.parity_matrix, list(data)))
 
     def encode(self, shards: list[np.ndarray]) -> None:

@@ -130,3 +130,22 @@ def test_crc32c_masked():
         (((0xE3069283 >> 15) | (0xE3069283 << 17)) & 0xFFFFFFFF) + 0xA282EAD8
     ) & 0xFFFFFFFF
     assert crc32c.checksum(b"") == 0
+
+
+def test_native_codec_uses_simd_on_this_host():
+    """The native GF codec must engage a SIMD path (GFNI or SSSE3) on
+    x86 hosts — a silently-scalar build costs ~4x throughput (this
+    exact staleness shipped for three rounds before being caught)."""
+    from seaweedfs_tpu.native import lib as native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native lib unavailable")
+    with open("/proc/cpuinfo") as f:
+        flags = f.read()
+    impl = native._lib.sw_gf_impl()
+    if "gfni" in flags and "avx512bw" in flags:
+        assert impl == 2, "GFNI host must use the gf2p8affine kernel"
+    elif "ssse3" in flags:
+        assert impl >= 1, "SSE host must not run the scalar codec"
