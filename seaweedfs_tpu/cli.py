@@ -115,16 +115,23 @@ def cmd_filer(args) -> None:
     # filer.toml picks the store backend; the -store flag (a path) keeps
     # its historical meaning of "sqlite at this path" and wins when given
     store, store_path = "sqlite", args.store
+    store_options: dict = {}
     fconf = load_configuration("filer")
     if fconf.loaded and args.store == "./filer.db":  # flag left at default
         for kind, path_key in (("sqlite", "dbFile"), ("leveldb", "dir"),
-                               ("memory", "")):
+                               ("redis", ""), ("memory", "")):
             if fconf.get_bool(f"{kind}.enabled"):
                 store = kind
                 if path_key:
                     store_path = fconf.get_string(
                         f"{kind}.{path_key}", store_path)
                 break
+        if store == "redis":
+            store_options = {
+                "host": fconf.get_string("redis.host", "127.0.0.1"),
+                "port": fconf.get_int("redis.port", 6379),
+                "db": fconf.get_int("redis.db", 0),
+            }
 
     f = FilerServer(
         masters=[_grpc_addr(m) for m in args.master.split(",")],
@@ -136,6 +143,7 @@ def cmd_filer(args) -> None:
         metrics_port=args.metricsPort,
         peers=args.peers.split(",") if args.peers else None,
         cipher=args.cipher,
+        store_options=store_options,
     )
     f.start()
     print(f"filer http={args.port} grpc={f.grpc_port}")

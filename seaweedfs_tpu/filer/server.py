@@ -50,6 +50,7 @@ class FilerServer:
         manifest_batch: int = filechunk_manifest.MANIFEST_BATCH,
         peers: list[str] | None = None,  # peer filer HTTP addresses
         cipher: bool = False,  # AES-GCM encrypt chunk blobs (cipher.go)
+        store_options: dict | None = None,  # extra store kwargs (redis etc.)
     ):
         self.masters = list(masters)
         self.ip = ip
@@ -66,12 +67,14 @@ class FilerServer:
                     f"filer peer {p!r} must be host:port (http address)")
         self.metrics_port = metrics_port
         self.master_client = MasterClient(f"filer@{ip}:{port}", self.masters)
+        opts = dict(store_options or {})
         if store == "memory":
             self.filer = Filer(make_store("memory"), self._delete_chunks,
                                resolve_chunks_fn=self.resolve_chunks)
         else:
             self.filer = Filer(
-                make_store(store, path=store_path), self._delete_chunks,
+                make_store(store, path=store_path, **opts),
+                self._delete_chunks,
                 resolve_chunks_fn=self.resolve_chunks,
             )
         # the store signature identifies THIS store across restarts

@@ -1,10 +1,16 @@
 """Embedded FilerStore backends; importing registers them.
 
 Reference analogue: weed/filer/<backend>/ dirs registered via blank-import
-init() (weed/server/filer_server.go:23-36).  This build ships three
-embedded classes: in-memory (tests), sqlite (single-file, transactional,
-ordered listing — the abstract_sql class), and leveldb (bitcask-style
-log+snapshot store covering the reference's embedded-leveldb default).
+init() (weed/server/filer_server.go:23-36).  This build ships four
+classes: in-memory (tests), sqlite (single-file, transactional,
+ordered listing — the abstract_sql class), leveldb (bitcask-style
+log+snapshot store covering the reference's embedded-leveldb default),
+and redis (any RESP2 endpoint via the framework's own client).
 """
 
-from . import leveldb_store, memory_store, sqlite_store  # noqa: F401
+from . import (  # noqa: F401
+    leveldb_store,
+    memory_store,
+    redis_store,
+    sqlite_store,
+)

@@ -1,0 +1,124 @@
+"""Redis-backed FilerStore over the framework's own RESP client.
+
+Reference: weed/filer/redis/universal_redis_store.go — entries live at
+key = full path (serialized pb), directory membership in a set at
+key = directory + "\\x00"; listing is SMEMBERS + client-side sort/page.
+The go-redis dependency is replaced by util/resp.RespClient, so this
+store works against any RESP2 endpoint with zero client libraries.
+"""
+
+from __future__ import annotations
+
+from ...pb import filer_pb2
+from ...util.resp import RespClient
+from ..filerstore import FilerStore, register_store
+
+DIR_LIST_MARKER = b"\x00"
+KV_PREFIX = b"kv\x00"
+
+
+def _entry_key(directory: str, name: str) -> bytes:
+    return f"{directory.rstrip('/')}/{name}".encode()
+
+
+def _dir_key(directory: str) -> bytes:
+    return directory.encode() + DIR_LIST_MARKER
+
+
+def _glob_escape(b: bytes) -> bytes:
+    """Escape KEYS glob metacharacters so a literal path stays literal."""
+    out = bytearray()
+    for ch in b:
+        if ch in b"*?[]\\":
+            out += b"\\"
+        out.append(ch)
+    return bytes(out)
+
+
+@register_store("redis")
+class RedisStore(FilerStore):
+    name = "redis"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 db: int = 0, **_):
+        # RespClient.command carries its own lock; no second layer here
+        self._client = RespClient(host, port, db=db)
+
+    def _cmd(self, *parts):
+        return self._client.command(*parts)
+
+    # -- entries -------------------------------------------------------------
+
+    def insert_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
+        self._cmd(b"SET", _entry_key(directory, entry.name),
+                  entry.SerializeToString())
+        self._cmd(b"SADD", _dir_key(directory), entry.name.encode())
+
+    update_entry = insert_entry
+
+    def find_entry(self, directory: str, name: str) -> filer_pb2.Entry | None:
+        raw = self._cmd(b"GET", _entry_key(directory, name))
+        if raw is None:
+            return None
+        e = filer_pb2.Entry()
+        e.ParseFromString(raw)
+        return e
+
+    def delete_entry(self, directory: str, name: str) -> None:
+        self._cmd(b"DEL", _entry_key(directory, name))
+        self._cmd(b"SREM", _dir_key(directory), name.encode())
+
+    def delete_folder_children(self, directory: str) -> None:
+        # Primary path: targeted SMEMBERS recursion (no full-keyspace
+        # scan).  A glob-ESCAPED prefix sweep then reaps keyspaces whose
+        # parent entries were never created — orphans the go reference's
+        # member-recursion leaves behind.
+        base = directory.rstrip("/")
+        for name_b in self._cmd(b"SMEMBERS", _dir_key(directory)) or []:
+            name = bytes(name_b).decode()
+            e = self.find_entry(directory, name)
+            if e is not None and e.is_directory:
+                self.delete_folder_children(f"{base}/{name}")
+            self._cmd(b"DEL", _entry_key(directory, name))
+        keys = self._cmd(
+            b"KEYS", _glob_escape(base.encode() + b"/") + b"*") or []
+        for i in range(0, len(keys), 512):  # variadic DEL batches
+            self._cmd(b"DEL", *[bytes(k) for k in keys[i : i + 512]])
+        self._cmd(b"DEL", _dir_key(directory))
+
+    def list_entries(self, directory: str, start_from: str = "",
+                     inclusive: bool = False, prefix: str = "",
+                     limit: int = 1024):
+        names = sorted(
+            n.decode() for n in
+            (self._cmd(b"SMEMBERS", _dir_key(directory)) or []))
+        out = 0
+        for name in names:
+            if prefix and not name.startswith(prefix):
+                continue
+            if start_from:
+                if name < start_from or \
+                        (name == start_from and not inclusive):
+                    continue
+            e = self.find_entry(directory, name)
+            if e is None:
+                continue  # membership raced a delete
+            yield e
+            out += 1
+            if out >= limit:
+                return
+
+    # -- KV ------------------------------------------------------------------
+
+    def kv_get(self, key: bytes) -> bytes | None:
+        v = self._cmd(b"GET", KV_PREFIX + key)
+        return v if v else None
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        if value:
+            self._cmd(b"SET", KV_PREFIX + key, value)
+        else:
+            self._cmd(b"DEL", KV_PREFIX + key)
+
+    def close(self) -> None:
+        self._client.close()

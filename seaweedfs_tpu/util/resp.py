@@ -1,0 +1,238 @@
+"""Minimal RESP2 (Redis protocol) client — no client library needed.
+
+Reference analogue: the go-redis dependency behind weed/filer/redis.
+Only the handful of commands the redis filer store uses; one socket per
+client with a lock (the filer store serializes through it).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+
+class RespError(RuntimeError):
+    pass
+
+
+class RespClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 6379,
+                 db: int = 0, timeout: float = 10.0):
+        self.host, self.port, self.db = host, port, db
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock = None
+        self._f = None
+        self._connect()
+
+    def _connect(self) -> None:
+        self._teardown()
+        self._sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout)
+        self._f = self._sock.makefile("rb")
+        if self.db:
+            self._send_locked("SELECT", str(self.db))
+
+    def _teardown(self) -> None:
+        for h in (self._f, self._sock):
+            try:
+                if h:
+                    h.close()
+            except OSError:
+                pass
+        self._f = self._sock = None
+
+    def close(self) -> None:
+        with self._lock:
+            self._teardown()
+
+    def _send_locked(self, *parts: str | bytes):
+        out = [f"*{len(parts)}\r\n".encode()]
+        for p in parts:
+            b = p if isinstance(p, bytes) else str(p).encode()
+            out.append(f"${len(b)}\r\n".encode())
+            out.append(b + b"\r\n")
+        self._sock.sendall(b"".join(out))
+        return self._read_reply()
+
+    def command(self, *parts: str | bytes):
+        """Send one command, return the parsed reply.
+
+        A transport failure (dropped connection, timeout — the stream is
+        desynchronized after either) tears the socket down and retries
+        ONCE on a fresh connection; the server must not stay wedged on
+        one redis restart."""
+        with self._lock:
+            try:
+                if self._sock is None:
+                    self._connect()
+                return self._send_locked(*parts)
+            except (OSError, RespError) as e:
+                if isinstance(e, RespError) and \
+                        "connection closed" not in str(e):
+                    raise  # a real -ERR reply, not a transport failure
+                self._teardown()
+                self._connect()
+                return self._send_locked(*parts)
+
+    def _read_reply(self):
+        line = self._f.readline()
+        if not line:
+            raise RespError("connection closed")
+        kind, rest = line[:1], line[1:].rstrip(b"\r\n")
+        if kind == b"+":
+            return rest.decode()
+        if kind == b"-":
+            raise RespError(rest.decode())
+        if kind == b":":
+            return int(rest)
+        if kind == b"$":
+            n = int(rest)
+            if n == -1:
+                return None
+            data = self._f.read(n + 2)
+            return data[:-2]
+        if kind == b"*":
+            n = int(rest)
+            if n == -1:
+                return None
+            return [self._read_reply() for _ in range(n)]
+        raise RespError(f"bad reply type {kind!r}")
+
+
+class FakeRedisServer:
+    """In-process RESP2 server covering the commands the redis filer
+    store issues — the test double standing in for a real redis (this
+    image ships no redis server)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        import socketserver
+
+        self.kv: dict[bytes, bytes] = {}
+        self.sets: dict[bytes, set[bytes]] = {}
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        cmd = self._read_command()
+                    except (ValueError, OSError):
+                        return
+                    if cmd is None:
+                        return
+                    self._dispatch([bytes(c) for c in cmd])
+
+            def _read_command(self):
+                line = self.rfile.readline()
+                if not line:
+                    return None
+                if not line.startswith(b"*"):
+                    raise ValueError("inline commands unsupported")
+                n = int(line[1:])
+                parts = []
+                for _ in range(n):
+                    hdr = self.rfile.readline()
+                    size = int(hdr[1:])
+                    parts.append(self.rfile.read(size + 2)[:-2])
+                return parts
+
+            def _send(self, payload: bytes):
+                self.wfile.write(payload)
+                self.wfile.flush()
+
+            def _bulk(self, b):
+                if b is None:
+                    return self._send(b"$-1\r\n")
+                self._send(f"${len(b)}\r\n".encode() + b + b"\r\n")
+
+            def _dispatch(self, cmd):
+                op = cmd[0].upper()
+                with outer._lock:
+                    if op == b"PING":
+                        return self._send(b"+PONG\r\n")
+                    if op == b"SELECT":
+                        return self._send(b"+OK\r\n")
+                    if op == b"SET":
+                        outer.kv[cmd[1]] = cmd[2]
+                        return self._send(b"+OK\r\n")
+                    if op == b"GET":
+                        return self._bulk(outer.kv.get(cmd[1]))
+                    if op == b"DEL":
+                        n = 0
+                        for k in cmd[1:]:
+                            n += 1 if outer.kv.pop(k, None) is not None else 0
+                            n += 1 if outer.sets.pop(k, None) is not None else 0
+                        return self._send(f":{n}\r\n".encode())
+                    if op == b"SADD":
+                        s = outer.sets.setdefault(cmd[1], set())
+                        added = sum(1 for m in cmd[2:] if m not in s)
+                        s.update(cmd[2:])
+                        return self._send(f":{added}\r\n".encode())
+                    if op == b"SREM":
+                        s = outer.sets.get(cmd[1], set())
+                        removed = sum(1 for m in cmd[2:] if m in s)
+                        s.difference_update(cmd[2:])
+                        return self._send(f":{removed}\r\n".encode())
+                    if op == b"KEYS":
+                        rx = outer._glob_to_regex(cmd[1])
+                        keys = sorted({
+                            k for k in list(outer.kv) + list(outer.sets)
+                            if rx.fullmatch(k)})
+                        out = [f"*{len(keys)}\r\n".encode()]
+                        for k in keys:
+                            out.append(f"${len(k)}\r\n".encode() + k + b"\r\n")
+                        return self._send(b"".join(out))
+                    if op == b"SMEMBERS":
+                        members = sorted(outer.sets.get(cmd[1], set()))
+                        out = [f"*{len(members)}\r\n".encode()]
+                        for m in members:
+                            out.append(f"${len(m)}\r\n".encode() + m + b"\r\n")
+                        return self._send(b"".join(out))
+                    return self._send(b"-ERR unknown command\r\n")
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._lock = threading.Lock()
+        self._server = Server((host, port), Handler)
+        self.port = self._server.server_address[1]
+
+    @staticmethod
+    def _glob_to_regex(pattern: bytes):
+        """Redis KEYS glob -> regex, honoring backslash escapes (which
+        fnmatch lacks): *, ?, [...] and backslash-quoted literals."""
+        import re
+
+        out = []
+        i = 0
+        while i < len(pattern):
+            ch = pattern[i : i + 1]
+            if ch == b"\\" and i + 1 < len(pattern):
+                out.append(re.escape(pattern[i + 1 : i + 2]))
+                i += 2
+                continue
+            if ch == b"*":
+                out.append(b".*")
+            elif ch == b"?":
+                out.append(b".")
+            elif ch == b"[":
+                j = pattern.find(b"]", i + 1)
+                if j == -1:
+                    out.append(re.escape(ch))
+                else:
+                    out.append(pattern[i : j + 1])
+                    i = j
+            else:
+                out.append(re.escape(ch))
+            i += 1
+        return re.compile(b"".join(out), re.DOTALL)
+
+    def start(self) -> None:
+        threading.Thread(target=self._server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -93,6 +93,13 @@ dbFile = "./filer.db"
 # Embedded sorted-file store (pure python SSTable-style).
 enabled = false
 dir = "./filerldb"
+
+[redis]
+# Any RESP2 endpoint (framework-native client, no redis library).
+enabled = false
+host = "127.0.0.1"
+port = 6379
+db = 0
 '''
 
 TEMPLATES = {

@@ -72,16 +72,25 @@ def test_minus_chunks():
 # -- stores -----------------------------------------------------------------
 
 
-@pytest.fixture(params=["memory", "sqlite", "leveldb"])
+@pytest.fixture(params=["memory", "sqlite", "leveldb", "redis"])
 def store(request, tmp_path):
+    fake = None
     if request.param == "sqlite":
         s = make_store("sqlite", path=str(tmp_path / "filer.db"))
     elif request.param == "leveldb":
         s = make_store("leveldb", path=str(tmp_path / "filerldb"))
+    elif request.param == "redis":
+        from seaweedfs_tpu.util.resp import FakeRedisServer
+
+        fake = FakeRedisServer()
+        fake.start()
+        s = make_store("redis", host="127.0.0.1", port=fake.port)
     else:
         s = make_store("memory")
     yield s
     s.close()
+    if fake is not None:
+        fake.stop()
 
 
 def entry(name, is_dir=False, content=b""):
@@ -509,3 +518,42 @@ def test_cipher_round_trip_and_opaque_volume_bytes(tmp_path_factory):
         filer.stop()
         vs.stop()
         master.stop()
+
+
+def test_redis_store_glob_metachar_paths():
+    """Paths containing KEYS glob metacharacters delete exactly their own
+    subtree — no orphans, no collateral deletion of glob-sibling paths."""
+    from seaweedfs_tpu.filer.filerstore import make_store
+    from seaweedfs_tpu.util.resp import FakeRedisServer
+
+    fake = FakeRedisServer()
+    fake.start()
+    try:
+        s = make_store("redis", host="127.0.0.1", port=fake.port)
+        s.insert_entry("/docs[ab]", entry("child.txt"))
+        s.insert_entry("/docs[ab]/deep", entry("g.txt"))
+        s.insert_entry("/docsa", entry("keep.txt"))
+        s.delete_folder_children("/docs[ab]")
+        assert s.find_entry("/docs[ab]", "child.txt") is None
+        assert s.find_entry("/docs[ab]/deep", "g.txt") is None
+        assert s.find_entry("/docsa", "keep.txt") is not None
+        s.close()
+    finally:
+        fake.stop()
+
+
+def test_resp_client_reconnects():
+    """One dropped connection must not wedge the store forever."""
+    from seaweedfs_tpu.util.resp import FakeRedisServer, RespClient
+
+    fake = FakeRedisServer()
+    fake.start()
+    try:
+        c = RespClient("127.0.0.1", fake.port)
+        assert c.command("SET", "k", "v") == "OK"
+        # sever the transport behind the client's back
+        c._sock.close()
+        assert c.command("GET", "k") == b"v"  # reconnected transparently
+        c.close()
+    finally:
+        fake.stop()
