@@ -78,4 +78,8 @@ def make_sequencer(kind: str = "memory", node_id: int = 0):
         return MemorySequencer()
     if kind == "snowflake":
         return SnowflakeSequencer(node_id)
+    if kind == "etcd":
+        raise ValueError(
+            "the etcd sequencer needs an etcd endpoint + client, which "
+            "this deployment does not ship; use memory or snowflake")
     raise ValueError(f"unknown sequencer {kind!r}")

@@ -629,3 +629,45 @@ def fs_configure(env: CommandEnv, args: list[str]) -> str:
                           mime="application/json")
         return rendered + "\napplied."
     return rendered
+
+
+@register("fs.meta.notify")
+def fs_meta_notify(env: CommandEnv, args: list[str]) -> str:
+    """Re-publish every entry under a path as a create event to a
+    notification backend (command_fs_meta_notify.go) — backfills a queue
+    after enabling notifications.  Backend comes from notification.toml
+    ([notification] kind = "file"/"log"/... plus backend options)."""
+    from ..notification.publishers import make_publisher
+    from ..util.config import load_configuration
+
+    _short, opts, pos = _flags(args)
+    # validate the filer + path BEFORE constructing the publisher, so a
+    # failed precondition cannot leak an opened (file) backend
+    client = _filer(env)
+    path = _resolve(env, pos[0] if pos else None)
+    conf = load_configuration("notification")
+    kind = opts.get("backend", conf.get_string("notification.kind", "log"))
+    pub_opts = {}
+    if isinstance(conf.get(f"notification.{kind}"), dict):
+        pub_opts = dict(conf.get(f"notification.{kind}"))
+    if "path" in opts:
+        pub_opts["path"] = opts["path"]
+    if kind == "file" and not pub_opts.get("path"):
+        raise ValueError(
+            "the file backend needs -path <events file> (or a "
+            "[notification.file] path in notification.toml)")
+    publisher = make_publisher(kind, **pub_opts)
+    dirs = files = 0
+    try:
+        for fe in _walk_full_entries(client, path):
+            ev = filer_pb2.EventNotification()
+            ev.new_entry.CopyFrom(fe.entry)
+            publisher.publish(
+                f"{fe.dir.rstrip('/')}/{fe.entry.name}", ev)
+            if fe.entry.is_directory:
+                dirs += 1
+            else:
+                files += 1
+    finally:
+        publisher.close()
+    return f"notified {dirs} directories, {files} files"

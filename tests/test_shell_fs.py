@@ -282,3 +282,19 @@ def test_fs_configure_validation(populated):
     run_command(env, "fs.configure -locationPrefix / -ttl 1h -apply")
     out = run_command(env, "fs.configure -locationPrefix / -delete -apply")
     assert '"locationPrefix": "/"' not in out
+
+
+def test_fs_meta_notify(populated, tmp_path):
+    """fs.meta.notify backfills a notification queue from the namespace
+    (command_fs_meta_notify.go); here into the file backend."""
+    env, _ = populated
+    out_path = tmp_path / "events.jsonl"
+    out = run_command(
+        env, f"fs.meta.notify -backend file -path {out_path} /data")
+    assert "notified" in out and "files" in out
+    from seaweedfs_tpu.notification.publishers import FilePublisher
+
+    events = FilePublisher.read_events(str(out_path))
+    keys = {k for k, _ in events}
+    assert any(k.endswith("/a.txt") for k in keys)
+    assert any(k.endswith("/c.bin") for k in keys)
