@@ -87,6 +87,8 @@ def cmd_volume(args) -> None:
 
 
 def cmd_server(args) -> None:
+    """`weed server`: master + volume, optionally filer and s3 gateway in
+    one process (command/server.go)."""
     from .master.server import MasterServer
     from .util.config import load_configuration
     from .volume.server import VolumeServer
@@ -104,20 +106,42 @@ def cmd_server(args) -> None:
         codec_name=codec,
     )
     v.start()
-    print(f"server: master={args.masterPort} volume={args.port}")
+    extras = []
+    if args.filer or args.s3:
+        from .filer.server import FilerServer
+
+        store, store_path, store_options = _filer_store_selection(
+            args.filerStore)
+        filer = FilerServer(
+            masters=[f"{args.ip}:{m.grpc_port}"],
+            ip=args.ip, port=args.filerPort, store=store,
+            store_path=store_path, store_options=store_options,
+        )
+        filer.start()
+        extras.append(f"filer={args.filerPort}")
+        if args.s3:
+            from .s3api.server import S3ApiServer
+
+            s3 = S3ApiServer(
+                filer=f"{args.ip}:{args.filerPort}", port=args.s3Port,
+                iam_config_filer_path="/etc/iam/identity.json",
+            )
+            s3.start()
+            extras.append(f"s3={args.s3Port}")
+    print(f"server: master={args.masterPort} volume={args.port}"
+          + ("" if not extras else " " + " ".join(extras)))
     _wait()
 
 
-def cmd_filer(args) -> None:
-    from .filer.server import FilerServer
+def _filer_store_selection(flag_store: str) -> tuple[str, str, dict]:
+    """filer.toml picks the store backend; the -store flag (a path)
+    keeps its historical meaning of "sqlite at this path" and wins when
+    given.  -> (store, store_path, store_options)."""
     from .util.config import load_configuration
 
-    # filer.toml picks the store backend; the -store flag (a path) keeps
-    # its historical meaning of "sqlite at this path" and wins when given
-    store, store_path = "sqlite", args.store
-    store_options: dict = {}
+    store, store_path, store_options = "sqlite", flag_store, {}
     fconf = load_configuration("filer")
-    if fconf.loaded and args.store == "./filer.db":  # flag left at default
+    if fconf.loaded and flag_store == "./filer.db":  # flag left at default
         for kind, path_key in (("sqlite", "dbFile"), ("leveldb", "dir"),
                                ("redis", ""), ("memory", "")):
             if fconf.get_bool(f"{kind}.enabled"):
@@ -132,6 +156,13 @@ def cmd_filer(args) -> None:
                 "port": fconf.get_int("redis.port", 6379),
                 "db": fconf.get_int("redis.db", 0),
             }
+    return store, store_path, store_options
+
+
+def cmd_filer(args) -> None:
+    from .filer.server import FilerServer
+
+    store, store_path, store_options = _filer_store_selection(args.store)
 
     f = FilerServer(
         masters=[_grpc_addr(m) for m in args.master.split(",")],
@@ -550,6 +581,13 @@ def main(argv=None) -> None:
     s.add_argument("-masterPort", type=int, default=9333)
     s.add_argument("-port", type=int, default=8080)
     s.add_argument("-ec.codec", dest="ec_codec", default="")
+    s.add_argument("-filer", action="store_true",
+                   help="also start a filer")
+    s.add_argument("-filer.port", dest="filerPort", type=int, default=8888)
+    s.add_argument("-filer.store", dest="filerStore", default="./filer.db")
+    s.add_argument("-s3", action="store_true",
+                   help="also start an S3 gateway (implies -filer)")
+    s.add_argument("-s3.port", dest="s3Port", type=int, default=8333)
     s.set_defaults(fn=cmd_server)
 
     f = sub.add_parser("filer")

@@ -645,6 +645,8 @@ def fs_meta_notify(env: CommandEnv, args: list[str]) -> str:
     # failed precondition cannot leak an opened (file) backend
     client = _filer(env)
     path = _resolve(env, pos[0] if pos else None)
+    if not _is_directory(client, path):
+        raise ValueError(f"not a directory: {path}")
     conf = load_configuration("notification")
     kind = opts.get("backend", conf.get_string("notification.kind", "log"))
     pub_opts = {}
