@@ -67,16 +67,14 @@ def generate_ec_files(
     outs = [open(base_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
     try:
         with open(dat_path, "rb") as f:
-            if hasattr(codec, "encode_device"):
-                _encode_stream_pipelined(
-                    f, dat_size, outs, codec, large_block_size,
-                    small_block_size, slice_size, progress,
-                )
-            else:
-                _encode_stream(
-                    f, dat_size, outs, codec, large_block_size,
-                    small_block_size, slice_size, progress,
-                )
+            # the pipelined path overlaps the prefetch thread's disk
+            # reads with compute for EVERY codec; device codecs
+            # additionally overlap HBM transfer + kernel via the async
+            # dispatch, CPU codecs compute synchronously in dispatch
+            _encode_stream_pipelined(
+                f, dat_size, outs, codec, large_block_size,
+                small_block_size, slice_size, progress,
+            )
     finally:
         for o in outs:
             o.close()
@@ -99,30 +97,11 @@ def _slice_tasks(dat_size: int, large: int, small: int, slice_size: int):
         processed += small * DATA_SHARDS
 
 
-def _encode_stream(
-    f, dat_size, outs, codec, large, small, slice_size, progress=None
-) -> None:
-    done = 0
-    for row_start, block, col, width in _slice_tasks(
-        dat_size, large, small, slice_size
-    ):
-        data = np.empty((DATA_SHARDS, width), dtype=np.uint8)
-        for i in range(DATA_SHARDS):
-            data[i] = _read_at(f, row_start + i * block + col, width)
-        parity = codec.parity_of(data)
-        for i in range(DATA_SHARDS):
-            outs[i].write(data[i].tobytes())
-        for i in range(parity.shape[0]):
-            outs[DATA_SHARDS + i].write(parity[i].tobytes())
-        done += width * DATA_SHARDS
-        if progress is not None:
-            progress(min(done, dat_size))
-
-
 def _encode_stream_pipelined(
     f, dat_size, outs, codec, large, small, slice_size, progress=None
 ) -> None:
-    """Device-codec path: overlap disk reads, HBM transfers, and compute.
+    """Overlap disk reads with compute for every codec; device codecs
+    also overlap HBM transfer + kernel.
 
     Three stages run concurrently (SURVEY §7 hard part (b)):
       * a prefetch thread reads (10, W) stripe slices from the .dat into a
@@ -139,7 +118,9 @@ def _encode_stream_pipelined(
     import queue
     import threading
 
-    import jax.numpy as jnp
+    is_device_codec = hasattr(codec, "encode_device")
+    if is_device_codec:  # host-only codecs need no jax
+        import jax.numpy as jnp
 
     q: queue.Queue = queue.Queue(maxsize=2)
     stop = threading.Event()
@@ -173,15 +154,22 @@ def _encode_stream_pipelined(
     t.start()
 
     # lane-tile geometry for the fully-prepacked path: width must split into
-    # whole (SUBLANES, LANES)-uint32 tiles so the jit sees only the pallas_call
-    try:
-        from ...ops.rs_pallas import LANES, SUBLANES
-        lane_tile_bytes = SUBLANES * LANES * 4
-    except ImportError:
-        lane_tile_bytes = 0  # no pallas — 3d path never taken
+    # whole (SUBLANES, LANES)-uint32 tiles so the jit sees only the
+    # pallas_call.  Gated: this import pulls in jax, which host-only
+    # encodes must not pay for.
+    lane_tile_bytes = 0
+    if is_device_codec:
+        try:
+            from ...ops.rs_pallas import LANES, SUBLANES
+            lane_tile_bytes = SUBLANES * LANES * 4
+        except ImportError:
+            pass  # no pallas — 3d path never taken
 
     def dispatch(data: np.ndarray):
-        """-> (device parity future, packed?) — async on the device."""
+        """-> (device parity future, packed?) — async on the device;
+        synchronous parity for host-only codecs."""
+        if not is_device_codec:
+            return codec.parity_of(data), False
         width = data.shape[1]
         if (
             lane_tile_bytes
@@ -222,6 +210,12 @@ def _encode_stream_pipelined(
                 raise item
             if item is None:
                 break
+            if not is_device_codec:
+                # synchronous codec: nothing is in flight to overlap, so
+                # drain immediately — holding a `pending` slice would
+                # only inflate peak memory
+                drain((item, *dispatch(item)))
+                continue
             parity_dev, packed = dispatch(item)
             if pending is not None:
                 drain(pending)
