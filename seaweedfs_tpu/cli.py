@@ -261,6 +261,17 @@ def cmd_filer_meta_tail(args) -> None:
         print(f"{resp.ts_ns} {kind} {resp.directory}/{name}")
 
 
+def cmd_filer_meta_backup(args) -> None:
+    """Continuously back up filer metadata into a local store
+    (command/filer_meta_backup.go)."""
+    from .replication.meta_backup import MetaBackup
+
+    store, store_path, store_options = _filer_store_selection(args.store)
+    mb = MetaBackup.with_store(args.filer, store, store_path,
+                               filer_dir=args.filerDir, **store_options)
+    mb.run(restart=args.restart)
+
+
 def cmd_filer_sync(args) -> None:
     """Bidirectional sync between two filers.  Both directions share one
     sync signature: every replayed mutation carries it, and each side's
@@ -567,7 +578,7 @@ def main(argv=None) -> None:
                         "32GB (index files are NOT compatible with the "
                         "default 4-byte layout)")
     v.add_argument("-ec.codec", dest="ec_codec", default="",
-                   choices=["cpu", "tpu", "tpu_xor", "tpu_mxu"])
+                   choices=["auto", "cpu", "tpu", "tpu_xor", "tpu_mxu"])
     v.add_argument("-metricsPort", type=int, default=0)
     v.add_argument("-jwtKey", default="")
     v.add_argument("-whiteList", default="")
@@ -645,6 +656,17 @@ def main(argv=None) -> None:
     fmt.add_argument("-filer", default="127.0.0.1:8888")
     fmt.add_argument("-pathPrefix", default="/")
     fmt.set_defaults(fn=cmd_filer_meta_tail)
+
+    fmb = sub.add_parser("filer.meta.backup")
+    fmb.add_argument("-filer", default="127.0.0.1:8888")
+    fmb.add_argument("-filerDir", default="/",
+                     help="only back up this folder of the filer")
+    fmb.add_argument("-restart", action="store_true",
+                     help="copy the full metadata before the async "
+                          "incremental backup")
+    fmb.add_argument("-store", default="./meta_backup.db",
+                     help="backup sqlite db path")
+    fmb.set_defaults(fn=cmd_filer_meta_backup)
 
     fsy = sub.add_parser("filer.sync")
     fsy.add_argument("-a", required=True, help="filer A ip:port")

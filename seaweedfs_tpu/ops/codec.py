@@ -26,15 +26,60 @@ def available_codecs() -> list[str]:
     """Canonical codec names usable with ``get_codec`` on this host."""
     import importlib.util
 
-    names = ["cpu"]
+    names = ["auto", "cpu"]
     if importlib.util.find_spec("jax") is None:
         return names
     return names + ["tpu", "tpu_xor", "tpu_mxu"]
 
 
+_AUTO_CHOICE: list[str] = []
+
+
+def _resolve_auto(probe_mb: int = 4) -> str:
+    """Pick the codec that will win the disk->shards pipeline on THIS host.
+
+    The encode pipeline moves every input byte host->device and 0.4x back;
+    on a pod host that link is PCIe/ICI (GB/s — device wins), behind a
+    dev tunnel it can be single-digit MB/s (host SIMD wins).  So the probe
+    times one real encode round trip (transfer in + kernel + transfer out)
+    against the C++ SIMD codec on the same block, and the result is cached
+    for the process lifetime.
+    """
+    import importlib.util
+    import time as _time
+
+    if importlib.util.find_spec("jax") is None:
+        return "cpu"
+    import numpy as np
+
+    block = np.zeros((DATA_SHARDS, probe_mb << 20), dtype=np.uint8)
+    cpu = ReedSolomon(DATA_SHARDS, PARITY_SHARDS)
+    cpu.parity_of(block)  # warm
+    t0 = _time.perf_counter()
+    cpu.parity_of(block)
+    cpu_dt = _time.perf_counter() - t0
+    try:
+        import jax.numpy as jnp
+
+        from .rs_jax import ReedSolomonTPU
+
+        tpu = ReedSolomonTPU(DATA_SHARDS, PARITY_SHARDS, impl="pallas")
+        np.asarray(tpu.encode_device(jnp.asarray(block)))  # warm + compile
+        t0 = _time.perf_counter()
+        np.asarray(tpu.encode_device(jnp.asarray(block)))
+        tpu_dt = _time.perf_counter() - t0
+    except Exception:  # no device / backend init refused -> host codec
+        return "cpu"
+    return "tpu" if tpu_dt < cpu_dt else "cpu"
+
+
 def get_codec(name: str = "cpu", data_shards: int = DATA_SHARDS,
               parity_shards: int = PARITY_SHARDS):
     """Return a codec with encode/reconstruct/reconstruct_data/verify."""
+    if name == "auto":
+        if not _AUTO_CHOICE:
+            _AUTO_CHOICE.append(_resolve_auto())
+        name = _AUTO_CHOICE[0]
     if name in ("cpu", "go", "numpy"):
         return ReedSolomon(data_shards, parity_shards)
     if name in ("tpu", "pallas", "tpu_pallas"):

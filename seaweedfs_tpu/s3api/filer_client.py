@@ -77,6 +77,29 @@ class FilerClient:
                 return []
             raise FilerUnavailable(f"filer list failed: {e.code()}")
 
+    def iter_entries(self, directory: str, prefix: str = "",
+                     page: int = 1024):
+        """Yield every entry of one directory, paging through ListEntries."""
+        start, inclusive = "", False
+        while True:
+            batch = self.list_entries(directory, prefix=prefix,
+                                      start_from=start, inclusive=inclusive,
+                                      limit=page)
+            yield from batch
+            if len(batch) < page:
+                return
+            start, inclusive = batch[-1].name, False
+
+    def walk(self, directory: str):
+        """Yield (directory, entry) for the whole subtree, breadth-first."""
+        queue = [directory.rstrip("/") or "/"]
+        while queue:
+            d = queue.pop(0)
+            for entry in self.iter_entries(d):
+                yield d, entry
+                if entry.is_directory:
+                    queue.append((d.rstrip("/") or "") + "/" + entry.name)
+
     def create_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
         resp = self.stub().CreateEntry(
             filer_pb2.CreateEntryRequest(directory=directory, entry=entry)

@@ -80,17 +80,8 @@ def _is_directory(client: FilerClient, path: str) -> bool:
 
 
 def _iter_dir(client: FilerClient, directory: str, prefix: str = ""):
-    """Yield every entry of a directory, paging through ListEntries."""
-    start, inclusive = "", False
-    while True:
-        batch = client.list_entries(
-            directory, prefix=prefix, start_from=start,
-            inclusive=inclusive, limit=1024,
-        )
-        yield from batch
-        if len(batch) < 1024:
-            return
-        start, inclusive = batch[-1].name, False
+    """Yield every entry of a directory (FilerClient.iter_entries)."""
+    yield from client.iter_entries(directory, prefix=prefix)
 
 
 def _select(client: FilerClient, path: str):

@@ -88,6 +88,31 @@ def test_encode_validate_synthetic(synthetic_base):
         assert os.path.getsize(synthetic_base + ecc.to_ext(i)) == expect
 
 
+def test_batched_slices_byte_identical(synthetic_base):
+    """Multi-row codec batches (slice >> small block) must produce the
+    exact bytes of the one-segment-at-a-time path — parity is columnwise,
+    so batching is pure data layout."""
+    _encode_dir(synthetic_base)  # slice_size=50: every call one segment
+    small_slices = {}
+    for i in range(ecc.TOTAL_SHARDS):
+        p = synthetic_base + ecc.to_ext(i)
+        small_slices[i] = open(p, "rb").read()
+        os.remove(p)
+    generate_ec_files(synthetic_base, large_block_size=LARGE,
+                      small_block_size=SMALL, codec_name="cpu",
+                      slice_size=1 << 20)  # whole volume in one batch
+    for i in range(ecc.TOTAL_SHARDS):
+        batched = open(synthetic_base + ecc.to_ext(i), "rb").read()
+        assert batched == small_slices[i], f"shard {i} differs when batched"
+
+
+def test_auto_codec_resolves():
+    codec = get_codec("auto")
+    data = np.arange(10 * 64, dtype=np.uint8).reshape(10, 64)
+    ref = get_codec("cpu").parity_of(data)
+    assert np.array_equal(np.asarray(codec.parity_of(data)), np.asarray(ref))
+
+
 def test_tpu_and_cpu_shards_identical(synthetic_base):
     _encode_dir(synthetic_base, codec="cpu")
     cpu_shards = {}

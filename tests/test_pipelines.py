@@ -337,3 +337,37 @@ def test_filer_sync_no_loop(pipeline_cluster, tmp_path_factory):
         stop.set()
     finally:
         filer_b.stop()
+
+
+def test_meta_backup_traverse_and_stream(pipeline_cluster, tmp_path):
+    """filer.meta.backup: full BFS copy, then live events applied to the
+    backup store, resume offset persisted (command/filer_meta_backup.go)."""
+    from seaweedfs_tpu.replication.meta_backup import MetaBackup
+
+    _master, _vs, filer, _broker, _notify = pipeline_cluster
+    _put(filer.port, "/mb/a.txt", b"alpha")
+    _put(filer.port, "/mb/sub/b.txt", b"beta")
+
+    mb = MetaBackup.with_store(
+        f"127.0.0.1:{filer.port}", "sqlite",
+        str(tmp_path / "backup.db"), filer_dir="/mb")
+    assert mb.get_offset() is None
+    copied = mb.traverse()
+    assert copied >= 3  # a.txt, sub, sub/b.txt
+    assert mb.store.find_entry("/mb", "a.txt") is not None
+    assert mb.store.find_entry("/mb/sub", "b.txt") is not None
+    mb.set_offset(time.time_ns())
+
+    stop = threading.Event()
+    t = threading.Thread(target=lambda: mb.stream(stop), daemon=True)
+    t.start()
+    time.sleep(0.3)
+    _put(filer.port, "/mb/c.txt", b"gamma")
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if mb.store.find_entry("/mb", "c.txt") is not None:
+            break
+        time.sleep(0.05)
+    assert mb.store.find_entry("/mb", "c.txt") is not None
+    assert mb.get_offset() is not None and mb.get_offset() > 0
+    stop.set()
