@@ -143,7 +143,9 @@ def _filer_store_selection(flag_store: str) -> tuple[str, str, dict]:
     fconf = load_configuration("filer")
     if fconf.loaded and flag_store == "./filer.db":  # flag left at default
         for kind, path_key in (("sqlite", "dbFile"), ("leveldb", "dir"),
-                               ("redis", ""), ("memory", "")):
+                               ("leveldb2", "dir"), ("redis", ""),
+                               ("mysql", ""), ("postgres", ""),
+                               ("memory", "")):
             if fconf.get_bool(f"{kind}.enabled"):
                 store = kind
                 if path_key:
@@ -155,6 +157,19 @@ def _filer_store_selection(flag_store: str) -> tuple[str, str, dict]:
                 "host": fconf.get_string("redis.host", "127.0.0.1"),
                 "port": fconf.get_int("redis.port", 6379),
                 "db": fconf.get_int("redis.db", 0),
+            }
+        elif store in ("mysql", "postgres"):
+            port_default = {"mysql": 3306, "postgres": 5432}[store]
+            user_default = {"mysql": "root", "postgres": "postgres"}[store]
+            store_options = {
+                "hostname": fconf.get_string(f"{store}.hostname",
+                                             "localhost"),
+                "port": fconf.get_int(f"{store}.port", port_default),
+                "username": fconf.get_string(f"{store}.username",
+                                             user_default),
+                "password": fconf.get_string(f"{store}.password", ""),
+                "database": fconf.get_string(f"{store}.database",
+                                             "seaweedfs"),
             }
     return store, store_path, store_options
 

@@ -1,16 +1,20 @@
 """Embedded FilerStore backends; importing registers them.
 
 Reference analogue: weed/filer/<backend>/ dirs registered via blank-import
-init() (weed/server/filer_server.go:23-36).  This build ships four
-classes: in-memory (tests), sqlite (single-file, transactional,
-ordered listing — the abstract_sql class), leveldb (bitcask-style
-log+snapshot store covering the reference's embedded-leveldb default),
-and redis (any RESP2 endpoint via the framework's own client).
+init() (weed/server/filer_server.go:23-36).  This build ships: in-memory
+(tests), sqlite (single-file, transactional, ordered listing), leveldb
+(bitcask-style log+snapshot store covering the reference's
+embedded-leveldb default), leveldb2 (the same, md5-partitioned 8 ways),
+redis (any RESP2 endpoint via the framework's own client), and the
+abstract_sql class with mysql / postgres kinds (DB-API drivers load
+lazily; absent drivers raise a loud ConfigurationError).
 """
 
 from . import (  # noqa: F401
+    leveldb2_store,
     leveldb_store,
     memory_store,
     redis_store,
+    sql_store,
     sqlite_store,
 )

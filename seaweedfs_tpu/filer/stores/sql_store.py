@@ -1,0 +1,280 @@
+"""Generic SQL FilerStore over any DB-API 2.0 driver — the abstract_sql
+class, plus its mysql and postgres kinds.
+
+Reference: weed/filer/abstract_sql/abstract_sql_store.go (one shared SQL
+implementation) specialised by weed/filer/mysql/ and weed/filer/postgres/
+(dialect: placeholder style + upsert clause).  The schema matches the
+scaffold's `filemeta(dirhash BIGINT, name, directory, meta)` with the
+md5-prefix directory hash of util.HashStringToLong (weed/util/bytes.go:73)
+leading the primary key, so lookups and listings hit one (dirhash, name)
+index range regardless of directory-string length.
+
+The mysql / postgres kinds import their client library lazily and raise a
+loud ConfigurationError when it is absent (this image ships neither); the
+shared SQL layer itself is fully exercised in tests through the stdlib
+sqlite3 driver, which is DB-API 2.0 like the others.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Iterator
+
+from ...pb import filer_pb2
+from ..filerstore import FilerStore, register_store
+
+
+class ConfigurationError(RuntimeError):
+    pass
+
+
+def hash_string_to_long(directory: str) -> int:
+    """First 8 md5 bytes, big-endian, as a SIGNED 64-bit int
+    (util.HashStringToLong, weed/util/bytes.go:73)."""
+    b = hashlib.md5(directory.encode()).digest()
+    return int.from_bytes(b[:8], "big", signed=True)
+
+
+def _like_escape(s: str) -> str:
+    return (s.replace("\\", "\\\\").replace("%", r"\%").replace("_", r"\_"))
+
+
+class Dialect:
+    """What actually differs between SQL backends."""
+
+    paramstyle = "?"  # sqlite; mysql/postgres use %s
+    upsert_suffix = ""  # appended to the INSERT for insert-or-replace
+    insert_verb = "INSERT OR REPLACE"
+    blob_type = "BLOB"
+    like_escape_clause = " ESCAPE '\\'"
+
+    def placeholders(self, n: int) -> list[str]:
+        return [self.paramstyle] * n
+
+
+class SqliteDialect(Dialect):
+    pass
+
+
+class MysqlDialect(Dialect):
+    paramstyle = "%s"
+    insert_verb = "INSERT"
+    upsert_suffix = " ON DUPLICATE KEY UPDATE meta=VALUES(meta)"
+    blob_type = "LONGBLOB"
+    like_escape_clause = ""  # backslash is mysql's default escape
+
+
+class PostgresDialect(Dialect):
+    paramstyle = "%s"
+    insert_verb = "INSERT"
+    upsert_suffix = (
+        " ON CONFLICT (dirhash, name) DO UPDATE SET meta=EXCLUDED.meta"
+    )
+    blob_type = "BYTEA"
+
+
+class AbstractSqlStore(FilerStore):
+    """The shared SQL implementation; a kind supplies (connection, dialect)."""
+
+    name = "sql"
+
+    def __init__(self, conn, dialect: Dialect):
+        self._conn = conn
+        self._d = dialect
+        self._lock = threading.RLock()
+        self._in_tx = False
+        p = dialect.paramstyle
+        self._sql_insert = (
+            f"{dialect.insert_verb} INTO filemeta "
+            f"(dirhash, name, directory, meta) VALUES ({p}, {p}, {p}, {p})"
+            f"{dialect.upsert_suffix}"
+        )
+        self._sql_find = (
+            f"SELECT meta FROM filemeta WHERE dirhash={p} AND name={p}"
+        )
+        self._sql_delete = (
+            f"DELETE FROM filemeta WHERE dirhash={p} AND name={p}"
+        )
+        self._sql_delete_tree = (
+            f"DELETE FROM filemeta WHERE directory={p} OR directory LIKE {p}"
+            f"{dialect.like_escape_clause}"
+        )
+        self._sql_kv_get = f"SELECT v FROM filer_kv WHERE k={p}"
+        self._sql_kv_del = f"DELETE FROM filer_kv WHERE k={p}"
+        self._sql_kv_put = (
+            f"{dialect.insert_verb} INTO filer_kv (k, v) VALUES ({p}, {p})"
+            + (dialect.upsert_suffix
+               .replace("(dirhash, name)", "(k)")
+               .replace("meta", "v"))
+        )
+        self._ensure_schema()
+
+    def _ensure_schema(self) -> None:
+        blob = self._d.blob_type
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(
+                "CREATE TABLE IF NOT EXISTS filemeta ("
+                " dirhash BIGINT NOT NULL,"
+                " name VARCHAR(766) NOT NULL,"
+                " directory TEXT NOT NULL,"
+                f" meta {blob} NOT NULL,"
+                " PRIMARY KEY (dirhash, name))"
+            )
+            cur.execute(
+                "CREATE TABLE IF NOT EXISTS filer_kv ("
+                f" k VARCHAR(766) NOT NULL PRIMARY KEY, v {blob} NOT NULL)"
+            )
+            self._conn.commit()
+
+    def _commit(self) -> None:
+        if not self._in_tx:
+            self._conn.commit()
+
+    # -- entries -----------------------------------------------------------
+
+    def insert_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
+        with self._lock:
+            self._conn.cursor().execute(
+                self._sql_insert,
+                (hash_string_to_long(directory), entry.name, directory,
+                 entry.SerializeToString()),
+            )
+            self._commit()
+
+    update_entry = insert_entry
+
+    def find_entry(self, directory: str, name: str) -> filer_pb2.Entry | None:
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(self._sql_find,
+                        (hash_string_to_long(directory), name))
+            row = cur.fetchone()
+        if row is None:
+            return None
+        return filer_pb2.Entry.FromString(bytes(row[0]))
+
+    def delete_entry(self, directory: str, name: str) -> None:
+        with self._lock:
+            self._conn.cursor().execute(
+                self._sql_delete, (hash_string_to_long(directory), name))
+            self._commit()
+
+    def delete_folder_children(self, directory: str) -> None:
+        prefix = directory.rstrip("/") + "/"
+        with self._lock:
+            self._conn.cursor().execute(
+                self._sql_delete_tree,
+                (directory, _like_escape(prefix) + "%"))
+            self._commit()
+
+    def list_entries(
+        self,
+        directory: str,
+        start_from: str = "",
+        inclusive: bool = False,
+        prefix: str = "",
+        limit: int = 1024,
+    ) -> Iterator[filer_pb2.Entry]:
+        p = self._d.paramstyle
+        op = ">=" if inclusive else ">"
+        sql = (f"SELECT meta FROM filemeta WHERE dirhash={p} "
+               f"AND name {op} {p} ")
+        params: list = [hash_string_to_long(directory), start_from]
+        if prefix:
+            sql += f"AND name LIKE {p}{self._d.like_escape_clause} "
+            params.append(_like_escape(prefix) + "%")
+        sql += f"ORDER BY name LIMIT {p}"
+        params.append(limit)
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(sql, params)
+            rows = cur.fetchall()
+        for (meta,) in rows:
+            yield filer_pb2.Entry.FromString(bytes(meta))
+
+    # -- kv ----------------------------------------------------------------
+
+    def kv_get(self, key: bytes) -> bytes | None:
+        with self._lock:
+            cur = self._conn.cursor()
+            cur.execute(self._sql_kv_get, (key.decode("latin-1"),))
+            row = cur.fetchone()
+        return bytes(row[0]) if row else None
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            cur = self._conn.cursor()
+            if value:
+                cur.execute(self._sql_kv_put,
+                            (key.decode("latin-1"), value))
+            else:
+                cur.execute(self._sql_kv_del, (key.decode("latin-1"),))
+            self._commit()
+
+    # -- transactions -------------------------------------------------------
+
+    def begin(self) -> None:
+        self._in_tx = True
+
+    def commit(self) -> None:
+        with self._lock:
+            self._conn.commit()
+        self._in_tx = False
+
+    def rollback(self) -> None:
+        with self._lock:
+            self._conn.rollback()
+        self._in_tx = False
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+@register_store("mysql")
+class MysqlStore(AbstractSqlStore):
+    """filer store over a MySQL server (weed/filer/mysql/)."""
+
+    name = "mysql"
+
+    def __init__(self, hostname: str = "localhost", port: int = 3306,
+                 username: str = "root", password: str = "",
+                 database: str = "seaweedfs", **_):
+        try:
+            import pymysql  # type: ignore[import-not-found]
+        except ImportError:
+            try:
+                import MySQLdb as pymysql  # type: ignore[import-not-found]
+            except ImportError:
+                raise ConfigurationError(
+                    "filer store 'mysql' needs the pymysql or mysqlclient "
+                    "package, which this image does not ship; the SQL "
+                    "layer itself is the tested abstract_sql class"
+                ) from None
+        conn = pymysql.connect(host=hostname, port=port, user=username,
+                               password=password, database=database)
+        super().__init__(conn, MysqlDialect())
+
+
+@register_store("postgres")
+class PostgresStore(AbstractSqlStore):
+    """filer store over a PostgreSQL server (weed/filer/postgres/)."""
+
+    name = "postgres"
+
+    def __init__(self, hostname: str = "localhost", port: int = 5432,
+                 username: str = "postgres", password: str = "",
+                 database: str = "seaweedfs", **_):
+        try:
+            import psycopg2  # type: ignore[import-not-found]
+        except ImportError:
+            raise ConfigurationError(
+                "filer store 'postgres' needs the psycopg2 package, which "
+                "this image does not ship; the SQL layer itself is the "
+                "tested abstract_sql class"
+            ) from None
+        conn = psycopg2.connect(host=hostname, port=port, user=username,
+                                password=password, dbname=database)
+        super().__init__(conn, PostgresDialect())

@@ -146,7 +146,7 @@ def make_apply_pallas(
         return out[:, :w] if padded != w else out
 
     @jax.jit
-    def apply(data: jax.Array) -> jax.Array:
+    def _apply_u8(data: jax.Array) -> jax.Array:
         """(S, B) uint8 -> (n_out, B) uint8 (device-side repack for odd B)."""
         assert data.shape[0] == s, (data.shape, s)
         b = data.shape[1]
@@ -162,6 +162,22 @@ def make_apply_pallas(
             out32.reshape(n_out, padded // word_bytes, LANES), jnp.uint8
         ).reshape(n_out, padded)
         return out[:, :b] if padded != b else out
+
+    # the u8<->u32 bitcast prologue crashes this platform's compile helper
+    # above ~16MB per shard (the raw pallas_call itself is fine at any
+    # size), so the uint8 entry chunks wide inputs outside jit and
+    # concatenates — each chunk is word-aligned so only the tail repads
+    _U8_CHUNK = 16 << 20
+
+    def apply(data: jax.Array) -> jax.Array:
+        b = data.shape[1]
+        if b <= _U8_CHUNK:
+            return _apply_u8(data)
+        outs = [
+            _apply_u8(data[:, off:off + _U8_CHUNK])
+            for off in range(0, b, _U8_CHUNK)
+        ]
+        return jnp.concatenate(outs, axis=1)
 
     @jax.jit
     def apply32_3d(d3: jax.Array) -> jax.Array:

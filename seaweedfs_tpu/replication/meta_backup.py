@@ -17,10 +17,11 @@ from __future__ import annotations
 
 import time
 
+import grpc
+
 from ..filer.filerstore import make_store
 from ..pb import filer_pb2
 from ..s3api.filer_client import FilerClient
-from .source import subscribe_metadata
 
 OFFSET_KEY = b"metaBackup"
 
@@ -100,13 +101,25 @@ class MetaBackup:
         apply is idempotent (insert-or-replace / delete-if-present).
         `stop` (an Event-like with is_set) makes the loop exit for tests.
         """
+        from ..pb import rpc as rpclib
+
         since = self.get_offset() or 0
         last_ns = 0
         last_save = time.monotonic()
+        host, _, port = self.filer_http.partition(":")
+        stub = rpclib.filer_stub(f"{host}:{int(port) + 10000}")
+        # keep the streaming call handle: cancel() is the only way to
+        # interrupt an IDLE subscription (no events -> the iterator never
+        # returns control, so a stop flag alone could not be observed)
+        self._call = stub.SubscribeMetadata(
+            filer_pb2.SubscribeMetadataRequest(
+                client_name="meta.backup",
+                path_prefix=self.filer_dir,
+                since_ns=since,
+            )
+        )
         try:
-            for resp in subscribe_metadata(self.filer_http, self.filer_dir,
-                                           since_ns=since,
-                                           client_name="meta.backup"):
+            for resp in self._call:
                 self.apply_event(resp)
                 last_ns = resp.ts_ns
                 now = time.monotonic()
@@ -115,9 +128,22 @@ class MetaBackup:
                     last_save = now
                 if stop is not None and stop.is_set():
                     return
+        except grpc.RpcError as e:
+            if e.code() != grpc.StatusCode.CANCELLED:  # cancel() = clean stop
+                raise
         finally:
+            self.cancel()
             if last_ns:
                 self.set_offset(last_ns)
+
+    def cancel(self) -> None:
+        """Tear down the in-flight subscription (safe to call anytime)."""
+        call = getattr(self, "_call", None)
+        if call is not None:
+            try:
+                call.cancel()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
 
     def run(self, restart: bool = False) -> None:
         """The CLI entry loop (runFilerMetaBackup)."""

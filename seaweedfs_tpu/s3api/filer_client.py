@@ -92,9 +92,11 @@ class FilerClient:
 
     def walk(self, directory: str):
         """Yield (directory, entry) for the whole subtree, breadth-first."""
-        queue = [directory.rstrip("/") or "/"]
+        from collections import deque
+
+        queue = deque([directory.rstrip("/") or "/"])
         while queue:
-            d = queue.pop(0)
+            d = queue.popleft()
             for entry in self.iter_entries(d):
                 yield d, entry
                 if entry.is_directory:

@@ -94,12 +94,35 @@ dbFile = "./filer.db"
 enabled = false
 dir = "./filerldb"
 
+[leveldb2]
+# Same store, md5-hash-partitioned into 8 instances (dir/00..07).
+enabled = false
+dir = "./filerldb2"
+
 [redis]
 # Any RESP2 endpoint (framework-native client, no redis library).
 enabled = false
 host = "127.0.0.1"
 port = 6379
 db = 0
+
+[mysql]
+# Needs the pymysql (or mysqlclient) driver installed.
+enabled = false
+hostname = "localhost"
+port = 3306
+username = "root"
+password = ""
+database = "seaweedfs"
+
+[postgres]
+# Needs the psycopg2 driver installed.
+enabled = false
+hostname = "localhost"
+port = 5432
+username = "postgres"
+password = ""
+database = "seaweedfs"
 '''
 
 TEMPLATES = {

@@ -371,3 +371,6 @@ def test_meta_backup_traverse_and_stream(pipeline_cluster, tmp_path):
     assert mb.store.find_entry("/mb", "c.txt") is not None
     assert mb.get_offset() is not None and mb.get_offset() > 0
     stop.set()
+    mb.cancel()  # interrupt the idle subscription; thread exits cleanly
+    t.join(timeout=10)
+    assert not t.is_alive()
