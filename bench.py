@@ -111,7 +111,12 @@ def _e2e_rates(volume_mb: int | None = None, slice_mb: int = 8,
     )
 
     if volume_mb is None:
-        volume_mb = int(os.environ.get("SEAWEEDFS_TPU_BENCH_E2E_MB", "256"))
+        # host codecs sustain ~0.35 GB/s through this pipeline, so a 1GB
+        # volume keeps the stage under ~15s while exercising 100 small-row
+        # stripes; the device codec stays at 256MB because the tunnel
+        # transport (~10 MB/s) makes anything larger a timeout risk
+        default = "256" if codec_name != "cpu" else "1024"
+        volume_mb = int(os.environ.get("SEAWEEDFS_TPU_BENCH_E2E_MB", default))
     slice_bytes = slice_mb << 20
     dat_size = max(64, volume_mb) << 20
     result = {"impl": codec_name, "e2e_bytes": dat_size}
@@ -143,16 +148,25 @@ def _e2e_rates(volume_mb: int | None = None, slice_mb: int = 8,
         # content doesn't affect GF timing: tile one random block
         rng = np.random.default_rng(7)
         block = rng.integers(0, 256, 32 << 20, dtype=np.uint8).tobytes()
+        # the timed write+sync of the .dat doubles as the raw-disk write
+        # baseline: encode writes 1.4x the volume, so an e2e rate near
+        # disk_write_GBps/1.4 means the pipeline runs at the disk's write
+        # bandwidth and the codec is fully hidden behind I/O.  Syncing
+        # here also keeps the timed encode from competing with its own
+        # input's writeback (the read side stays page-cache warm — the
+        # "warm volume" of BASELINE config 2).
+        t0 = time.perf_counter()
         with open(base + ".dat", "wb") as f:
             left = dat_size
             while left > 0:
                 n = min(len(block), left)
                 f.write(block[:n])
                 left -= n
-        # flush the dat's dirty pages NOW so the timed encode doesn't
-        # compete with its own input's writeback (the read side stays
-        # page-cache warm — the "warm volume" of BASELINE config 2)
-        os.sync()
+            f.flush()
+            os.fsync(f.fileno())  # time THIS file's writeback only
+        result["disk_write_GBps"] = round(
+            dat_size / (time.perf_counter() - t0) / 1e9, 3)
+        os.sync()  # untimed: clear any other dirty pages before the encode
 
         last_emit = time.perf_counter()
 
@@ -207,6 +221,86 @@ def _e2e_rates(volume_mb: int | None = None, slice_mb: int = 8,
             if k.endswith("_partial_bytes"):
                 del result[k]
         return result
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _degraded_read_rate(n_needles: int = 600, needle_kb: int = 64,
+                        concurrency: int = 16, lose: int = 4,
+                        duration_s: float = 4.0) -> dict:
+    """BASELINE config 5: streaming EC reads reconstructing needles from
+    10-of-14 shards under concurrent load (the reference drives this with
+    `weed benchmark` against a degraded volume; here the same read path —
+    EcVolume.read_needle -> interval reconstruct on the CPU codec, as
+    per-needle reads must never pay device dispatch — runs in-process
+    with the reference benchmark's c=16).
+
+    Loses the 4 FIRST data shards, so every needle whose intervals land in
+    shards 0-3 pays a full decode-matrix reconstruction from the 10
+    survivors; needles on surviving shards measure the undegraded path.
+    Reports needles/s and payload GB/s over a fixed wall budget.
+    """
+    import os
+    import shutil
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.storage.ec.constants import to_ext
+    from seaweedfs_tpu.storage.ec.encoder import (
+        generate_ec_files,
+        write_sorted_file_from_idx,
+    )
+    from seaweedfs_tpu.storage.ec.volume import EcVolume
+    from seaweedfs_tpu.storage.needle import FLAG_HAS_NAME, Needle
+    from seaweedfs_tpu.storage.super_block import SuperBlock
+    from seaweedfs_tpu.storage.volume import Volume
+
+    rng = np.random.default_rng(11)
+    tmp = tempfile.mkdtemp(prefix="swfs-degraded-")
+    try:
+        vol = Volume(tmp, "", 1, super_block=SuperBlock())
+        payload = needle_kb << 10
+        for i in range(1, n_needles + 1):
+            n = Needle(cookie=int(rng.integers(0, 2**32)), id=i,
+                       data=rng.integers(0, 256, payload)
+                       .astype(np.uint8).tobytes())
+            n.set(FLAG_HAS_NAME)
+            n.name = f"bench-{i}.bin".encode()
+            vol.append_needle(n)
+        base = vol.file_name()
+        vol.close()
+        generate_ec_files(base, codec_name="cpu")
+        write_sorted_file_from_idx(base)
+        for sid in range(lose):
+            os.remove(base + to_ext(sid))
+
+        ev = EcVolume(base, volume_id=1)
+        stop_at = time.perf_counter() + duration_s
+        t0 = time.perf_counter()
+
+        def worker(seed: int) -> tuple[int, int]:
+            r = np.random.default_rng(seed)
+            reads = bytes_read = 0
+            while time.perf_counter() < stop_at:
+                nid = int(r.integers(1, n_needles + 1))
+                needle = ev.read_needle(nid)
+                assert needle.id == nid
+                reads += 1
+                bytes_read += len(needle.data)
+            return reads, bytes_read
+
+        with ThreadPoolExecutor(max_workers=concurrency) as pool:
+            results = list(pool.map(worker, range(concurrency)))
+        dt = time.perf_counter() - t0
+        ev.close()
+        reads = sum(r for r, _ in results)
+        payload_bytes = sum(b for _, b in results)
+        return {
+            "degraded_reads_per_s": round(reads / dt, 1),
+            "degraded_read_GBps": round(payload_bytes / dt / 1e9, 4),
+            "degraded_concurrency": concurrency,
+            "degraded_lost_shards": lose,
+        }
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -303,6 +397,12 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
         return
+    if "--degraded-only" in sys.argv:
+        try:
+            print(json.dumps(_degraded_read_rate()))
+        except Exception as exc:  # noqa: BLE001
+            print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
+        return
     if "--kernel-only" in sys.argv:
         try:
             print(json.dumps(_tpu_pallas_rate()))
@@ -379,6 +479,12 @@ def main() -> None:
                 out[k] = v
     else:
         out["e2e_error"] = (e2e.get("error") or "unknown")[:300]
+    # BASELINE config 5: concurrent degraded reads (pure host path, no
+    # device dispatch — cheap and deterministic, so no subprocess guard)
+    try:
+        out.update(_degraded_read_rate())
+    except Exception as exc:  # noqa: BLE001
+        out["degraded_error"] = f"{type(exc).__name__}: {exc}"[:300]
     print(json.dumps(out))
 
 

@@ -46,16 +46,20 @@ class MasterGrpcService:
         try:
             for hb in request_iterator:
                 if node is None:
-                    node = self.topo.register_node(
-                        DataNode(
-                            id=f"{hb.ip}:{hb.port}",
-                            public_url=hb.public_url or f"{hb.ip}:{hb.port}",
-                            grpc_address=f"{hb.ip}:{hb.port + 10000}",
-                            data_center=hb.data_center or "DefaultDataCenter",
-                            rack=hb.rack or "DefaultRack",
-                            max_volumes=sum(hb.max_volume_counts.values()) or 7,
-                        )
+                    node = DataNode(
+                        id=f"{hb.ip}:{hb.port}",
+                        public_url=hb.public_url or f"{hb.ip}:{hb.port}",
+                        grpc_address=f"{hb.ip}:{hb.port + 10000}",
+                        data_center=hb.data_center or "DefaultDataCenter",
+                        rack=hb.rack or "DefaultRack",
+                        max_volumes=sum(hb.max_volume_counts.values()) or 7,
                     )
+                # EVERY beat re-registers (idempotent): if the liveness
+                # sweep unregistered a starved node while its stream stayed
+                # up, the node must rejoin on its next beat — otherwise it
+                # ghosts forever, still heartbeating into a topology that
+                # no longer contains it
+                node = self.topo.register_node(node)
                 if hb.max_file_key:
                     self.master.sequencer.set_max(hb.max_file_key)
                 new_vids, deleted_vids = [], []

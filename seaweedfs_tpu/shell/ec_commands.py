@@ -253,7 +253,11 @@ def _rebuild_one(env: CommandEnv, vid: int, collection: str,
 
 @register("ec.balance")
 def ec_balance(env: CommandEnv, args: list[str]) -> str:
-    """Move shards from loaded nodes to nodes with more free EC slots."""
+    """Move shards from loaded nodes to nodes with more free EC slots;
+    -collection=NAME scopes both the counting and the moves
+    (command_ec_balance.go)."""
+    flags = _parse_flags(args)
+    collection = flags.get("collection", "")
     topo = env.topology()
     nodes = {dn.id: dn for _dc, _rack, dn in _iter_nodes(topo)}
     free = {nid: _free_ec_slots(dn) for nid, dn in nodes.items()}
@@ -262,6 +266,7 @@ def ec_balance(env: CommandEnv, args: list[str]) -> str:
             ShardBits(e.ec_index_bits).count()
             for disk in dn.disk_infos.values()
             for e in disk.ec_shard_infos
+            if not collection or e.collection == collection
         )
         for nid, dn in nodes.items()
     }
@@ -274,7 +279,7 @@ def ec_balance(env: CommandEnv, args: list[str]) -> str:
             target = max(free, key=lambda n: (free[n] - shard_count[n], n != nid))
             if target == nid or free[target] <= 0:
                 break
-            moved = _move_one_shard(env, topo, nid, target)
+            moved = _move_one_shard(env, topo, nid, target, collection)
             if not moved:
                 break
             shard_count[nid] -= 1
@@ -282,15 +287,20 @@ def ec_balance(env: CommandEnv, args: list[str]) -> str:
             free[target] -= 1
             moves.append(f"{moved} {nid} -> {target}")
             topo = env.topology()
-    return "ec.balance: " + ("; ".join(moves) if moves else "balanced")
+    if moves:
+        return "ec.balance: " + "; ".join(moves)
+    return f"ec.balance: balanced (shards per node: {shard_count})"
 
 
-def _move_one_shard(env: CommandEnv, topo, source: str, target: str):
+def _move_one_shard(env: CommandEnv, topo, source: str, target: str,
+                    collection: str = ""):
     for _dc, _rack, dn in _iter_nodes(topo):
         if dn.id != source:
             continue
         for disk in dn.disk_infos.values():
             for e in disk.ec_shard_infos:
+                if collection and e.collection != collection:
+                    continue
                 sids = ShardBits(e.ec_index_bits).shard_ids()
                 if not sids:
                     continue

@@ -59,9 +59,9 @@ class DiskNeedleMap:
     # -- on-disk binary search (ec_volume.go:225-250 discipline) ----------
 
     def _base_read(self, i: int) -> tuple[int, int, int]:
+        # positioned read: concurrent lookups share this handle
         esz = t.NEEDLE_MAP_ENTRY_SIZE
-        self._f.seek(i * esz)
-        return t.unpack_index_entry(self._f.read(esz))
+        return t.unpack_index_entry(os.pread(self._f.fileno(), esz, i * esz))
 
     def _base_get(self, key: int) -> tuple[int, int] | None:
         lo, hi = 0, self._base_count

@@ -46,8 +46,10 @@ class EcVolumeShard:
         self.size = os.path.getsize(self.path)
 
     def read_at(self, offset: int, length: int) -> bytes:
-        self._f.seek(offset)
-        return self._f.read(length)
+        # positioned read: concurrent degraded reads share this handle, so
+        # a seek+read pair would interleave (reference: ReadAt pread
+        # discipline, ec_shard.go:93)
+        return os.pread(self._f.fileno(), length, offset)
 
     def close(self) -> None:
         self._f.close()
@@ -131,8 +133,17 @@ class EcVolume:
         field, so they still contribute `offset + 1` — the volume must not
         shrink because its tail needle was deleted (the shard files on the
         other holders keep their full extent)."""
-        self._ecx.seek(0)
-        blob = self._ecx.read(self.ecx_size)
+        # chunked pread: one call caps at ~2GiB on Linux and need not
+        # return everything it was asked for
+        parts, at = [], 0
+        while at < self.ecx_size:
+            part = os.pread(self._ecx.fileno(),
+                            min(self.ecx_size - at, 1 << 30), at)
+            if not part:
+                break
+            parts.append(part)
+            at += len(part)
+        blob = b"".join(parts)
         end = 0
         for _key, offset, size in idx_mod.walk_index_blob(blob):
             if t.size_is_deleted(size):
@@ -162,10 +173,11 @@ class EcVolume:
     def _search_ecx(self, needle_id: int) -> tuple[int, int, int] | None:
         """-> (entry_file_pos, actual_offset, size) | None."""
         lo, hi = 0, self.ecx_size // t.NEEDLE_MAP_ENTRY_SIZE
+        fd = self._ecx.fileno()
         while lo < hi:
             mid = (lo + hi) // 2
-            self._ecx.seek(mid * t.NEEDLE_MAP_ENTRY_SIZE)
-            buf = self._ecx.read(t.NEEDLE_MAP_ENTRY_SIZE)
+            buf = os.pread(fd, t.NEEDLE_MAP_ENTRY_SIZE,
+                           mid * t.NEEDLE_MAP_ENTRY_SIZE)
             key, offset, size = t.unpack_index_entry(buf)
             if key == needle_id:
                 return mid * t.NEEDLE_MAP_ENTRY_SIZE, offset, size
@@ -183,9 +195,9 @@ class EcVolume:
         if entry is None:
             return
         pos, _offset, _size = entry
-        self._ecx.seek(pos + t.NEEDLE_ID_SIZE + t.OFFSET_SIZE)
-        self._ecx.write(t.size_to_bytes(t.TOMBSTONE_FILE_SIZE))
-        self._ecx.flush()
+        self._ecx.flush()  # don't let buffered state shadow the pwrite
+        os.pwrite(self._ecx.fileno(), t.size_to_bytes(t.TOMBSTONE_FILE_SIZE),
+                  pos + t.NEEDLE_ID_SIZE + t.OFFSET_SIZE)
         with self._ecj_lock:
             with open(self.base_name + ".ecj", "ab") as j:
                 j.write(t.needle_id_to_bytes(needle_id))

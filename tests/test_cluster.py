@@ -243,15 +243,33 @@ def test_ec_delete_fanout(cluster):
         fids.append(a["fid"])
     vid = int(fids[0].split(",")[0])
     env = CommandEnv(f"127.0.0.1:{master.grpc_port}")
-    run_command(env, f"ec.encode -volumeId={vid} -collection=ecdel")
+    encode_out = run_command(env, f"ec.encode -volumeId={vid} -collection=ecdel")
     deadline = time.time() + 150  # 1-vCPU host under load: spread is slow
     holders = []
+    balance_log = []
+    rebalance_at = time.time() + 5
     while time.time() < deadline:
         holders = [s for s in servers if s.store.find_ec_volume(vid)]
         if len(master.topo.lookup_ec_shards(vid)) == 14 and len(holders) >= 2:
             break
+        if len(holders) < 2 and time.time() >= rebalance_at:
+            # under load a starved heartbeat can drop peers from the topo
+            # at spread time, leaving every shard on the source; once the
+            # peers re-register, a balance pass spreads them
+            rebalance_at = time.time() + 5
+            try:
+                balance_log.append(
+                    run_command(env, "ec.balance -force -collection=ecdel"))
+            except Exception as e:
+                balance_log.append(f"balance error: {e!r}")
         time.sleep(0.2)
-    assert len(holders) >= 2, "shards should be spread across servers"
+    assert len(holders) >= 2, (
+        "shards should be spread across servers; "
+        f"encode_out={encode_out!r} "
+        f"nodes={list(master.topo.nodes)} "
+        f"shard_map={master.topo.lookup_ec_shards(vid)} "
+        f"balance_log={balance_log[-3:]}"
+    )
     victim_fid = fids[0]
     # delete through ONE holder's public HTTP surface
     code, body = _http("DELETE", f"http://127.0.0.1:{holders[0].port}/{victim_fid}")
@@ -361,3 +379,23 @@ def test_volume_evacuate(cluster):
     target = next(s for s in others if s.store.find_volume(vid))
     code, body = _http("GET", f"http://127.0.0.1:{target.port}/{fid}")
     assert code == 200 and body == b"evac!"
+
+
+def test_ghost_node_reregisters_after_liveness_drop(cluster):
+    """If the liveness sweep unregisters a starved node while its
+    heartbeat stream is still alive, the next beat must re-register it —
+    a dropped node whose stream survives must not ghost forever (the
+    root cause of ec spread degenerating to a single holder under CPU
+    starvation)."""
+    master, servers = cluster
+    victim_id = f"127.0.0.1:{servers[0].port}"
+    assert victim_id in master.topo.nodes
+    # simulate the liveness sweep's decision without actual starvation
+    master.topo.unregister_node(victim_id)
+    assert victim_id not in master.topo.nodes
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if victim_id in master.topo.nodes:
+            break
+        time.sleep(0.1)
+    assert victim_id in master.topo.nodes, "node did not re-register"

@@ -119,3 +119,46 @@ def test_location_cache_initial_error_returns_empty():
     c = TieredLocationCache(lookup)
     assert c.get() == {}
     assert c.errors == 1
+
+
+def test_concurrent_degraded_reads_share_file_handles(tmp_path):
+    """Shard and .ecx reads use positioned I/O: concurrent needle reads on
+    one EcVolume must not corrupt each other (a seek+read pair on the
+    shared handle interleaves under load; reference uses ReadAt,
+    ec_shard.go:93).  Regression: found by bench --degraded-only."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.storage.ec import constants as ecc
+    from seaweedfs_tpu.storage.ec.encoder import (
+        generate_ec_files,
+        write_sorted_file_from_idx,
+    )
+    from seaweedfs_tpu.storage.ec.volume import EcVolume
+
+    import os
+    from helpers import make_volume
+
+    vol = make_volume(str(tmp_path), n_needles=120, seed=9, max_size=60000)
+    base = vol.file_name()
+    vol.close()
+    generate_ec_files(base, codec_name="cpu")
+    write_sorted_file_from_idx(base)
+    for sid in range(4):
+        os.remove(base + ecc.to_ext(sid))
+
+    ev = EcVolume(base, volume_id=1)
+
+    def reader(seed: int) -> int:
+        rng = np.random.default_rng(seed)
+        ok = 0
+        for _ in range(60):
+            nid = int(rng.integers(1, 121))
+            n = ev.read_needle(nid)
+            assert n.id == nid
+            ok += 1
+        return ok
+
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        counts = list(pool.map(reader, range(8)))
+    ev.close()
+    assert sum(counts) == 8 * 60
