@@ -400,6 +400,22 @@ def cmd_webdav(args) -> None:
     _wait()
 
 
+def cmd_ftp(args) -> None:
+    """FTP gateway over the filer (the reference's ftpd is an 81-LoC
+    stub; this one serves)."""
+    import json as _json
+
+    from .ftpd.server import FtpServer
+
+    users = {}
+    if args.users:
+        users = _json.loads(open(args.users).read())
+    s = FtpServer(filer=args.filer, ip=args.ip, port=args.port, users=users)
+    s.start()
+    print(f"ftp on {args.ip}:{s.port} filer={args.filer}")
+    _wait()
+
+
 def cmd_shell(args) -> None:
     from .shell.commands import CommandEnv, run_command
 
@@ -718,6 +734,14 @@ def main(argv=None) -> None:
     wd.add_argument("-filer", default="127.0.0.1:8888")
     wd.add_argument("-port", type=int, default=7333)
     wd.set_defaults(fn=cmd_webdav)
+
+    fp = sub.add_parser("ftp")
+    fp.add_argument("-filer", default="127.0.0.1:8888")
+    fp.add_argument("-ip", default="127.0.0.1")
+    fp.add_argument("-port", type=int, default=8021)
+    fp.add_argument("-users", default="",
+                    help='JSON file {"user": "password"}; empty = anonymous')
+    fp.set_defaults(fn=cmd_ftp)
 
     bk = sub.add_parser("backup")
     bk.add_argument("-server", default="127.0.0.1:9333",

@@ -1,0 +1,444 @@
+"""FTP gateway: an RFC 959 server over the filer namespace.
+
+Reference surface: weed/ftpd/ — an 81-LoC stub that registers flags but
+serves nothing.  This implementation is functional: a threaded control
+loop speaking the classic command set (USER/PASS, PWD/CWD/CDUP, TYPE,
+PASV/EPSV, LIST/NLST, RETR/STOR/APPE, DELE, MKD/RMD, RNFR/RNTO, SIZE,
+MDTM, QUIT) with passive-mode data connections, every operation mapped
+onto the filer's HTTP/gRPC surface (FilerClient) the same way the WebDAV
+gateway maps DAV verbs.
+
+Auth: anonymous by default; pass users={"name": "password"} to require a
+match.  Active (PORT) mode is not offered — PASV/EPSV only, which every
+modern client (including stdlib ftplib) uses.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+import time
+
+from ..pb import filer_pb2
+from ..s3api.filer_client import FilerClient
+from ..util import glog
+
+
+def _norm(path: str) -> str:
+    parts = []
+    for p in path.split("/"):
+        if not p or p == ".":
+            continue
+        if p == "..":
+            if parts:
+                parts.pop()
+        else:
+            parts.append(p)
+    return "/" + "/".join(parts)
+
+
+def _split(path: str) -> tuple[str, str]:
+    path = path.rstrip("/") or "/"
+    if path == "/":
+        return "/", ""
+    i = path.rindex("/")
+    return (path[:i] or "/"), path[i + 1:]
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    server: "FtpServer"
+
+    def handle(self) -> None:  # noqa: C901 — a protocol switch is a switch
+        self.cwd = "/"
+        self.user = ""
+        self.authed = not self.server.users
+        self.rename_from = ""
+        self.pasv: socket.socket | None = None
+        self.reply(220, "seaweedfs-tpu FTP gateway ready")
+        while True:
+            line = self.rfile.readline()
+            if not line:
+                break
+            try:
+                text = line.decode("utf-8", errors="replace").rstrip("\r\n")
+            except Exception:
+                continue
+            cmd, _, arg = text.partition(" ")
+            cmd = cmd.upper()
+            try:
+                if not self.dispatch(cmd, arg):
+                    break
+            except ConnectionError:
+                break
+            except Exception as e:  # noqa: BLE001 — one op fails, not the session
+                glog.warning(f"ftp: {cmd} failed: {e!r}")
+                self.reply(550, f"action failed: {type(e).__name__}")
+        self._close_pasv()
+
+    # -- plumbing ----------------------------------------------------------
+
+    def reply(self, code: int, text: str) -> None:
+        self.wfile.write(f"{code} {text}\r\n".encode())
+
+    def _close_pasv(self) -> None:
+        if self.pasv is not None:
+            try:
+                self.pasv.close()
+            except OSError:
+                pass
+            self.pasv = None
+
+    def _data_conn(self) -> socket.socket | None:
+        """Accept the client's connection on the passive socket."""
+        if self.pasv is None:
+            self.reply(425, "use PASV or EPSV first")
+            return None
+        self.pasv.settimeout(30)
+        try:
+            conn, _ = self.pasv.accept()
+        except OSError:
+            self.reply(425, "data connection failed")
+            return None
+        finally:
+            self._close_pasv()
+        return conn
+
+    def _resolve(self, arg: str) -> str:
+        if not arg:
+            return self.cwd
+        if arg.startswith("/"):
+            return _norm(arg)
+        return _norm(self.cwd.rstrip("/") + "/" + arg)
+
+    @property
+    def fc(self) -> FilerClient:
+        return self.server.filer_client
+
+    def _is_dir(self, path: str) -> bool:
+        if path == "/":
+            return True
+        d, n = _split(path)
+        e = self.fc.find_entry(d, n)
+        return e is not None and e.is_directory
+
+    # -- command dispatch --------------------------------------------------
+
+    def dispatch(self, cmd: str, arg: str) -> bool:
+        if cmd == "QUIT":
+            self.reply(221, "bye")
+            return False
+        if cmd == "USER":
+            self.user = arg
+            if self.authed:
+                self.reply(230, "ok, no password needed")
+            else:
+                self.reply(331, "password required")
+            return True
+        if cmd == "PASS":
+            if self.authed:
+                self.reply(230, "already logged in")
+            elif self.server.users.get(self.user) == arg:
+                self.authed = True
+                self.reply(230, "logged in")
+            else:
+                self.reply(530, "login incorrect")
+            return True
+        if not self.authed:
+            self.reply(530, "log in first")
+            return True
+        handler = getattr(self, f"do_{cmd}", None)
+        if handler is None:
+            self.reply(502, f"{cmd} not implemented")
+            return True
+        handler(arg)
+        return True
+
+    # -- session state -----------------------------------------------------
+
+    def do_SYST(self, arg: str) -> None:
+        self.reply(215, "UNIX Type: L8")
+
+    def do_NOOP(self, arg: str) -> None:
+        self.reply(200, "ok")
+
+    def do_TYPE(self, arg: str) -> None:
+        self.reply(200, f"type {arg or 'I'} ok")
+
+    def do_FEAT(self, arg: str) -> None:
+        self.wfile.write(b"211-features\r\n SIZE\r\n MDTM\r\n EPSV\r\n")
+        self.reply(211, "end")
+
+    def do_PWD(self, arg: str) -> None:
+        self.reply(257, f'"{self.cwd}" is the current directory')
+
+    def do_CWD(self, arg: str) -> None:
+        target = self._resolve(arg)
+        if self._is_dir(target):
+            self.cwd = target
+            self.reply(250, f"cwd is now {target}")
+        else:
+            self.reply(550, f"{target}: not a directory")
+
+    def do_CDUP(self, arg: str) -> None:
+        self.do_CWD("..")
+
+    # -- passive data ------------------------------------------------------
+
+    def _open_pasv(self) -> int:
+        self._close_pasv()
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.bind((self.server.ip, 0))
+        s.listen(1)
+        self.pasv = s
+        return s.getsockname()[1]
+
+    def do_PASV(self, arg: str) -> None:
+        port = self._open_pasv()
+        # advertise the control connection's local address, not the bind
+        # address — `-ip 0.0.0.0` must not leak into the 227 reply
+        host = self.connection.getsockname()[0]
+        h = host.replace(".", ",")
+        self.reply(227, f"entering passive mode ({h},{port >> 8},{port & 255})")
+
+    def do_EPSV(self, arg: str) -> None:
+        port = self._open_pasv()
+        self.reply(229, f"entering extended passive mode (|||{port}|)")
+
+    # -- directory ops -----------------------------------------------------
+
+    def _list_lines(self, path: str, names_only: bool) -> list[bytes]:
+        if self._is_dir(path):
+            entries = list(self.fc.iter_entries(path))
+        else:
+            d, n = _split(path)
+            e = self.fc.find_entry(d, n)
+            entries = [e] if e is not None else []
+        lines = []
+        for e in entries:
+            if names_only:
+                lines.append(e.name.encode() + b"\r\n")
+                continue
+            kind = "d" if e.is_directory else "-"
+            size = e.attributes.file_size
+            mtime = time.strftime(
+                "%b %d %H:%M", time.localtime(e.attributes.mtime or 0))
+            lines.append(
+                f"{kind}rw-r--r-- 1 weed weed {size:>12} {mtime} "
+                f"{e.name}\r\n".encode())
+        return lines
+
+    def do_LIST(self, arg: str) -> None:
+        # ls-style flags come first; stop stripping at the first non-flag
+        # token and keep the remainder verbatim (names may contain spaces
+        # or later dashes)
+        tokens = arg.split(" ")
+        while tokens and tokens[0].startswith("-"):
+            tokens.pop(0)
+        self._send_listing(self._resolve(" ".join(tokens)), names_only=False)
+
+    def do_NLST(self, arg: str) -> None:
+        self._send_listing(self._resolve(arg), names_only=True)
+
+    def _send_listing(self, path: str, names_only: bool) -> None:
+        lines = self._list_lines(path, names_only)
+        self.reply(150, "directory listing follows")
+        conn = self._data_conn()
+        if conn is None:
+            return
+        try:
+            for ln in lines:
+                conn.sendall(ln)
+        finally:
+            conn.close()
+        self.reply(226, "listing sent")
+
+    def do_MKD(self, arg: str) -> None:
+        path = self._resolve(arg)
+        d, n = _split(path)
+        self.fc.mkdir(d, n)
+        self.reply(257, f'"{path}" created')
+
+    def do_RMD(self, arg: str) -> None:
+        path = self._resolve(arg)
+        if not self._is_dir(path):
+            self.reply(550, f"{path}: not a directory")
+            return
+        d, n = _split(path)
+        self.fc.delete_entry(d, n, is_recursive=True)
+        self.reply(250, f"{path} removed")
+
+    # -- file ops ----------------------------------------------------------
+
+    def do_SIZE(self, arg: str) -> None:
+        d, n = _split(self._resolve(arg))
+        e = self.fc.find_entry(d, n)
+        if e is None or e.is_directory:
+            self.reply(550, "no such file")
+        else:
+            self.reply(213, str(e.attributes.file_size))
+
+    def do_MDTM(self, arg: str) -> None:
+        d, n = _split(self._resolve(arg))
+        e = self.fc.find_entry(d, n)
+        if e is None:
+            self.reply(550, "no such file")
+        else:
+            self.reply(213, time.strftime(
+                "%Y%m%d%H%M%S", time.gmtime(e.attributes.mtime or 0)))
+
+    def do_RETR(self, arg: str) -> None:
+        path = self._resolve(arg)
+        try:
+            resp = self.fc.open_object(path)  # streaming GET
+        except Exception:
+            self.reply(550, f"{path}: not found")
+            return
+        self.reply(150, f"opening data connection for {path}")
+        conn = self._data_conn()
+        if conn is None:
+            resp.close()
+            return
+        try:
+            while True:
+                buf = resp.read(1 << 16)
+                if not buf:
+                    break
+                conn.sendall(buf)
+        finally:
+            conn.close()
+            resp.close()
+        self.reply(226, "transfer complete")
+
+    def _recv_to_spool(self, conn: socket.socket):
+        """Drain a data connection into a spooled temp file (RAM under
+        8MB, disk beyond) so multi-GB transfers never live in memory."""
+        import tempfile
+
+        spool = tempfile.SpooledTemporaryFile(max_size=8 << 20)
+        try:
+            while True:
+                buf = conn.recv(1 << 16)
+                if not buf:
+                    break
+                spool.write(buf)
+        finally:
+            conn.close()
+        return spool
+
+    def do_STOR(self, arg: str) -> None:
+        path = self._resolve(arg)
+        self.reply(150, f"ok to send data for {path}")
+        conn = self._data_conn()
+        if conn is None:
+            return
+        with self._recv_to_spool(conn) as spool:
+            length = spool.tell()
+            spool.seek(0)
+            self.fc.put_object_stream(path, spool, length)
+        self.reply(226, "stored")
+
+    def do_APPE(self, arg: str) -> None:
+        path = self._resolve(arg)
+        self.reply(150, f"ok to append data for {path}")
+        conn = self._data_conn()
+        if conn is None:
+            return
+        with self._recv_to_spool(conn) as spool:
+            # read-modify-write append, serialized per path WITHIN this
+            # gateway (a filer-side atomic append does not exist; two
+            # gateways appending the same path can still lose an update,
+            # as with any FTP server backed by whole-object PUTs)
+            with self.server.path_lock(path):
+                import tempfile
+
+                merged = tempfile.SpooledTemporaryFile(max_size=8 << 20)
+                try:
+                    resp = self.fc.open_object(path)
+                    while True:
+                        buf = resp.read(1 << 16)
+                        if not buf:
+                            break
+                        merged.write(buf)
+                    resp.close()
+                except Exception:
+                    pass
+                spool.seek(0)
+                while True:
+                    buf = spool.read(1 << 16)
+                    if not buf:
+                        break
+                    merged.write(buf)
+                length = merged.tell()
+                merged.seek(0)
+                with merged:
+                    self.fc.put_object_stream(path, merged, length)
+        self.reply(226, "appended")
+
+    def do_DELE(self, arg: str) -> None:
+        path = self._resolve(arg)
+        d, n = _split(path)
+        if self.fc.find_entry(d, n) is None:
+            self.reply(550, f"{path}: no such file")
+            return
+        self.fc.delete_entry(d, n)
+        self.reply(250, f"{path} deleted")
+
+    def do_RNFR(self, arg: str) -> None:
+        self.rename_from = self._resolve(arg)
+        self.reply(350, "ready for RNTO")
+
+    def do_RNTO(self, arg: str) -> None:
+        if not self.rename_from:
+            self.reply(503, "RNFR first")
+            return
+        src, dst = self.rename_from, self._resolve(arg)
+        self.rename_from = ""
+        sd, sn = _split(src)
+        dd, dn = _split(dst)
+        stub = self.fc.stub()
+        stub.AtomicRenameEntry(filer_pb2.AtomicRenameEntryRequest(
+            old_directory=sd, old_name=sn,
+            new_directory=dd, new_name=dn,
+        ))
+        self.reply(250, f"renamed to {dst}")
+
+
+class _ThreadedTCP(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class FtpServer:
+    """`weed ftp`: serve the filer namespace over FTP."""
+
+    def __init__(self, filer: str = "127.0.0.1:8888", ip: str = "127.0.0.1",
+                 port: int = 8021, users: dict[str, str] | None = None):
+        self.ip = ip
+        self.port = port
+        self.users = users or {}
+        self.filer_client = FilerClient(filer)
+        self._srv = _ThreadedTCP((ip, port), _Handler)
+        self._srv.filer_client = self.filer_client  # type: ignore[attr-defined]
+        self._srv.users = self.users  # type: ignore[attr-defined]
+        self._srv.ip = ip  # type: ignore[attr-defined]
+        self._srv.path_lock = self.path_lock  # type: ignore[attr-defined]
+        self.port = self._srv.server_address[1]
+        self._thread: threading.Thread | None = None
+        self._path_locks: dict[str, threading.Lock] = {}
+        self._path_locks_guard = threading.Lock()
+
+    def path_lock(self, path: str) -> threading.Lock:
+        """Per-path mutex for read-modify-write ops (APPE) in this process."""
+        with self._path_locks_guard:
+            return self._path_locks.setdefault(path, threading.Lock())
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="ftp-server", daemon=True)
+        self._thread.start()
+        glog.info(f"ftp gateway on {self.ip}:{self.port}")
+
+    def stop(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()

@@ -60,6 +60,10 @@ class DiskFile(BackendStorageFile):
         self._lock = threading.Lock()
 
     def read_at(self, offset: int, size: int) -> bytes:
+        # stays under the lock: volume readers already serialize on
+        # volume._lock (which also guards the vacuum handle swap), so a
+        # lock-free pread here would buy nothing while opening an
+        # fd-reuse hazard against a concurrently swapped handle
         with self._lock:
             self._f.seek(offset)
             return self._f.read(size)
