@@ -49,7 +49,7 @@ def volume_vacuum(env: CommandEnv, args: list[str]) -> str:
 @register("volume.mount")
 def volume_mount(env: CommandEnv, args: list[str]) -> str:
     flags = _parse_flags(args)
-    env.volume_server(flags["node"]).VolumeMount(
+    env.volume_server(_node_grpc(flags["node"])).VolumeMount(
         vs.VolumeMountRequest(volume_id=int(flags["volumeId"]))
     )
     return "mounted"
@@ -58,7 +58,7 @@ def volume_mount(env: CommandEnv, args: list[str]) -> str:
 @register("volume.unmount")
 def volume_unmount(env: CommandEnv, args: list[str]) -> str:
     flags = _parse_flags(args)
-    env.volume_server(flags["node"]).VolumeUnmount(
+    env.volume_server(_node_grpc(flags["node"])).VolumeUnmount(
         vs.VolumeUnmountRequest(volume_id=int(flags["volumeId"]))
     )
     return "unmounted"
@@ -67,7 +67,7 @@ def volume_unmount(env: CommandEnv, args: list[str]) -> str:
 @register("volume.delete")
 def volume_delete(env: CommandEnv, args: list[str]) -> str:
     flags = _parse_flags(args)
-    env.volume_server(flags["node"]).VolumeDelete(
+    env.volume_server(_node_grpc(flags["node"])).VolumeDelete(
         vs.VolumeDeleteRequest(volume_id=int(flags["volumeId"]))
     )
     return "deleted"
@@ -75,24 +75,110 @@ def volume_delete(env: CommandEnv, args: list[str]) -> str:
 
 @register("volume.move")
 def volume_move(env: CommandEnv, args: list[str]) -> str:
-    """Copy a volume to a target node, then delete from the source."""
+    """Copy a volume to a target node, then delete from the source.
+    -source/-target are public node ids (ip:port as volume.list prints),
+    the same convention as every other node-taking command."""
     flags = _parse_flags(args)
     vid = int(flags["volumeId"])
     source, target = flags["source"], flags["target"]
-    topo = env.topology()
-    collection = ""
-    for _dc, _rack, dn in _iter_nodes(topo):
+    _require_distinct_copy(env, vid, source, target)
+    _node, collection = _locate_volume(env, vid)
+    env.volume_server(_node_grpc(target)).VolumeCopy(
+        vs.VolumeCopyRequest(
+            volume_id=vid, collection=collection,
+            source_data_node=_node_grpc(source),
+        )
+    )
+    env.volume_server(_node_grpc(source)).VolumeDelete(
+        vs.VolumeDeleteRequest(volume_id=vid))
+    return f"moved {vid} {source} -> {target}"
+
+
+def _require_distinct_copy(env: CommandEnv, vid: int, source: str,
+                           target: str) -> None:
+    """Refuse a copy that would truncate the .dat being streamed: the
+    target must be a different node that does not already hold vid."""
+    if source == target:
+        raise RuntimeError(f"source and target are both {source}")
+    for _dc, _rack, dn in _iter_nodes(env.topology()):
+        if dn.id != target:
+            continue
         for disk in dn.disk_infos.values():
             for v in disk.volume_infos:
                 if v.id == vid:
-                    collection = v.collection
-    env.volume_server(target).VolumeCopy(
+                    raise RuntimeError(
+                        f"{target} already holds volume {vid}")
+
+
+@register("volume.copy")
+def volume_copy(env: CommandEnv, args: list[str]) -> str:
+    """Copy a volume to a target node, keeping the source
+    (command_volume_copy.go)."""
+    flags = _parse_flags(args)
+    vid = int(flags["volumeId"])
+    source, target = flags["source"], flags["target"]
+    _require_distinct_copy(env, vid, source, target)
+    _node, collection = _locate_volume(env, vid)
+    env.volume_server(_node_grpc(target)).VolumeCopy(
         vs.VolumeCopyRequest(
-            volume_id=vid, collection=collection, source_data_node=source
+            volume_id=vid, collection=collection,
+            source_data_node=_node_grpc(source),
         )
     )
-    env.volume_server(source).VolumeDelete(vs.VolumeDeleteRequest(volume_id=vid))
-    return f"moved {vid} {source} -> {target}"
+    return f"copied {vid} {source} -> {target}"
+
+
+@register("volume.mark")
+def volume_mark(env: CommandEnv, args: list[str]) -> str:
+    """Mark a volume readonly or writable on a node
+    (command_volume_mark.go)."""
+    flags = _parse_flags(args)
+    vid = int(flags["volumeId"])
+    node = flags.get("node") or _locate_volume(env, vid)[0]
+    stub = env.volume_server(_node_grpc(node))
+    if flags.get("writable") == "true":
+        stub.VolumeMarkWritable(vs.VolumeMarkWritableRequest(volume_id=vid))
+        return f"volume {vid} marked writable on {node}"
+    stub.VolumeMarkReadonly(vs.VolumeMarkReadonlyRequest(volume_id=vid))
+    return f"volume {vid} marked readonly on {node}"
+
+
+@register("volume.configure.replication")
+def volume_configure_replication(env: CommandEnv, args: list[str]) -> str:
+    """Change a volume's replica placement in its super block on every
+    holder (command_volume_configure_replication.go)."""
+    flags = _parse_flags(args)
+    vid = int(flags["volumeId"])
+    replication = flags["replication"]
+    ReplicaPlacement.parse(replication)  # validate before touching servers
+    changed = []
+    for _dc, _rack, dn in _iter_nodes(env.topology()):
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                if v.id != vid:
+                    continue
+                resp = env.volume_server(_node_grpc(dn.id)).VolumeConfigure(
+                    vs.VolumeConfigureRequest(
+                        volume_id=vid, replication=replication
+                    )
+                )
+                if resp.error:
+                    raise RuntimeError(resp.error)
+                changed.append(dn.id)
+    if not changed:
+        raise RuntimeError(f"volume {vid} not found in topology")
+    return f"volume {vid} replication={replication} on {sorted(set(changed))}"
+
+
+@register("volume.server.leave")
+def volume_server_leave(env: CommandEnv, args: list[str]) -> str:
+    """Ask one volume server to stop heartbeating and leave the cluster
+    (command_volume_server_leave.go)."""
+    flags = _parse_flags(args)
+    node = flags["node"]
+    env.volume_server(_node_grpc(node)).VolumeServerLeave(
+        vs.VolumeServerLeaveRequest())
+    return f"{node} asked to leave"
 
 
 def _locate_volume(env: CommandEnv, vid: int) -> tuple[str, str]:
@@ -240,8 +326,8 @@ def volume_balance(env: CommandEnv, args: list[str]) -> str:
             try:
                 run = volume_move(
                     env,
-                    [f"-volumeId={vid}", f"-source={_node_grpc(nid)}",
-                     f"-target={_node_grpc(target)}"],
+                    [f"-volumeId={vid}", f"-source={nid}",
+                     f"-target={target}"],
                 )
                 moves.append(run)
                 counts[nid] -= 1
@@ -300,8 +386,8 @@ def volume_evacuate(env: CommandEnv, args: list[str]) -> str:
             try:
                 volume_move(
                     env,
-                    [f"-volumeId={v.id}", f"-source={_node_grpc(node)}",
-                     f"-target={_node_grpc(target)}"],
+                    [f"-volumeId={v.id}", f"-source={node}",
+                     f"-target={target}"],
                 )
                 moved.append(f"v{v.id}->{target}")
             except grpc.RpcError as e:

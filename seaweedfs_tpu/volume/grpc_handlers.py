@@ -75,9 +75,22 @@ class VolumeGrpcService:
             return vs.VolumeConfigureResponse(error="volume not found")
         from ..storage.replica_placement import ReplicaPlacement
 
-        v.super_block.replica_placement = ReplicaPlacement.parse(
-            request.replication
-        )
+        new_placement = ReplicaPlacement.parse(request.replication)
+        # persist FIRST (the placement byte lives in the 8-byte super
+        # block at the head of the .dat, super_block.go WriteSuperBlock
+        # discipline), THEN mutate memory — a failed write (e.g. the .dat
+        # is remote-tiered and read-only) must not leave the node
+        # heartbeating a placement that never reached disk.  Under v._lock:
+        # tier transitions and vacuum commits swap v._dat.
+        old = v.super_block.replica_placement
+        with v._lock:
+            try:
+                v.super_block.replica_placement = new_placement
+                v._dat.write_at(0, v.super_block.to_bytes())
+            except Exception as e:  # noqa: BLE001 — report, don't diverge
+                v.super_block.replica_placement = old
+                return vs.VolumeConfigureResponse(
+                    error=f"cannot persist super block: {e}")
         return vs.VolumeConfigureResponse()
 
     def DeleteCollection(self, request, context):

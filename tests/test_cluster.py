@@ -345,6 +345,78 @@ def test_find_replica_divergence_pure():
     assert {n for n, _fc, _sz in out[2]} == {"a", "b"}
 
 
+def test_ghost_node_reregisters_after_liveness_drop(cluster):
+    """If the liveness sweep unregisters a starved node while its
+    heartbeat stream is still alive, the next beat must re-register it —
+    a dropped node whose stream survives must not ghost forever (the
+    root cause of ec spread degenerating to a single holder under CPU
+    starvation)."""
+    master, servers = cluster
+    victim_id = f"127.0.0.1:{servers[0].port}"
+    assert victim_id in master.topo.nodes
+    # simulate the liveness sweep's decision without actual starvation
+    master.topo.unregister_node(victim_id)
+    assert victim_id not in master.topo.nodes
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if victim_id in master.topo.nodes:
+            break
+        time.sleep(0.1)
+    assert victim_id in master.topo.nodes, "node did not re-register"
+
+
+def test_volume_copy_mark_configure_commands(cluster):
+    """volume.copy / volume.mark / volume.configure.replication against
+    the live cluster (command_volume_copy.go, command_volume_mark.go,
+    command_volume_configure_replication.go)."""
+    master, servers = cluster
+    a = _assign(master, collection="shellops")
+    payload = b"shell ops payload"
+    code, _ = _http("POST", f"http://{a['url']}/{a['fid']}", payload)
+    assert code == 201
+    vid = int(a["fid"].split(",")[0])
+    env = CommandEnv(f"127.0.0.1:{master.grpc_port}")
+    # wait for the heartbeat delta to land the new volume in the topology
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if any(v.volume_id == vid
+               for n in master.topo.nodes.values()
+               for v in n.volumes.values()):
+            break
+        time.sleep(0.1)
+
+    source = next(s for s in servers if s.store.find_volume(vid) is not None)
+    target = next(s for s in servers if s.store.find_volume(vid) is None)
+    out = run_command(
+        env,
+        f"volume.copy -volumeId={vid} "
+        f"-source=127.0.0.1:{source.port} -target=127.0.0.1:{target.port}")
+    assert "copied" in out
+    assert target.store.find_volume(vid) is not None  # source kept too
+    assert source.store.find_volume(vid) is not None
+    code, got = _http("GET", f"http://127.0.0.1:{target.port}/{a['fid']}")
+    assert code == 200 and got == payload
+
+    out = run_command(
+        env, f"volume.mark -volumeId={vid} -node=127.0.0.1:{source.port}")
+    assert "readonly" in out
+    assert source.store.find_volume(vid).read_only
+    out = run_command(
+        env,
+        f"volume.mark -volumeId={vid} -node=127.0.0.1:{source.port} "
+        "-writable=true")
+    assert "writable" in out
+    assert not source.store.find_volume(vid).read_only
+
+    out = run_command(
+        env, f"volume.configure.replication -volumeId={vid} -replication=001")
+    assert "replication=001" in out
+    assert str(source.store.find_volume(vid)
+               .super_block.replica_placement) == "001"
+
+
+
+
 def test_volume_evacuate(cluster):
     """Moves all volumes off a node and tells it to leave
     (command_volume_server_evacuate.go).  Runs LAST: the evacuated node
@@ -379,23 +451,3 @@ def test_volume_evacuate(cluster):
     target = next(s for s in others if s.store.find_volume(vid))
     code, body = _http("GET", f"http://127.0.0.1:{target.port}/{fid}")
     assert code == 200 and body == b"evac!"
-
-
-def test_ghost_node_reregisters_after_liveness_drop(cluster):
-    """If the liveness sweep unregisters a starved node while its
-    heartbeat stream is still alive, the next beat must re-register it —
-    a dropped node whose stream survives must not ghost forever (the
-    root cause of ec spread degenerating to a single holder under CPU
-    starvation)."""
-    master, servers = cluster
-    victim_id = f"127.0.0.1:{servers[0].port}"
-    assert victim_id in master.topo.nodes
-    # simulate the liveness sweep's decision without actual starvation
-    master.topo.unregister_node(victim_id)
-    assert victim_id not in master.topo.nodes
-    deadline = time.time() + 10
-    while time.time() < deadline:
-        if victim_id in master.topo.nodes:
-            break
-        time.sleep(0.1)
-    assert victim_id in master.topo.nodes, "node did not re-register"
