@@ -214,18 +214,7 @@ class MasterGrpcService:
 
     def CollectionDelete(self, request, context):
         self._require_leader(context)
-        from ..pb import rpc as rpclib
-        from ..pb import volume_server_pb2 as vs
-
-        with self.topo.lock:
-            nodes = list(self.topo.nodes.values())
-        for n in nodes:
-            try:
-                rpclib.volume_server_stub(n.grpc_address, timeout=30).DeleteCollection(
-                    vs.DeleteCollectionRequest(collection=request.name)
-                )
-            except grpc.RpcError:
-                pass
+        self.master.delete_collection(request.name)
         return master_pb2.CollectionDeleteResponse()
 
     def GetMasterConfiguration(self, request, context):

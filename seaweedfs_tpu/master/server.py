@@ -209,6 +209,29 @@ class MasterServer:
 
     # -- layouts ----------------------------------------------------------
 
+    def delete_collection(self, name: str) -> None:
+        """Delete a collection everywhere: fan out DeleteCollection to the
+        volume servers AND purge the master's own layouts, so a later
+        assign to the same collection name starts from scratch instead of
+        picking a deleted vid out of a stale writable set
+        (master_grpc_server_collection.go)."""
+        from ..pb import rpc as rpclib
+        from ..pb import volume_server_pb2 as vspb
+
+        with self.topo.lock:
+            nodes = list(self.topo.nodes.values())
+        for n in nodes:
+            try:
+                rpclib.volume_server_stub(
+                    n.grpc_address, timeout=30
+                ).DeleteCollection(
+                    vspb.DeleteCollectionRequest(collection=name))
+            except grpc.RpcError:
+                pass
+        with self._layout_lock:
+            for key in [k for k in self.layouts if k[0] == name]:
+                del self.layouts[key]
+
     def get_layout(self, collection: str, replication: str, ttl: str) -> VolumeLayout:
         replication = replication or self.default_replication
         key = (collection, replication, ttl)
@@ -479,6 +502,55 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
                 return self._json(200, self.master.raft.handle(msg))
             except (ValueError, KeyError) as e:
                 return self._json(400, {"error": str(e)})
+        if u.path == "/submit":
+            # one-shot convenience: assign + upload in a single request
+            # (master_server_handlers.go submitFromMasterServerHandler)
+            from ..operation.upload import upload_data
+            from ..volume.http_handlers import _parse_multipart
+
+            if not self.master.is_leader():
+                leader = self.master.leader()
+                if leader == f"{self.master.ip}:{self.master.port}":
+                    return self._json(503, {"error": "no leader elected yet"})
+                self.send_response(307)
+                self.send_header("Location", f"http://{leader}{self.path}")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            q = urllib.parse.parse_qs(u.query)
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length)
+            ctype = self.headers.get("Content-Type", "")
+            name = mime = b""
+            if ctype.startswith("multipart/form-data"):
+                data, name, mime = _parse_multipart(body, ctype)
+            else:
+                data = body
+            try:
+                fid, url, public_url, _count = self.master.assign(
+                    count=1,
+                    collection=q.get("collection", [""])[0],
+                    replication=q.get("replication", [""])[0],
+                    ttl=q.get("ttl", [""])[0],
+                    data_center=q.get("dataCenter", [""])[0],
+                    rack=q.get("rack", [""])[0],
+                )
+                res = upload_data(
+                    f"http://{url}/{fid}", data,
+                    filename=name.decode() if name else "",
+                    mime=mime.decode() if mime else "",
+                    jwt=self.master.sign_fid(fid),
+                )
+                return self._json(201, {
+                    "fid": fid,
+                    "fileUrl": f"{public_url}/{fid}",
+                    "fileName": name.decode() if name else "",
+                    "size": res.size,
+                })
+            except ValueError as e:  # malformed client input -> 400
+                return self._json(400, {"error": str(e)})
+            except Exception as e:
+                return self._json(500, {"error": str(e)})
         return self._json(404, {"error": f"unknown path {u.path}"})
 
     def do_GET(self):
@@ -488,7 +560,8 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         def qget(name, default=""):
             return q.get(name, [default])[0]
 
-        if (u.path.startswith("/dir/") and u.path != "/dir/status"
+        if (((u.path.startswith("/dir/") and u.path != "/dir/status")
+                or u.path == "/vol/grow")
                 and not self.master.is_leader()):
             # followers hold no topology (volume servers heartbeat the
             # leader only) — redirect like the reference's ProxyToLeader
@@ -594,6 +667,51 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
                 float(qget("garbageThreshold", "0") or 0) or None
             )
             return self._json(200, {"vacuumed": vacuumed})
+        if u.path == "/vol/grow":
+            # master_server_handlers_admin.go volumeGrowHandler
+            try:
+                grown = self.master.grow_volumes(
+                    qget("collection"),
+                    qget("replication") or self.master.default_replication,
+                    qget("ttl"),
+                    data_center=qget("dataCenter"),
+                    rack=qget("rack"),
+                    target_count=int(qget("count", "0") or 0) or None,
+                )
+                return self._json(200, {"count": len(grown),
+                                        "volumeIds": grown})
+            except Exception as e:
+                return self._json(500, {"error": str(e)})
+        if u.path == "/vol/status":
+            with self.master.topo.lock:
+                vols = {}
+                for n in self.master.topo.nodes.values():
+                    for vid, v in n.volumes.items():
+                        vols.setdefault(str(vid), {
+                            "size": v.size,
+                            "fileCount": v.file_count,
+                            "collection": v.collection,
+                            "readOnly": v.read_only,
+                            "replicaPlacement": str(
+                                ReplicaPlacement.from_byte(
+                                    v.replica_placement)),
+                            "locations": [],
+                        })["locations"].append(n.id)
+                return self._json(200, {"Volumes": vols})
+        if u.path == "/col/delete":
+            # master_server_handlers_admin.go deleteFromMasterServerHandler
+            name = qget("collection")
+            if not name:
+                return self._json(400, {"error": "collection required"})
+            if not self.master.is_leader():
+                return self._json(503, {"error": "not the leader"})
+            self.master.delete_collection(name)
+            return self._json(200, {"collection": name, "deleted": True})
+        if u.path in ("/cluster/healthz", "/stats/health"):
+            own = f"{self.master.ip}:{self.master.port}"
+            healthy = (self.master.is_leader()
+                       or self.master.leader() != own)
+            return self._json(200 if healthy else 503, {"ok": healthy})
         return self._json(404, {"error": f"unknown path {u.path}"})
 
 

@@ -417,6 +417,58 @@ def test_volume_copy_mark_configure_commands(cluster):
 
 
 
+def test_master_admin_http_endpoints(cluster):
+    """/submit, /vol/grow, /vol/status, /col/delete, /cluster/healthz
+    (master_server_handlers_admin.go surface)."""
+    master, servers = cluster
+    base = f"http://127.0.0.1:{master.port}"
+
+    code, body = _http("GET", f"{base}/cluster/healthz")
+    assert code == 200 and json.loads(body)["ok"]
+
+    # one-shot submit: assign + upload in a single POST
+    boundary = "testbound123"
+    payload = b"submitted-in-one-shot"
+    mp = (f"--{boundary}\r\n"
+          'Content-Disposition: form-data; name="file"; '
+          'filename="one.txt"\r\n'
+          "Content-Type: text/plain\r\n\r\n").encode() + payload + \
+        f"\r\n--{boundary}--\r\n".encode()
+    req = urllib.request.Request(
+        f"{base}/submit?collection=subm", data=mp, method="POST",
+        headers={"Content-Type":
+                 f"multipart/form-data; boundary={boundary}"})
+    with urllib.request.urlopen(req, timeout=15) as r:
+        out = json.loads(r.read())
+    assert out["fid"] and out["size"] > 0
+    code, got = _http("GET", f"http://{out['fileUrl']}")
+    assert code == 200 and got == payload
+
+    # status + grow + col delete (wait out the heartbeat delta lag)
+    deadline = time.time() + 15
+    vols = {}
+    while time.time() < deadline:
+        code, body = _http("GET", f"{base}/vol/status")
+        vols = json.loads(body)["Volumes"]
+        if any(v["collection"] == "subm" for v in vols.values()):
+            break
+        time.sleep(0.2)
+    assert any(v["collection"] == "subm" for v in vols.values())
+    code, body = _http("GET", f"{base}/vol/grow?collection=grown&count=1")
+    assert code == 200 and json.loads(body)["count"] == 1
+    code, body = _http("GET", f"{base}/col/delete?collection=subm")
+    assert code == 200 and json.loads(body)["deleted"]
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        code, body = _http("GET", f"{base}/vol/status")
+        if not any(v["collection"] == "subm"
+                   for v in json.loads(body)["Volumes"].values()):
+            break
+        time.sleep(0.2)
+    assert not any(v["collection"] == "subm"
+                   for v in json.loads(body)["Volumes"].values())
+
+
 def test_volume_evacuate(cluster):
     """Moves all volumes off a node and tells it to leave
     (command_volume_server_evacuate.go).  Runs LAST: the evacuated node
