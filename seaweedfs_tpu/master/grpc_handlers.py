@@ -79,6 +79,13 @@ class MasterGrpcService:
                     new_vids += [m.id for m in hb.new_volumes]
                     deleted_vids += [m.id for m in hb.deleted_volumes]
                 node.last_seen = time.monotonic()
+                if deleted_vids:
+                    # vids gone from this node must leave the writable
+                    # sets too — rebuild_layouts only ever registers, so
+                    # without this a deleted volume stays assignable on
+                    # this node until master restart
+                    self.master.unregister_from_layouts(deleted_vids,
+                                                        node.id)
                 if new_vids or deleted_vids:
                     self.master.broadcast_location(
                         node, new_vids, deleted_vids

@@ -215,9 +215,6 @@ class MasterServer:
         assign to the same collection name starts from scratch instead of
         picking a deleted vid out of a stale writable set
         (master_grpc_server_collection.go)."""
-        from ..pb import rpc as rpclib
-        from ..pb import volume_server_pb2 as vspb
-
         with self.topo.lock:
             nodes = list(self.topo.nodes.values())
         for n in nodes:
@@ -225,7 +222,7 @@ class MasterServer:
                 rpclib.volume_server_stub(
                     n.grpc_address, timeout=30
                 ).DeleteCollection(
-                    vspb.DeleteCollectionRequest(collection=name))
+                    vs.DeleteCollectionRequest(collection=name))
             except grpc.RpcError:
                 pass
         with self._layout_lock:
@@ -245,6 +242,12 @@ class MasterServer:
                 )
                 self.layouts[key] = layout
             return layout
+
+    def unregister_from_layouts(self, vids, node_id: str) -> None:
+        with self._layout_lock:
+            for layout in self.layouts.values():
+                for vid in vids:
+                    layout.unregister(vid, node_id)
 
     def rebuild_layouts(self, node) -> None:
         """Re-register a node's volumes into their layouts."""
@@ -394,10 +397,7 @@ class MasterServer:
         while not self._stop.wait(self.topo.pulse_seconds):
             for node_id in self.topo.collect_dead_nodes():
                 vids = self.topo.unregister_node(node_id)
-                with self._layout_lock:
-                    for layout in self.layouts.values():
-                        for vid in vids:
-                            layout.unregister(vid, node_id)
+                self.unregister_from_layouts(vids, node_id)
 
     # -- vacuum -----------------------------------------------------------
 
@@ -488,6 +488,28 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _redirect_to_leader(self) -> None:
+        """307 to the leader; 503 when no leader is elected.  Drains any
+        unread request body first — skipping it desyncs HTTP/1.1
+        keep-alive (the next request parses the stale body as a request
+        line)."""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = 0
+        while length > 0:
+            chunk = self.rfile.read(min(length, 1 << 20))
+            if not chunk:
+                break
+            length -= len(chunk)
+        leader = self.master.leader()
+        if leader == f"{self.master.ip}:{self.master.port}":
+            return self._json(503, {"error": "no leader elected yet"})
+        self.send_response(307)
+        self.send_header("Location", f"http://{leader}{self.path}")
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
     def do_POST(self):
         u = urllib.parse.urlparse(self.path)
         if u.path == "/cluster/raft" and self.master.raft is not None:
@@ -509,24 +531,17 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
             from ..volume.http_handlers import _parse_multipart
 
             if not self.master.is_leader():
-                leader = self.master.leader()
-                if leader == f"{self.master.ip}:{self.master.port}":
-                    return self._json(503, {"error": "no leader elected yet"})
-                self.send_response(307)
-                self.send_header("Location", f"http://{leader}{self.path}")
-                self.send_header("Content-Length", "0")
-                self.end_headers()
-                return
+                return self._redirect_to_leader()
             q = urllib.parse.parse_qs(u.query)
-            length = int(self.headers.get("Content-Length") or 0)
-            body = self.rfile.read(length)
-            ctype = self.headers.get("Content-Type", "")
-            name = mime = b""
-            if ctype.startswith("multipart/form-data"):
-                data, name, mime = _parse_multipart(body, ctype)
-            else:
-                data = body
             try:
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length)
+                ctype = self.headers.get("Content-Type", "")
+                name = mime = b""
+                if ctype.startswith("multipart/form-data"):
+                    data, name, mime = _parse_multipart(body, ctype)
+                else:
+                    data = body
                 fid, url, public_url, _count = self.master.assign(
                     count=1,
                     collection=q.get("collection", [""])[0],
@@ -561,18 +576,11 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
             return q.get(name, [default])[0]
 
         if (((u.path.startswith("/dir/") and u.path != "/dir/status")
-                or u.path == "/vol/grow")
+                or u.path in ("/vol/grow", "/vol/status"))
                 and not self.master.is_leader()):
             # followers hold no topology (volume servers heartbeat the
             # leader only) — redirect like the reference's ProxyToLeader
-            leader = self.master.leader()
-            if leader == f"{self.master.ip}:{self.master.port}":
-                return self._json(503, {"error": "no leader elected yet"})
-            self.send_response(307)
-            self.send_header("Location", f"http://{leader}{self.path}")
-            self.send_header("Content-Length", "0")
-            self.end_headers()
-            return
+            return self._redirect_to_leader()
         if u.path == "/dir/assign":
             try:
                 fid, url, public_url, count = self.master.assign(
@@ -680,6 +688,8 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
                 )
                 return self._json(200, {"count": len(grown),
                                         "volumeIds": grown})
+            except ValueError as e:  # malformed client input -> 400
+                return self._json(400, {"error": str(e)})
             except Exception as e:
                 return self._json(500, {"error": str(e)})
         if u.path == "/vol/status":

@@ -22,15 +22,15 @@ def _so_path() -> str:
 
 
 def _host_simd_tier() -> int:
-    """Best sw_gf_impl tier this host can run: 2 GFNI+AVX512, 1 SSSE3,
-    0 scalar — the heal target for stale/portable builds."""
+    """Best sw_gf_impl tier this host can run: 3 interleaved GFNI+AVX512,
+    1 SSSE3, 0 scalar — the heal target for stale/portable builds."""
     try:
         with open("/proc/cpuinfo") as f:
             flags = f.read()
     except OSError:
         return 0
     if "gfni" in flags and "avx512bw" in flags and "avx512f" in flags:
-        return 2
+        return 3
     if "ssse3" in flags:
         return 1
     return 0
