@@ -469,6 +469,38 @@ def test_master_admin_http_endpoints(cluster):
                    for v in json.loads(body)["Volumes"].values())
 
 
+def test_deleted_volume_leaves_writable_set(cluster):
+    """A volume deleted on its server must leave the master's layouts at
+    the next full heartbeat — otherwise assigns keep picking the dead vid
+    until master restart (regression: rebuild_layouts only registers)."""
+    master, servers = cluster
+    a = _assign(master, collection="delreg")
+    vid = int(a["fid"].split(",")[0])
+    holder = next(s for s in servers if s.store.find_volume(vid) is not None)
+    # the layout knows the vid once the heartbeat lands
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        layouts = [l for (c, _r, _t), l in master.layouts.items()
+                   if c == "delreg"]
+        if layouts and any(vid in l.locations for l in layouts):
+            break
+        time.sleep(0.1)
+    holder.store.delete_volume(vid)
+    deadline = time.time() + 15
+    gone = False
+    while time.time() < deadline:
+        layouts = [l for (c, _r, _t), l in master.layouts.items()
+                   if c == "delreg"]
+        if layouts and not any(
+                holder_id == f"127.0.0.1:{holder.port}"
+                for l in layouts
+                for holder_id in l.locations.get(vid, [])):
+            gone = True
+            break
+        time.sleep(0.2)
+    assert gone, "deleted volume still registered to its old holder"
+
+
 def test_volume_evacuate(cluster):
     """Moves all volumes off a node and tells it to leave
     (command_volume_server_evacuate.go).  Runs LAST: the evacuated node

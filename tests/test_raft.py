@@ -343,3 +343,69 @@ def test_raft_transport_rejects_forged_messages(tmp_path):
     finally:
         for m in masters:
             m.stop()
+
+
+def test_follower_redirects_admin_endpoints(tmp_path):
+    """Followers 307 state-bearing HTTP endpoints to the leader, draining
+    posted bodies first so keep-alive connections stay in sync."""
+    import json
+    import urllib.request
+
+    from seaweedfs_tpu.master.server import MasterServer
+
+    ports = [_free_port() for _ in range(3)]
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    masters = []
+    for p in ports:
+        m = MasterServer(ip="127.0.0.1", port=p, peers=peers,
+                         raft_state_dir=str(tmp_path))
+        m.start()
+        masters.append(m)
+    try:
+        deadline = time.time() + 15
+        leader = None
+        while time.time() < deadline:
+            leaders = [m for m in masters if m.is_leader()]
+            if len(leaders) == 1 and all(
+                    m.leader() == f"127.0.0.1:{leaders[0].port}"
+                    for m in masters):
+                leader = leaders[0]
+                break
+            time.sleep(0.05)
+        assert leader is not None
+        follower = next(m for m in masters if m is not leader)
+        expect = f"http://127.0.0.1:{leader.port}"
+
+        class NoRedirect(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, *a, **k):
+                return None
+
+        opener = urllib.request.build_opener(NoRedirect)
+        for path in ("/dir/assign", "/vol/grow?collection=x",
+                     "/vol/status"):
+            try:
+                r = opener.open(
+                    f"http://127.0.0.1:{follower.port}{path}", timeout=5)
+                code, loc = r.status, r.headers.get("Location", "")
+            except urllib.error.HTTPError as e:
+                code, loc = e.code, e.headers.get("Location", "")
+            assert code == 307, (path, code)
+            assert loc.startswith(expect), (path, loc)
+        # POST /submit with a body: redirect + the body must be drained
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{follower.port}/submit",
+            data=b"x" * 100000, method="POST")
+        try:
+            r = opener.open(req, timeout=5)
+            code = r.status
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 307
+        # healthz: follower knowing a leader is healthy
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{follower.port}/cluster/healthz",
+                timeout=5) as r:
+            assert json.loads(r.read())["ok"] is True
+    finally:
+        for m in masters:
+            m.stop()
