@@ -110,12 +110,16 @@ def cmd_server(args) -> None:
     if args.filer or args.s3:
         from .filer.server import FilerServer
 
+        from .notification import publisher_from_config
+
         store, store_path, store_options = _filer_store_selection(
             args.filerStore)
         filer = FilerServer(
             masters=[f"{args.ip}:{m.grpc_port}"],
             ip=args.ip, port=args.filerPort, store=store,
             store_path=store_path, store_options=store_options,
+            notification=publisher_from_config(
+                load_configuration("notification")),
         )
         filer.start()
         extras.append(f"filer={args.filerPort}")
@@ -176,8 +180,11 @@ def _filer_store_selection(flag_store: str) -> tuple[str, str, dict]:
 
 def cmd_filer(args) -> None:
     from .filer.server import FilerServer
+    from .notification import publisher_from_config
+    from .util.config import load_configuration
 
     store, store_path, store_options = _filer_store_selection(args.store)
+    notification = publisher_from_config(load_configuration("notification"))
 
     f = FilerServer(
         masters=[_grpc_addr(m) for m in args.master.split(",")],
@@ -190,6 +197,7 @@ def cmd_filer(args) -> None:
         peers=args.peers.split(",") if args.peers else None,
         cipher=args.cipher,
         store_options=store_options,
+        notification=notification,
     )
     f.start()
     print(f"filer http={args.port} grpc={f.grpc_port}")
@@ -242,16 +250,28 @@ def cmd_filer_replicate(args) -> None:
     from .replication import FilerSource, Replicator
     from .replication.sink import FilerSink, LocalSink, S3Sink
 
-    if args.sink_type == "filer":
-        sink = FilerSink(args.sink)
-    elif args.sink_type == "s3":
-        endpoint, _, bucket = args.sink.partition("/")
-        sink = S3Sink(endpoint, bucket or "backup")
-    else:
-        sink = LocalSink(args.sink)
+    if args.sink:
+        args.sink_type = args.sink_type or "local"
+        if args.sink_type == "filer":
+            sink = FilerSink(args.sink)
+        elif args.sink_type == "s3":
+            endpoint, _, bucket = args.sink.partition("/")
+            sink = S3Sink(endpoint, bucket or "backup")
+        else:
+            sink = LocalSink(args.sink)
+        label = f"{args.sink_type}:{args.sink}"
+    else:  # no -sink flag: replication.toml picks it (scaffold.go model)
+        from .replication.sink import sink_from_config
+        from .util.config import load_configuration
+
+        if args.sink_type:
+            raise SystemExit(
+                "-sink.type without -sink would be silently ignored; "
+                "either give both flags or configure replication.toml")
+        conf = load_configuration("replication", required=True)
+        sink, label = sink_from_config(conf)
     rep = Replicator(FilerSource(args.filer), sink, args.filerPath)
-    print(f"replicating {args.filer}{args.filerPath} -> "
-          f"{args.sink_type}:{args.sink}")
+    print(f"replicating {args.filer}{args.filerPath} -> {label}")
     rep.run()
 
 
@@ -418,10 +438,19 @@ def cmd_ftp(args) -> None:
 
 def cmd_shell(args) -> None:
     from .shell.commands import CommandEnv, run_command
+    from .util.config import load_configuration
 
-    env = CommandEnv(_grpc_addr(args.master))
-    if getattr(args, "filer", ""):
-        env.option["filer"] = args.filer
+    master, filer = args.master, getattr(args, "filer", "")
+    sconf = load_configuration("shell")
+    if sconf.loaded:  # shell.toml fills only OMITTED flags (default=None)
+        if master is None:
+            master = sconf.get_string("cluster.default.master", "")
+        if not filer:
+            filer = sconf.get_string("cluster.default.filer", "")
+    master = master or "127.0.0.1:9333"
+    env = CommandEnv(_grpc_addr(master))
+    if filer:
+        env.option["filer"] = filer
     if args.command:
         print(run_command(env, args.command))
         return
@@ -670,11 +699,12 @@ def main(argv=None) -> None:
     fr = sub.add_parser("filer.replicate")
     fr.add_argument("-filer", default="127.0.0.1:8888")
     fr.add_argument("-filerPath", default="/")
-    fr.add_argument("-sink.type", dest="sink_type", default="local",
-                    choices=["local", "filer", "s3"])
-    fr.add_argument("-sink", required=True,
+    fr.add_argument("-sink.type", dest="sink_type", default="",
+                    choices=["", "local", "filer", "s3"],
+                    help="with -sink; defaults to local")
+    fr.add_argument("-sink", default="",
                     help="local dir, target filer ip:port, or s3 "
-                         "endpoint/bucket")
+                         "endpoint/bucket; empty = use replication.toml")
     fr.set_defaults(fn=cmd_filer_replicate)
 
     fb = sub.add_parser("filer.backup")
@@ -778,7 +808,9 @@ def main(argv=None) -> None:
     fcp.set_defaults(fn=cmd_filer_copy)
 
     sh = sub.add_parser("shell")
-    sh.add_argument("-master", default="127.0.0.1:9333")
+    sh.add_argument("-master", default=None,
+                    help="master ip:port (omitted -> shell.toml, then "
+                         "127.0.0.1:9333)")
     sh.add_argument("-filer", default="",
                     help="filer http address for fs.*/s3.* commands")
     sh.add_argument("-c", dest="command", default="")
@@ -816,7 +848,8 @@ def main(argv=None) -> None:
 
     sc = sub.add_parser("scaffold")
     sc.add_argument("-config", default="security",
-                    choices=("security", "master", "filer"))
+                    choices=("security", "master", "filer",
+                             "notification", "replication", "shell"))
     sc.add_argument("-output", default=".",
                     help="output directory, or - for stdout")
     sc.set_defaults(fn=cmd_scaffold)

@@ -28,3 +28,17 @@ __all__ = [
     "ConfigurationError",
     "make_publisher",
 ]
+
+
+def publisher_from_config(conf):
+    """Build the one enabled [notification.*] of a notification.toml;
+    None when the file is absent or nothing is enabled
+    (notification/configuration.go LoadConfiguration)."""
+    if not conf.loaded:
+        return None
+    for kind in ("log", "file", "kafka", "aws_sqs", "google_pub_sub"):
+        if conf.get_bool(f"notification.{kind}.enabled"):
+            opts = conf.get(f"notification.{kind}") or {}
+            opts = {k: v for k, v in opts.items() if k != "enabled"}
+            return make_publisher(kind, **opts)
+    return None

@@ -57,6 +57,7 @@ class FilePublisher(Publisher):
     of the gocdk file backend; each line carries the serialized event."""
 
     def __init__(self, path: str):
+        self.path = path
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._f = open(path, "ab")
         self._lock = threading.Lock()

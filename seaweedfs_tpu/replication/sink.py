@@ -309,3 +309,61 @@ class AzureSink(Sink):
         except urllib.error.HTTPError as e:
             if e.code != 404:
                 raise
+
+
+def sink_from_config(conf):
+    """Build the one enabled [sink.*] of a replication.toml
+    (replication/replicator.go + scaffold.go replication template).
+    Returns (sink, label); raises if nothing is enabled."""
+    if conf.get_bool("sink.local.enabled"):
+        d = conf.get_string("sink.local.directory", "/backup")
+        return LocalSink(d), f"local:{d}"
+    if conf.get_bool("sink.filer.enabled"):
+        addr = conf.get_string("sink.filer.grpcAddress", "localhost:18888")
+        host, _, port_s = addr.partition(":")
+        try:
+            port = int(port_s)
+        except ValueError:
+            raise ValueError(
+                f"[sink.filer] grpcAddress {addr!r} must be host:port"
+            ) from None
+        # the toml records the gRPC port (reference schema); the sink
+        # speaks to the filer's HTTP port one offset below
+        http_addr = f"{host}:{port - 10000}" if port > 10000 else addr
+        return FilerSink(http_addr), f"filer:{addr}"
+    if conf.get_bool("sink.s3.enabled"):
+        endpoint = conf.get_string("sink.s3.endpoint", "localhost:8333")
+        bucket = conf.get_string("sink.s3.bucket", "backup")
+        return (S3Sink(endpoint, bucket,
+                       prefix=conf.get_string("sink.s3.directory", "")),
+                f"s3:{endpoint}/{bucket}")
+    if conf.get_bool("sink.google_cloud_storage.enabled"):
+        bucket = conf.get_string("sink.google_cloud_storage.bucket", "")
+        return (GcsSink(bucket,
+                        conf.get_string(
+                            "sink.google_cloud_storage.access_key", ""),
+                        conf.get_string(
+                            "sink.google_cloud_storage.secret_key", ""),
+                        prefix=conf.get_string(
+                            "sink.google_cloud_storage.directory", "")),
+                f"gcs:{bucket}")
+    if conf.get_bool("sink.azure.enabled"):
+        container = conf.get_string("sink.azure.container", "")
+        return (AzureSink(conf.get_string("sink.azure.account_name", ""),
+                          conf.get_string("sink.azure.account_key", ""),
+                          container,
+                          prefix=conf.get_string("sink.azure.directory", "")),
+                f"azure:{container}")
+    if conf.get_bool("sink.backblaze.enabled"):
+        bucket = conf.get_string("sink.backblaze.bucket", "")
+        return (B2Sink(conf.get_string("sink.backblaze.region",
+                                       "us-west-002"),
+                       bucket,
+                       conf.get_string("sink.backblaze.b2_account_id", ""),
+                       conf.get_string(
+                           "sink.backblaze.b2_master_application_key", ""),
+                       prefix=conf.get_string("sink.backblaze.directory",
+                                              "")),
+                f"b2:{bucket}")
+    raise ValueError(
+        f"no [sink.*] enabled in {conf.path or 'replication.toml'}")

@@ -639,17 +639,28 @@ def fs_meta_notify(env: CommandEnv, args: list[str]) -> str:
     if not _is_directory(client, path):
         raise ValueError(f"not a directory: {path}")
     conf = load_configuration("notification")
-    kind = opts.get("backend", conf.get_string("notification.kind", "log"))
-    pub_opts = {}
-    if isinstance(conf.get(f"notification.{kind}"), dict):
-        pub_opts = dict(conf.get(f"notification.{kind}"))
-    if "path" in opts:
-        pub_opts["path"] = opts["path"]
-    if kind == "file" and not pub_opts.get("path"):
-        raise ValueError(
-            "the file backend needs -path <events file> (or a "
-            "[notification.file] path in notification.toml)")
-    publisher = make_publisher(kind, **pub_opts)
+    kind = opts.get("backend", conf.get_string("notification.kind", ""))
+    publisher = None
+    if not kind:
+        # scaffolded schema: per-backend [notification.<kind>] enabled
+        # flags — the same selection the filer server makes
+        from ..notification import publisher_from_config
+
+        publisher = publisher_from_config(conf)
+        kind = "log"
+    if publisher is None:
+        pub_opts = {}
+        if isinstance(conf.get(f"notification.{kind}"), dict):
+            pub_opts = {k: v for k, v in
+                        conf.get(f"notification.{kind}").items()
+                        if k != "enabled"}
+        if "path" in opts:
+            pub_opts["path"] = opts["path"]
+        if kind == "file" and not pub_opts.get("path"):
+            raise ValueError(
+                "the file backend needs -path <events file> (or a "
+                "[notification.file] path in notification.toml)")
+        publisher = make_publisher(kind, **pub_opts)
     dirs = files = 0
     try:
         for fe in _walk_full_entries(client, path):

@@ -125,10 +125,101 @@ password = ""
 database = "seaweedfs"
 '''
 
+NOTIFICATION_TOML = '''\
+# notification.toml
+# Filer metadata events fan out to at most one enabled queue
+# (weed scaffold -config=notification analogue).
+
+[notification.log]
+# Print events to the filer's log.
+enabled = false
+
+[notification.file]
+# Append JSON events to a local file.
+enabled = false
+path = "./filer_events.jsonl"
+
+[notification.kafka]
+# Needs a reachable Kafka broker.
+enabled = false
+hosts = "kafka1:9092"
+topic = "seaweedfs_filer"
+
+[notification.aws_sqs]
+# Signed with the framework's own SigV4; no AWS SDK required.
+enabled = false
+aws_access_key_id = ""
+aws_secret_access_key = ""
+region = "us-east-2"
+sqs_queue_url = ""
+
+[notification.google_pub_sub]
+enabled = false
+project_id = ""
+topic = "seaweedfs_filer"
+'''
+
+REPLICATION_TOML = '''\
+# replication.toml
+# Where `filer.replicate` replays filer events; one enabled sink.
+
+[source.filer]
+enabled = true
+grpcAddress = "localhost:18888"
+directory = "/buckets"
+
+[sink.local]
+enabled = false
+directory = "/backup"
+
+[sink.filer]
+enabled = false
+grpcAddress = "localhost:18888"
+directory = "/backup"
+
+[sink.s3]
+# Any S3-compatible endpoint (framework-native SigV4 client).
+enabled = false
+endpoint = "localhost:8333"
+bucket = "backup"
+directory = ""
+
+[sink.google_cloud_storage]
+enabled = false
+bucket = ""
+directory = ""
+
+[sink.azure]
+enabled = false
+account_name = ""
+account_key = ""
+container = ""
+directory = ""
+
+[sink.backblaze]
+enabled = false
+b2_account_id = ""
+b2_master_application_key = ""
+bucket = ""
+directory = ""
+'''
+
+SHELL_TOML = '''\
+# shell.toml
+# Defaults for `weed shell` when -master/-filer flags are omitted.
+
+[cluster.default]
+master = "localhost:9333"
+filer = "localhost:8888"
+'''
+
 TEMPLATES = {
     "security": SECURITY_TOML,
     "master": MASTER_TOML,
     "filer": FILER_TOML,
+    "notification": NOTIFICATION_TOML,
+    "replication": REPLICATION_TOML,
+    "shell": SHELL_TOML,
 }
 
 

@@ -153,3 +153,44 @@ def test_mtls_rejects_unauthenticated_client(tmp_path, plaintext_rpc):
         ch.close()
     finally:
         server.stop(0)
+
+
+def test_scaffold_notification_replication_shell(tmp_path, monkeypatch):
+    """scaffold emits notification/replication/shell TOMLs and the
+    factories build the enabled backend from them
+    (command/scaffold.go parity)."""
+    from seaweedfs_tpu.notification import publisher_from_config
+    from seaweedfs_tpu.notification.publishers import FilePublisher
+    from seaweedfs_tpu.replication.sink import LocalSink, sink_from_config
+    from seaweedfs_tpu.util.config import load_configuration
+    from seaweedfs_tpu.util.scaffold import scaffold
+
+    for kind in ("notification", "replication", "shell"):
+        text = scaffold(kind)
+        (tmp_path / f"{kind}.toml").write_text(text)
+
+    # enable the file publisher (into tmp_path, not the CWD) + local sink
+    n = (tmp_path / "notification.toml").read_text().replace(
+        "[notification.file]\n# Append JSON events to a local file.\n"
+        "enabled = false\npath = \"./filer_events.jsonl\"",
+        "[notification.file]\nenabled = true\n"
+        f"path = \"{tmp_path}/filer_events.jsonl\"")
+    (tmp_path / "notification.toml").write_text(n)
+    r = (tmp_path / "replication.toml").read_text().replace(
+        "[sink.local]\nenabled = false",
+        "[sink.local]\nenabled = true")
+    (tmp_path / "replication.toml").write_text(r)
+
+    paths = [str(tmp_path)]
+    nconf = load_configuration("notification", search_paths=paths)
+    pub = publisher_from_config(nconf)
+    assert isinstance(pub, FilePublisher)
+    assert str(tmp_path) in pub.path
+    pub.close()
+
+    rconf = load_configuration("replication", search_paths=paths)
+    sink, label = sink_from_config(rconf)
+    assert isinstance(sink, LocalSink) and label.startswith("local:")
+
+    sconf = load_configuration("shell", search_paths=paths)
+    assert sconf.get_string("cluster.default.master") == "localhost:9333"
