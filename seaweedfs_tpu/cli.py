@@ -270,6 +270,23 @@ def cmd_filer_replicate(args) -> None:
                 "either give both flags or configure replication.toml")
         conf = load_configuration("replication", required=True)
         sink, label = sink_from_config(conf)
+        # [source.filer] wins over flag DEFAULTS in toml mode, so the
+        # scaffolded source section is honored, not silently ignored
+        if conf.get_bool("source.filer.enabled"):
+            addr = conf.get_string("source.filer.grpcAddress", "")
+            if addr and args.filer == "127.0.0.1:8888":
+                host, _, port_s = addr.partition(":")
+                try:
+                    port = int(port_s)
+                except ValueError:
+                    raise SystemExit(
+                        f"[source.filer] grpcAddress {addr!r} must be "
+                        "host:port") from None
+                args.filer = (f"{host}:{port - 10000}" if port > 10000
+                              else addr)
+            if args.filerPath == "/":
+                args.filerPath = conf.get_string("source.filer.directory",
+                                                 "/")
     rep = Replicator(FilerSource(args.filer), sink, args.filerPath)
     print(f"replicating {args.filer}{args.filerPath} -> {label}")
     rep.run()

@@ -55,14 +55,30 @@ class Replicator:
 
     def run(self, stop_event: threading.Event | None = None,
             since_ns: int = 0) -> None:
-        """Consume the source filer's metadata stream until stopped."""
-        for resp in subscribe_metadata(
-            self.source.filer_http, self.path_prefix, since_ns,
-            signature=self.signature,
-        ):
-            if stop_event is not None and stop_event.is_set():
-                return
-            try:
-                self.process_event(resp.directory, resp.event_notification)
-            except Exception as e:
-                glog.warning("replicate %s failed: %s", resp.directory, e)
+        """Consume the source filer's metadata stream until stopped.
+        A dropped subscription (source filer restarting or shutting down)
+        ends the loop instead of escaping a worker thread."""
+        import grpc
+
+        received = 0
+        try:
+            for resp in subscribe_metadata(
+                self.source.filer_http, self.path_prefix, since_ns,
+                signature=self.signature,
+            ):
+                received += 1
+                if stop_event is not None and stop_event.is_set():
+                    return
+                try:
+                    self.process_event(resp.directory,
+                                       resp.event_notification)
+                except Exception as e:
+                    glog.warning("replicate %s failed: %s",
+                                 resp.directory, e)
+        except grpc.RpcError as e:
+            if received == 0 and e.code() != grpc.StatusCode.CANCELLED:
+                # never connected: an unreachable source must surface as
+                # an error, not a silent zero-event success
+                raise
+            glog.info("replicate stream from %s ended after %d events: %s",
+                      self.source.filer_http, received, e.code())

@@ -640,6 +640,8 @@ def fs_meta_notify(env: CommandEnv, args: list[str]) -> str:
         raise ValueError(f"not a directory: {path}")
     conf = load_configuration("notification")
     kind = opts.get("backend", conf.get_string("notification.kind", ""))
+    if not kind and "path" in opts:
+        kind = "file"  # an explicit -path must win over toml selection
     publisher = None
     if not kind:
         # scaffolded schema: per-backend [notification.<kind>] enabled

@@ -153,10 +153,8 @@ aws_secret_access_key = ""
 region = "us-east-2"
 sqs_queue_url = ""
 
-[notification.google_pub_sub]
-enabled = false
-project_id = ""
-topic = "seaweedfs_filer"
+# (google_pub_sub exists in code but needs a programmatic OAuth token
+# source, which a static TOML cannot supply — configure it in-process.)
 '''
 
 REPLICATION_TOML = '''\
@@ -185,8 +183,11 @@ bucket = "backup"
 directory = ""
 
 [sink.google_cloud_storage]
+# HMAC interoperability credentials (S3-compat XML API).
 enabled = false
 bucket = ""
+access_key = ""
+secret_key = ""
 directory = ""
 
 [sink.azure]
@@ -200,6 +201,7 @@ directory = ""
 enabled = false
 b2_account_id = ""
 b2_master_application_key = ""
+region = "us-west-002"
 bucket = ""
 directory = ""
 '''

@@ -384,11 +384,13 @@ def test_follower_redirects_admin_endpoints(tmp_path):
         for path in ("/dir/assign", "/vol/grow?collection=x",
                      "/vol/status"):
             try:
-                r = opener.open(
-                    f"http://127.0.0.1:{follower.port}{path}", timeout=5)
-                code, loc = r.status, r.headers.get("Location", "")
+                with opener.open(
+                        f"http://127.0.0.1:{follower.port}{path}",
+                        timeout=5) as r:
+                    code, loc = r.status, r.headers.get("Location", "")
             except urllib.error.HTTPError as e:
                 code, loc = e.code, e.headers.get("Location", "")
+                e.close()
             assert code == 307, (path, code)
             assert loc.startswith(expect), (path, loc)
         # POST /submit with a body: redirect + the body must be drained
@@ -396,10 +398,11 @@ def test_follower_redirects_admin_endpoints(tmp_path):
             f"http://127.0.0.1:{follower.port}/submit",
             data=b"x" * 100000, method="POST")
         try:
-            r = opener.open(req, timeout=5)
-            code = r.status
+            with opener.open(req, timeout=5) as r:
+                code = r.status
         except urllib.error.HTTPError as e:
             code = e.code
+            e.close()
         assert code == 307
         # healthz: follower knowing a leader is healthy
         with urllib.request.urlopen(
