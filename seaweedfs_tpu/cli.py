@@ -270,25 +270,30 @@ def cmd_filer_replicate(args) -> None:
                 "either give both flags or configure replication.toml")
         conf = load_configuration("replication", required=True)
         sink, label = sink_from_config(conf)
+        # (explicit flags always win; toml fills only omitted ones)
         # [source.filer] wins over flag DEFAULTS in toml mode, so the
         # scaffolded source section is honored, not silently ignored
         if conf.get_bool("source.filer.enabled"):
             addr = conf.get_string("source.filer.grpcAddress", "")
-            if addr and args.filer == "127.0.0.1:8888":
+            if addr and args.filer is None:
                 host, _, port_s = addr.partition(":")
                 try:
                     port = int(port_s)
+                    if port <= 10000:
+                        raise ValueError
                 except ValueError:
                     raise SystemExit(
                         f"[source.filer] grpcAddress {addr!r} must be "
-                        "host:port") from None
-                args.filer = (f"{host}:{port - 10000}" if port > 10000
-                              else addr)
-            if args.filerPath == "/":
+                        "host:port with the gRPC port (HTTP port + "
+                        "10000)") from None
+                args.filer = f"{host}:{port - 10000}"
+            if args.filerPath is None:
                 args.filerPath = conf.get_string("source.filer.directory",
                                                  "/")
-    rep = Replicator(FilerSource(args.filer), sink, args.filerPath)
-    print(f"replicating {args.filer}{args.filerPath} -> {label}")
+    src_filer = args.filer or "127.0.0.1:8888"
+    src_path = args.filerPath or "/"
+    rep = Replicator(FilerSource(src_filer), sink, src_path)
+    print(f"replicating {src_filer}{src_path} -> {label}")
     rep.run()
 
 
@@ -714,8 +719,12 @@ def main(argv=None) -> None:
     mb.set_defaults(fn=cmd_msg_broker)
 
     fr = sub.add_parser("filer.replicate")
-    fr.add_argument("-filer", default="127.0.0.1:8888")
-    fr.add_argument("-filerPath", default="/")
+    fr.add_argument("-filer", default=None,
+                    help="source filer ip:port (omitted -> "
+                         "replication.toml, then 127.0.0.1:8888)")
+    fr.add_argument("-filerPath", default=None,
+                    help="source path (omitted -> replication.toml, "
+                         "then /)")
     fr.add_argument("-sink.type", dest="sink_type", default="",
                     choices=["", "local", "filer", "s3"],
                     help="with -sink; defaults to local")

@@ -56,29 +56,44 @@ class Replicator:
     def run(self, stop_event: threading.Event | None = None,
             since_ns: int = 0) -> None:
         """Consume the source filer's metadata stream until stopped.
-        A dropped subscription (source filer restarting or shutting down)
-        ends the loop instead of escaping a worker thread."""
+
+        A source that was NEVER reachable raises (an unreachable filer
+        must not look like a successful zero-event replication).  A
+        stream dropped after traffic — source restart, network blip —
+        RESUBSCRIBES from the last applied event timestamp with a short
+        backoff, the reference's filer.sync reconnect discipline."""
+        import time as _time
+
         import grpc
 
-        received = 0
-        try:
-            for resp in subscribe_metadata(
-                self.source.filer_http, self.path_prefix, since_ns,
-                signature=self.signature,
-            ):
-                received += 1
+        ever_received = False
+        resume_ns = since_ns
+        while True:
+            try:
+                for resp in subscribe_metadata(
+                    self.source.filer_http, self.path_prefix, resume_ns,
+                    signature=self.signature,
+                ):
+                    ever_received = True
+                    resume_ns = max(resume_ns, resp.ts_ns)
+                    if stop_event is not None and stop_event.is_set():
+                        return
+                    try:
+                        self.process_event(resp.directory,
+                                           resp.event_notification)
+                    except Exception as e:
+                        glog.warning("replicate %s failed: %s",
+                                     resp.directory, e)
+                return  # server closed the stream cleanly
+            except grpc.RpcError as e:
+                if e.code() == grpc.StatusCode.CANCELLED:
+                    return
+                if not ever_received:
+                    raise
                 if stop_event is not None and stop_event.is_set():
                     return
-                try:
-                    self.process_event(resp.directory,
-                                       resp.event_notification)
-                except Exception as e:
-                    glog.warning("replicate %s failed: %s",
-                                 resp.directory, e)
-        except grpc.RpcError as e:
-            if received == 0 and e.code() != grpc.StatusCode.CANCELLED:
-                # never connected: an unreachable source must surface as
-                # an error, not a silent zero-event success
-                raise
-            glog.info("replicate stream from %s ended after %d events: %s",
-                      self.source.filer_http, received, e.code())
+                glog.warning(
+                    "replicate stream from %s dropped (%s); resuming "
+                    "from ts=%d", self.source.filer_http, e.code(),
+                    resume_ns)
+                _time.sleep(1.747)

@@ -639,9 +639,12 @@ def fs_meta_notify(env: CommandEnv, args: list[str]) -> str:
     if not _is_directory(client, path):
         raise ValueError(f"not a directory: {path}")
     conf = load_configuration("notification")
-    kind = opts.get("backend", conf.get_string("notification.kind", ""))
-    if not kind and "path" in opts:
-        kind = "file"  # an explicit -path must win over toml selection
+    if "backend" in opts:
+        kind = opts["backend"]
+    elif "path" in opts:
+        kind = "file"  # an explicit -path always wins over toml selection
+    else:
+        kind = conf.get_string("notification.kind", "")
     publisher = None
     if not kind:
         # scaffolded schema: per-backend [notification.<kind>] enabled

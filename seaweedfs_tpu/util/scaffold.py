@@ -73,8 +73,10 @@ sequencer_snowflake_id = 0
 # The erasure-coding codec volume servers use for bulk encode/rebuild
 # (flag -ec.codec overrides).
 [codec]
-# cpu | tpu | tpu_xor | tpu_mxu
-type = "tpu"
+# auto | cpu | tpu | tpu_xor | tpu_mxu — auto probes one timed encode
+# round trip and picks the faster of the device and host-SIMD codecs
+# for this machine.
+type = "auto"
 '''
 
 FILER_TOML = '''\
