@@ -276,17 +276,19 @@ def cmd_filer_replicate(args) -> None:
         if conf.get_bool("source.filer.enabled"):
             addr = conf.get_string("source.filer.grpcAddress", "")
             if addr and args.filer is None:
+                from .replication.source import GRPC_PORT_OFFSET
+
                 host, _, port_s = addr.partition(":")
                 try:
                     port = int(port_s)
-                    if port <= 10000:
+                    if port <= GRPC_PORT_OFFSET:
                         raise ValueError
                 except ValueError:
                     raise SystemExit(
                         f"[source.filer] grpcAddress {addr!r} must be "
                         "host:port with the gRPC port (HTTP port + "
-                        "10000)") from None
-                args.filer = f"{host}:{port - 10000}"
+                        f"{GRPC_PORT_OFFSET})") from None
+                args.filer = f"{host}:{port - GRPC_PORT_OFFSET}"
             if args.filerPath is None:
                 args.filerPath = conf.get_string("source.filer.directory",
                                                  "/")

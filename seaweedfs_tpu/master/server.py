@@ -447,9 +447,12 @@ class MasterServer:
         while not self._stop.wait(self.maintenance_interval):
             try:
                 env = CommandEnv(f"{self.ip}:{self.grpc_port}")
-                run_maintenance(env, script=self.maintenance_script)
-            except Exception:
-                pass
+                for line in run_maintenance(env,
+                                            script=self.maintenance_script):
+                    if glog.V(1):
+                        glog.info("maintenance: %s", line)
+            except Exception as e:  # the loop must survive, not go mute
+                glog.warning("maintenance run failed: %s", e)
 
     # -- admin lock -------------------------------------------------------
 

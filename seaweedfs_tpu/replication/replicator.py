@@ -96,4 +96,8 @@ class Replicator:
                     "replicate stream from %s dropped (%s); resuming "
                     "from ts=%d", self.source.filer_http, e.code(),
                     resume_ns)
-                _time.sleep(1.747)
+                if stop_event is not None:
+                    if stop_event.wait(1.747):
+                        return
+                else:
+                    _time.sleep(1.747)

@@ -73,24 +73,38 @@ def collect_volume_ids_for_ec_encode(
     volume_size_limit: int,
     full_percent: float,
     collection: str = "",
+    quiet_for_seconds: float = 0,
+    now: float | None = None,
 ) -> list[int]:
-    """Pure selection logic (tier-3 testable): volumes full enough to freeze."""
+    """Pure selection logic (tier-3 testable): volumes full enough to
+    freeze AND quiet for the requested window — encoding a volume under
+    an active write burst would readonly it mid-stream
+    (command_ec_encode.go collectVolumeIdsForEcEncode)."""
+    if now is None:
+        now = time.time()
     vids = set()
     for _dc, _rack, dn in _iter_nodes(topo):
         for disk in dn.disk_infos.values():
             for v in disk.volume_infos:
                 if collection and v.collection != collection:
                     continue
-                if v.size >= volume_size_limit * full_percent / 100.0:
-                    vids.add(v.id)
+                if v.size < volume_size_limit * full_percent / 100.0:
+                    continue
+                if (quiet_for_seconds > 0 and v.modified_at_second
+                        and now - v.modified_at_second < quiet_for_seconds):
+                    continue
+                vids.add(v.id)
     return sorted(vids)
 
 
 @register("ec.encode")
 def ec_encode(env: CommandEnv, args: list[str]) -> str:
+    from .fs_commands import _parse_duration
+
     flags = _parse_flags(args)
     collection = flags.get("collection", "")
     full_percent = float(flags.get("fullPercent", "95"))
+    quiet_for = _parse_duration(flags.get("quietFor", "0"))
     codec = flags.get("codec", "")
     explicit_vid = int(flags["volumeId"]) if "volumeId" in flags else None
 
@@ -100,11 +114,21 @@ def ec_encode(env: CommandEnv, args: list[str]) -> str:
         vids = [explicit_vid]
     else:
         vids = collect_volume_ids_for_ec_encode(
-            topo, limit, full_percent, collection
+            topo, limit, full_percent, collection,
+            quiet_for_seconds=quiet_for,
         )
+    # every volume encodes under its OWN collection — the flag only
+    # FILTERS the selection; passing it through verbatim would generate
+    # shards under one name and try to mount them under another
+    vid_collection: dict[int, str] = {}
+    for _dc, _rack, dn in _iter_nodes(topo):
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                vid_collection[v.id] = v.collection
     out = []
     for vid in vids:
-        out.append(do_ec_encode(env, topo, vid, collection, codec))
+        out.append(do_ec_encode(
+            env, topo, vid, vid_collection.get(vid, collection), codec))
     return "\n".join(out) if out else "ec.encode: no volumes selected"
 
 
@@ -121,6 +145,16 @@ def do_ec_encode(env: CommandEnv, topo, vid: int, collection: str,
             locations = [loc.url for loc in entry.locations]
     if not locations:
         return f"ec.encode {vid}: no locations"
+    if not collection:
+        # a volume outside the heartbeat snapshot (LookupVolume fallback)
+        # must still encode under its OWN collection — ask its holder
+        try:
+            st = env.volume_server(_node_grpc(locations[0])) \
+                .ReadVolumeFileStatus(
+                    vs.ReadVolumeFileStatusRequest(volume_id=vid))
+            collection = st.collection
+        except grpc.RpcError:
+            pass
     # 1. freeze writes on every replica
     for loc in locations:
         env.volume_server(_node_grpc(loc)).VolumeMarkReadonly(

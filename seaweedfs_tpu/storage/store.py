@@ -404,6 +404,7 @@ class Store:
                     version=v.version,
                     ttl=v.super_block.ttl.to_uint32(),
                     compact_revision=v.super_block.compaction_revision,
+                    modified_at_second=v.last_modified_second,
                 )
             for vid, ev in loc.ec_volumes.items():
                 hb.ec_shards.add(

@@ -70,6 +70,13 @@ class Volume:
                     self._dat.read_at(0, 64)
                 )
         self.version = self.super_block.version
+        # quiet-window bookkeeping for ec.encode -quietFor: seed from the
+        # .dat mtime at load so a restart doesn't reset the quiet clock
+        try:
+            self.last_modified_second = int(
+                os.path.getmtime(base + ".dat"))
+        except OSError:
+            self.last_modified_second = int(time.time())
         kind = DEFAULT_NEEDLE_MAP_KIND
         if kind == "disk":
             from .disk_needle_map import DiskNeedleMap
@@ -133,6 +140,7 @@ class Volume:
                 raise IOError("volume size limit exceeded")
             if not n.append_at_ns:
                 n.append_at_ns = time.time_ns()
+            self.last_modified_second = int(time.time())
             blob = n.to_bytes(self.version)
             self._dat.write_at(offset, blob)
             old = self.needle_map.get(n.id)
@@ -161,6 +169,7 @@ class Volume:
             self._dat.write_at(offset, marker.to_bytes(self.version))
             self.needle_map.delete(needle_id)
             self._idx.delete(needle_id, offset)
+            self.last_modified_second = int(time.time())
             return max(existing.size, 0)
 
     # -- read path --------------------------------------------------------

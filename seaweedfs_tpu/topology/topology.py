@@ -29,6 +29,7 @@ class VolumeInfo:
     version: int = 3
     ttl: int = 0
     compact_revision: int = 0
+    modified_at_second: int = 0
 
     @classmethod
     def from_pb(cls, m: master_pb2.VolumeInformationMessage) -> "VolumeInfo":
@@ -37,6 +38,7 @@ class VolumeInfo:
             size=m.size,
             collection=m.collection,
             file_count=m.file_count,
+            modified_at_second=m.modified_at_second,
             delete_count=m.delete_count,
             deleted_byte_count=m.deleted_byte_count,
             read_only=m.read_only,
@@ -218,6 +220,7 @@ class Topology:
                         replica_placement=v.replica_placement,
                         version=v.version,
                         ttl=v.ttl,
+                        modified_at_second=v.modified_at_second,
                     )
                 for vid, bits in n.ec_shards.items():
                     disk.ec_shard_infos.add(

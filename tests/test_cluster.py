@@ -535,3 +535,46 @@ def test_volume_evacuate(cluster):
     target = next(s for s in others if s.store.find_volume(vid))
     code, body = _http("GET", f"http://127.0.0.1:{target.port}/{fid}")
     assert code == 200 and body == b"evac!"
+
+
+def test_maintenance_loop_encodes_automatically(tmp_path_factory):
+    """The master's periodic [master.maintenance] script runs ec.encode
+    over full volumes without any operator action — the reference's
+    production EC entry point (master_server.go:187-242, SURVEY §5.3)."""
+    master = MasterServer(
+        ip="127.0.0.1", port=_free_port(), volume_size_limit_mb=1,
+        maintenance_interval=1.0,
+        maintenance_script=["ec.encode -fullPercent=50 -quietFor=0"],
+    )
+    master.start()
+    vs_ = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("mvol"))],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=_free_port(), pulse_seconds=0.5,
+        max_volume_count=40,
+    )
+    vs_.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and len(master.topo.nodes) < 1:
+            time.sleep(0.1)
+        # fill one volume past 50% of the 1MB size limit
+        a = _assign(master, collection="auto")
+        vid = int(a["fid"].split(",")[0])
+        payload = b"m" * (700 << 10)
+        code, _ = _http("POST", f"http://{a['url']}/{a['fid']}", payload)
+        assert code == 201
+        # the loop must freeze + encode it without any shell interaction
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if len(master.topo.lookup_ec_shards(vid)) == 14:
+                break
+            time.sleep(0.5)
+        assert len(master.topo.lookup_ec_shards(vid)) == 14, (
+            "maintenance loop did not EC-encode the full volume")
+        # the blob survives through the EC read path
+        code, got = _http("GET", f"http://{a['url']}/{a['fid']}")
+        assert code == 200 and got == payload
+    finally:
+        vs_.stop()
+        master.stop()

@@ -65,3 +65,43 @@ def test_graft_entry_contract():
     assert out.shape == (4, args[0].shape[1])
     assert out.dtype == np.uint8
     g.dryrun_multichip(8)
+
+
+def test_ec_encode_selection_full_and_quiet():
+    """collect_volume_ids_for_ec_encode: full-percent threshold, collection
+    filter, and the -quietFor window over modified_at_second (pure tier-3
+    logic, command_ec_encode.go collectVolumeIdsForEcEncode)."""
+    from seaweedfs_tpu.pb import master_pb2
+    from seaweedfs_tpu.shell.ec_commands import (
+        collect_volume_ids_for_ec_encode,
+    )
+
+    now = 10_000
+    topo = master_pb2.TopologyInfo(id="topo")
+    dn = (topo.data_center_infos.add(id="dc")
+          .rack_infos.add(id="r").data_node_infos.add(id="n1"))
+    disk = dn.disk_infos[""]
+    # (vid, size, collection, modified_at)
+    for vid, size, coll, mod in (
+        (1, 95, "a", now - 7200),   # full + quiet -> selected
+        (2, 95, "a", now - 60),     # full but ACTIVE -> skipped by quietFor
+        (3, 10, "a", now - 7200),   # quiet but not full -> skipped
+        (4, 95, "b", now - 7200),   # wrong collection when filtered
+    ):
+        disk.volume_infos.add(id=vid, size=size, collection=coll,
+                              modified_at_second=mod)
+
+    got = collect_volume_ids_for_ec_encode(
+        topo, volume_size_limit=100, full_percent=90, collection="a",
+        quiet_for_seconds=3600, now=now)
+    assert got == [1]
+    # without the quiet window the active volume is selected too
+    got = collect_volume_ids_for_ec_encode(
+        topo, volume_size_limit=100, full_percent=90, collection="a",
+        quiet_for_seconds=0, now=now)
+    assert got == [1, 2]
+    # no collection filter picks up 'b' as well
+    got = collect_volume_ids_for_ec_encode(
+        topo, volume_size_limit=100, full_percent=90,
+        quiet_for_seconds=3600, now=now)
+    assert got == [1, 4]
