@@ -501,6 +501,48 @@ def test_deleted_volume_leaves_writable_set(cluster):
     assert gone, "deleted volume still registered to its old holder"
 
 
+def test_master_vacuum_orchestration(cluster):
+    """Leader-driven Check -> Compact -> Commit over gRPC reclaims
+    tombstoned bytes and keeps survivors readable
+    (topology_vacuum.go:147-167)."""
+    master, servers = cluster
+    fids = []
+    for i in range(10):
+        a = _assign(master, collection="vac")
+        payload = (f"vacuum-{i}-".encode() * 300)[:2500]
+        code, _ = _http("POST", f"http://{a['url']}/{a['fid']}", payload)
+        assert code == 201
+        fids.append((a, payload))
+    vid = int(fids[0][0]["fid"].split(",")[0])
+    holder = next(s for s in servers if s.store.find_volume(vid) is not None)
+    size_before = holder.store.find_volume(vid).content_size
+    # the periodic sweep only sees volumes the heartbeat has registered;
+    # wait for the fresh volume to land in the topology first
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if any(vid in n.volumes for n in master.topo.nodes.values()):
+            break
+        time.sleep(0.1)
+    # delete 8 of 10 -> ~80% garbage
+    for a, _p in fids[:8]:
+        code, _ = _http("DELETE", f"http://{a['url']}/{a['fid']}")
+        assert code == 202
+    code, body = _http(
+        "GET",
+        f"http://127.0.0.1:{master.port}/vol/vacuum?garbageThreshold=0.3")
+    assert code == 200
+    vacuumed = json.loads(body)["vacuumed"]
+    assert vid in vacuumed, (vacuumed, vid)
+    v = holder.store.find_volume(vid)
+    assert v.content_size < size_before, "vacuum did not shrink the volume"
+    # survivors still readable, deleted still 404
+    for a, payload in fids[8:]:
+        code, got = _http("GET", f"http://{a['url']}/{a['fid']}")
+        assert code == 200 and got == payload
+    code, _ = _http("GET", f"http://{fids[0][0]['url']}/{fids[0][0]['fid']}")
+    assert code == 404
+
+
 def test_volume_evacuate(cluster):
     """Moves all volumes off a node and tells it to leave
     (command_volume_server_evacuate.go).  Runs LAST: the evacuated node
