@@ -543,6 +543,40 @@ def test_master_vacuum_orchestration(cluster):
     assert code == 404
 
 
+def test_fix_replication_restores_lost_replica(cluster):
+    """volume.fix.replication copies an under-replicated volume to a new
+    node and the data survives (command_volume_fix_replication.go)."""
+    master, servers = cluster
+    a = _assign(master, replication="001", collection="fixrep")
+    payload = b"replica payload " * 64
+    code, _ = _http("POST", f"http://{a['url']}/{a['fid']}", payload)
+    assert code == 201
+    vid = int(a["fid"].split(",")[0])
+    holders = [s for s in servers if s.store.find_volume(vid) is not None]
+    assert len(holders) == 2
+    # lose one replica and wait for the topology to notice
+    victim = holders[1]
+    victim.store.delete_volume(vid)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        topo_holders = [n.id for n in master.topo.nodes.values()
+                        if vid in n.volumes]
+        if len(topo_holders) == 1:
+            break
+        time.sleep(0.2)
+    assert len(topo_holders) == 1, topo_holders
+
+    env = CommandEnv(f"127.0.0.1:{master.grpc_port}")
+    out = run_command(env, "volume.fix.replication")
+    assert f"{vid}: copied to" in out, out
+    holders_after = [s for s in servers
+                     if s.store.find_volume(vid) is not None]
+    assert len(holders_after) == 2
+    for s_ in holders_after:
+        code, got = _http("GET", f"http://127.0.0.1:{s_.port}/{a['fid']}")
+        assert code == 200 and got == payload
+
+
 def test_volume_evacuate(cluster):
     """Moves all volumes off a node and tells it to leave
     (command_volume_server_evacuate.go).  Runs LAST: the evacuated node
