@@ -472,6 +472,21 @@ class VolumeGrpcService:
                 v.version, verify=False,
             )
             if n.size > 0:
+                # replicas can hold the same needle under different append
+                # timestamps (fan-out re-stamps); re-appending an extant
+                # IDENTICAL record would balloon the .dat on every resync
+                # and leave the replicas byte-diverged forever.  Size alone
+                # is not identity — a same-length overwrite must still
+                # land — so matched candidates compare content.
+                existing = v.needle_map.get(n.id)
+                if existing is not None and existing.size == n.size:
+                    try:
+                        local = v.read_needle(n.id)
+                        if (local.cookie == full.cookie
+                                and local.checksum == full.checksum):
+                            continue
+                    except Exception:  # unreadable local copy: replace it
+                        pass
                 v.append_needle(full)
             else:
                 # carry the origin's tombstone timestamp — a local stamp

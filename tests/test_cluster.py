@@ -577,6 +577,71 @@ def test_fix_replication_restores_lost_replica(cluster):
         assert code == 200 and got == payload
 
 
+def test_fsck_check_disk_and_collection_delete(cluster):
+    """volume.fsck reports a diverged replica, volume.check.disk -force
+    tail-syncs it back, and collection.delete removes a collection's
+    volumes cluster-wide including the master's layouts."""
+    master, servers = cluster
+    env = CommandEnv(f"127.0.0.1:{master.grpc_port}")
+
+    # --- fsck + check.disk over a manufactured divergence
+    a = _assign(master, replication="001", collection="fsck")
+    vid = int(a["fid"].split(",")[0])
+    code, _ = _http("POST", f"http://{a['url']}/{a['fid']}",
+                    b"first write")
+    assert code == 201
+    holders = [s for s in servers if s.store.find_volume(vid) is not None]
+    assert len(holders) == 2
+    # the fsck sweep walks the TOPOLOGY; wait until both replicas'
+    # heartbeats have registered the volume
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if sum(vid in n.volumes
+               for n in master.topo.nodes.values()) == 2:
+            break
+        time.sleep(0.2)
+    # write a SECOND needle to only one replica (?type=replicate marks
+    # it as an already-fanned-out replica write, so no fan-out happens)
+    fid2 = f"{vid},1f00000002"
+    code, _ = _http(
+        "POST",
+        f"http://127.0.0.1:{holders[0].port}/{fid2}?type=replicate",
+        b"diverged write")
+    assert code == 201
+    out = run_command(env, "volume.fsck")
+    assert f"volume {vid} diverged" in out, out
+    # a transient tail-connect failure surfaces as "sync failed" in the
+    # command output; retry the repair a couple of times before judging
+    deadline = time.time() + 20
+    synced = False
+    while time.time() < deadline:
+        out = run_command(env, "volume.check.disk -force")
+        if f"volume {vid}: synced" in out:
+            synced = True
+        if (holders[1].store.find_volume(vid) is not None
+                and holders[1].store.find_volume(vid).file_count()
+                == holders[0].store.find_volume(vid).file_count()):
+            break
+        time.sleep(0.5)
+    assert synced, out
+    assert (holders[1].store.find_volume(vid).file_count()
+            == holders[0].store.find_volume(vid).file_count())
+    out = run_command(env, "volume.fsck")
+    assert f"volume {vid} diverged" not in out, out
+
+    # --- collection.delete sweeps servers and layouts
+    out = run_command(env, "collection.delete fsck")
+    assert "deleted" in out
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if all(s.store.find_volume(vid) is None for s in servers):
+            break
+        time.sleep(0.2)
+    assert all(s.store.find_volume(vid) is None for s in servers)
+    assert not [l for (c, _r, _t), l in master.layouts.items()
+                if c == "fsck" and l.locations]
+
+
 def test_volume_evacuate(cluster):
     """Moves all volumes off a node and tells it to leave
     (command_volume_server_evacuate.go).  Runs LAST: the evacuated node
