@@ -1,0 +1,162 @@
+"""File-level batch EC encode: many volumes through one sharded dispatch.
+
+BASELINE config 4 ("batch ec.encode of 64 volumes sharded across v5e-8
+over ICI") as a user-facing flow, not just the dryrun: given N volume
+base paths, each slice step stacks the v-th stripe slice of every volume
+into one (V, 10, W) block, runs the mesh-sharded GF encode (V over
+``dp``, columns over ``sp`` — zero collectives, parity is columnwise),
+and appends each volume's data+parity to its own `.ec00`–`.ec13` files.
+
+Volumes of different sizes batch together: slices past a volume's end are
+zero-padded on the way in and trimmed on the way out, so the shard files
+are byte-identical to a per-volume `generate_ec_files` run (pinned in
+tests/test_parallel.py).  Stripe geometry is shared with the serial
+encoder (`_slice_tasks` + `fill_stripe_rows`), so the two paths cannot
+drift.
+
+``slice_size`` is the TOTAL per-shard step budget across all volumes:
+the per-volume slice narrows as the batch widens, keeping the host-side
+step buffer at ~10*slice_size bytes whether 1 volume or 64 are batched.
+Shard writes run on their own thread, overlapping the next step's reads
+and device encode (same reasoning as the serial pipeline: on write-bound
+disks this is the difference between sum and max of the stages).
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+
+import numpy as np
+
+from ..storage.ec.constants import (
+    DATA_SHARDS,
+    LARGE_BLOCK_SIZE,
+    SMALL_BLOCK_SIZE,
+    TOTAL_SHARDS,
+    to_ext,
+)
+from ..storage.ec.encoder import (
+    DEFAULT_SLICE,
+    _slice_tasks,
+    fill_stripe_rows,
+)
+from .mesh import batch_encode_sharded, make_mesh
+
+
+def batch_generate_ec_files(
+    bases: list[str],
+    mesh=None,
+    large_block_size: int = LARGE_BLOCK_SIZE,
+    small_block_size: int = SMALL_BLOCK_SIZE,
+    slice_size: int = DEFAULT_SLICE,
+    progress=None,
+) -> None:
+    """Encode every `<base>.dat` into `<base>.ec00`..`.ec13`, batched.
+
+    `progress(volume_bytes_done_total)` fires after each batched step's
+    bytes hit the output files (real bytes only, padding excluded).
+    """
+    if not bases:
+        return
+    if mesh is None:
+        mesh = make_mesh()
+    dp = mesh.shape["dp"]
+
+    # total step budget -> per-volume slice, floored to one small block so
+    # row batching still engages
+    per_vol_slice = max(slice_size // len(bases), small_block_size)
+
+    vols = []
+    try:
+        for base in bases:
+            dat_size = os.path.getsize(base + ".dat")
+            v = {"f": open(base + ".dat", "rb"), "outs": [],
+                 "dat_size": dat_size, "consumed": 0,
+                 "tasks": list(_slice_tasks(dat_size, large_block_size,
+                                            small_block_size,
+                                            per_vol_slice))}
+            vols.append(v)  # registered BEFORE outs open: cleanup sees it
+            for i in range(TOTAL_SHARDS):
+                v["outs"].append(open(base + to_ext(i), "wb"))
+        _run_steps(vols, mesh, dp, progress)
+    finally:
+        for v in vols:
+            v["f"].close()
+            for o in v["outs"]:
+                o.close()
+
+
+def _run_steps(vols, mesh, dp: int, progress) -> None:
+    # pad the volume axis so it splits evenly over dp (padding volumes are
+    # all-zero and never written anywhere)
+    v_real = len(vols)
+    v_padded = -(-v_real // dp) * dp
+
+    # writer thread: shard appends overlap the next step's reads + encode
+    wq: queue.Queue = queue.Queue(maxsize=2)
+    write_err: list[Exception] = []
+    done = 0
+
+    def writer() -> None:
+        nonlocal done
+        while True:
+            item = wq.get()
+            if item is None:
+                return
+            if write_err:
+                continue  # drain so the producer never blocks
+            try:
+                data, parity, widths = item
+                for vi, v in enumerate(vols):
+                    w = widths[vi]
+                    if w == 0:
+                        continue
+                    for i in range(DATA_SHARDS):
+                        v["outs"][i].write(data[vi, i, :w])
+                    for i in range(parity.shape[1]):
+                        v["outs"][DATA_SHARDS + i].write(
+                            np.ascontiguousarray(parity[vi, i, :w]))
+                    real = min(w * DATA_SHARDS,
+                               v["dat_size"] - v["consumed"])
+                    v["consumed"] += real
+                    done += real
+                if progress is not None:
+                    progress(done)
+            except Exception as e:  # surfaced by the main thread
+                write_err.append(e)
+
+    wt = threading.Thread(target=writer, name="batch-ec-writer", daemon=True)
+    wt.start()
+    try:
+        steps = max(len(v["tasks"]) for v in vols)
+        for step in range(steps):
+            widths = [
+                sum(seg[3] for seg in v["tasks"][step])
+                if step < len(v["tasks"]) else 0
+                for v in vols
+            ]
+            w_max = max(widths)
+            data = np.zeros((v_padded, DATA_SHARDS, w_max), dtype=np.uint8)
+            for vi, v in enumerate(vols):
+                if step < len(v["tasks"]):
+                    fill_stripe_rows(v["f"], v["tasks"][step],
+                                     data[vi, :, :widths[vi]])
+            parity = np.asarray(batch_encode_sharded(mesh, data))
+            wq.put((data, parity, widths))
+            if write_err:
+                raise write_err[0]
+        wq.put(None)
+        wt.join()
+        if write_err:
+            raise write_err[0]
+    finally:
+        if wt.is_alive():
+            while True:
+                try:
+                    wq.get_nowait()
+                except queue.Empty:
+                    break
+            wq.put(None)
+            wt.join()

@@ -168,13 +168,7 @@ def _encode_stream_pipelined(
             for batch in _slice_tasks(dat_size, large, small, slice_size):
                 total = sum(seg[3] for seg in batch)
                 data = np.empty((DATA_SHARDS, total), dtype=np.uint8)
-                for i in range(DATA_SHARDS):
-                    row = memoryview(data[i])
-                    at = 0
-                    for row_start, block, col, width in batch:
-                        _read_into(f, row_start + i * block + col,
-                                   row[at:at + width])
-                        at += width
+                fill_stripe_rows(f, batch, data)
                 if not _put(data):
                     return
         except Exception as e:  # surfaced by the consumer
@@ -296,6 +290,20 @@ def _encode_stream_pipelined(
                     break
             wq.put(None)
             wt.join()
+
+
+def fill_stripe_rows(f, batch, dest: np.ndarray) -> None:
+    """Fill dest[(DATA_SHARDS, total_width)] with one _slice_tasks batch:
+    row i gathers the batch's segments at `row_start + i*block + col`.
+    The single home of the stripe-gather arithmetic — the serial and
+    multi-volume batch encoders both call it, so their geometry cannot
+    drift."""
+    for i in range(DATA_SHARDS):
+        row = memoryview(dest[i])
+        at = 0
+        for row_start, block, col, width in batch:
+            _read_into(f, row_start + i * block + col, row[at:at + width])
+            at += width
 
 
 def _read_at(f, offset: int, length: int) -> np.ndarray:
