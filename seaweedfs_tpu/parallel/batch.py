@@ -131,14 +131,18 @@ def _run_steps(vols, mesh, dp: int, progress) -> None:
     wt.start()
     try:
         steps = max(len(v["tasks"]) for v in vols)
+        # one uniform step width -> ONE compiled program for the whole
+        # run; narrower tail steps zero-pad in and trim on write
+        step_widths = [
+            [sum(seg[3] for seg in v["tasks"][step])
+             if step < len(v["tasks"]) else 0
+             for v in vols]
+            for step in range(steps)
+        ]
+        w_pad = max(max(ws) for ws in step_widths)
         for step in range(steps):
-            widths = [
-                sum(seg[3] for seg in v["tasks"][step])
-                if step < len(v["tasks"]) else 0
-                for v in vols
-            ]
-            w_max = max(widths)
-            data = np.zeros((v_padded, DATA_SHARDS, w_max), dtype=np.uint8)
+            widths = step_widths[step]
+            data = np.zeros((v_padded, DATA_SHARDS, w_pad), dtype=np.uint8)
             for vi, v in enumerate(vols):
                 if step < len(v["tasks"]):
                     fill_stripe_rows(v["f"], v["tasks"][step],

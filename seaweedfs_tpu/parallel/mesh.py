@@ -77,6 +77,19 @@ def _batch_encoder(rows: tuple[tuple[int, ...], ...]):
     return encode
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_encoder(mesh: Mesh, data_shards: int, parity_shards: int):
+    """One jitted sharded encoder per (mesh, geometry) — rebuilding the
+    jit wrapper per call would recompile on EVERY invocation, turning a
+    multi-step batch encode into a compile storm."""
+    rows = _rows_of(gf256.rs_parity_matrix(data_shards, parity_shards))
+    encode = _batch_encoder(rows)
+    in_sharding = NamedSharding(mesh, P("dp", None, "sp"))
+    out_sharding = NamedSharding(mesh, P("dp", None, "sp"))
+    return jax.jit(encode, in_shardings=in_sharding,
+                   out_shardings=out_sharding)
+
+
 def batch_encode_sharded(
     mesh: Mesh,
     volumes: jax.Array | np.ndarray,
@@ -87,11 +100,7 @@ def batch_encode_sharded(
 
     V shards over ``dp``, B over ``sp``; the stripe axis stays local.
     """
-    rows = _rows_of(gf256.rs_parity_matrix(data_shards, parity_shards))
-    encode = _batch_encoder(rows)
-    in_sharding = NamedSharding(mesh, P("dp", None, "sp"))
-    out_sharding = NamedSharding(mesh, P("dp", None, "sp"))
-    fn = jax.jit(encode, in_shardings=in_sharding, out_shardings=out_sharding)
+    fn = _sharded_encoder(mesh, data_shards, parity_shards)
     return fn(jnp.asarray(volumes))
 
 
