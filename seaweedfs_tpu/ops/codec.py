@@ -35,7 +35,7 @@ def available_codecs() -> list[str]:
 _AUTO_CHOICE: list[str] = []
 
 
-def _resolve_auto(probe_mb: int = 4) -> str:
+def _resolve_auto(probe_mb: int = 4, timeout_s: float = 75.0) -> str:
     """Pick the codec that will win the disk->shards pipeline on THIS host.
 
     The encode pipeline moves every input byte host->device and 0.4x back;
@@ -44,8 +44,16 @@ def _resolve_auto(probe_mb: int = 4) -> str:
     times one real encode round trip (transfer in + kernel + transfer out)
     against the C++ SIMD codec on the same block, and the result is cached
     for the process lifetime.
+
+    The device side runs in a KILLABLE subprocess with a hard timeout: a
+    wedged transport hangs every device call including backend init, and a
+    server starting with -ec.codec=auto must degrade to the host codec,
+    not hang forever.
     """
     import importlib.util
+    import os
+    import subprocess
+    import sys
     import time as _time
 
     if importlib.util.find_spec("jax") is None:
@@ -58,17 +66,50 @@ def _resolve_auto(probe_mb: int = 4) -> str:
     t0 = _time.perf_counter()
     cpu.parity_of(block)
     cpu_dt = _time.perf_counter() - t0
+
+    code = (
+        "import os, sys, time, numpy as np, jax\n"
+        # the ambient sitecustomize may preload jax on the accelerator
+        # platform before JAX_PLATFORMS is read; re-assert the caller's
+        # choice via config, which wins if set before backend init
+        "_p = os.environ.get('JAX_PLATFORMS')\n"
+        "if _p:\n"
+        "    jax.config.update('jax_platforms', _p)\n"
+        # a CPU backend can never beat the in-process C++ SIMD codec —
+        # skip the (interpret-mode, slow) device timing outright
+        "print('PLATFORM', jax.default_backend()); sys.stdout.flush()\n"
+        "if jax.default_backend() == 'cpu':\n"
+        "    sys.exit(0)\n"
+        "import jax.numpy as jnp\n"
+        "from seaweedfs_tpu.ops.rs_jax import ReedSolomonTPU\n"
+        f"block = np.zeros(({DATA_SHARDS}, {probe_mb} << 20), dtype=np.uint8)\n"
+        f"tpu = ReedSolomonTPU({DATA_SHARDS}, {PARITY_SHARDS}, impl='pallas')\n"
+        "np.asarray(tpu.encode_device(jnp.asarray(block)))\n"
+        "t0 = time.perf_counter()\n"
+        "np.asarray(tpu.encode_device(jnp.asarray(block)))\n"
+        "print('DT', time.perf_counter() - t0)\n"
+    )
     try:
-        import jax.numpy as jnp
-
-        from .rs_jax import ReedSolomonTPU
-
-        tpu = ReedSolomonTPU(DATA_SHARDS, PARITY_SHARDS, impl="pallas")
-        np.asarray(tpu.encode_device(jnp.asarray(block)))  # warm + compile
-        t0 = _time.perf_counter()
-        np.asarray(tpu.encode_device(jnp.asarray(block)))
-        tpu_dt = _time.perf_counter() - t0
-    except Exception:  # no device / backend init refused -> host codec
+        env = dict(os.environ)
+        # the child must resolve seaweedfs_tpu the same way the parent
+        # did, even when the package is only importable via the parent's
+        # script-dir sys.path entry
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p] +
+            [env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=timeout_s, env=env,
+        )
+    except Exception:  # wedged transport, fork failure, odd embedding —
+        return "cpu"   # auto always degrades, never raises
+    if proc.returncode != 0:  # no device / backend init refused
+        return "cpu"
+    tpu_dt = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("DT "):
+            tpu_dt = float(line.split()[1])
+    if tpu_dt is None:
         return "cpu"
     return "tpu" if tpu_dt < cpu_dt else "cpu"
 

@@ -60,9 +60,6 @@ def batch_generate_ec_files(
     """
     if not bases:
         return
-    if mesh is None:
-        mesh = make_mesh()
-    dp = mesh.shape["dp"]
 
     # total step budget -> per-volume slice, floored to one small block so
     # row batching still engages
@@ -80,7 +77,11 @@ def batch_generate_ec_files(
             vols.append(v)  # registered BEFORE outs open: cleanup sees it
             for i in range(TOTAL_SHARDS):
                 v["outs"].append(open(base + to_ext(i), "wb"))
-        _run_steps(vols, mesh, dp, progress)
+        if not any(v["tasks"] for v in vols):
+            return  # all volumes empty: empty shard files, NO device touch
+        if mesh is None:
+            mesh = make_mesh()
+        _run_steps(vols, mesh, mesh.shape["dp"], progress)
     finally:
         for v in vols:
             v["f"].close()
