@@ -69,18 +69,22 @@ def batch_generate_ec_files(
     try:
         for base in bases:
             dat_size = os.path.getsize(base + ".dat")
-            v = {"f": open(base + ".dat", "rb"), "outs": [],
-                 "dat_size": dat_size, "consumed": 0,
-                 "tasks": list(_slice_tasks(dat_size, large_block_size,
-                                            small_block_size,
-                                            per_vol_slice))}
-            vols.append(v)  # registered BEFORE outs open: cleanup sees it
-            for i in range(TOTAL_SHARDS):
-                v["outs"].append(open(base + to_ext(i), "wb"))
-        if not any(v["tasks"] for v in vols):
-            return  # all volumes empty: empty shard files, NO device touch
-        if mesh is None:
+            vols.append({
+                "f": open(base + ".dat", "rb"), "outs": [], "base": base,
+                "dat_size": dat_size, "consumed": 0,
+                "tasks": list(_slice_tasks(dat_size, large_block_size,
+                                           small_block_size,
+                                           per_vol_slice))})
+        have_work = any(v["tasks"] for v in vols)
+        if have_work and mesh is None:
+            # the mesh must exist BEFORE the shard files open 'wb': a
+            # device-init failure here must not truncate existing shards
             mesh = make_mesh()
+        for v in vols:
+            for i in range(TOTAL_SHARDS):
+                v["outs"].append(open(v["base"] + to_ext(i), "wb"))
+        if not have_work:
+            return  # all volumes empty: empty shard files, no device touch
         _run_steps(vols, mesh, mesh.shape["dp"], progress)
     finally:
         for v in vols:

@@ -138,9 +138,9 @@ def distributed_reconstruct(
     S must be divisible by the dp axis size (10 and 2 in practice).
     """
     try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # older jax kept it under experimental
         from jax.experimental.shard_map import shard_map
-    except ImportError:  # moved to the top level in newer jax
-        from jax import shard_map  # type: ignore[attr-defined]
 
     r, s = matrix.shape
     dp = mesh.shape["dp"]

@@ -66,15 +66,26 @@ class Replicator:
 
         import grpc
 
-        ever_received = False
         resume_ns = since_ns
+        source_seen = False
         while True:
+            if not source_seen:
+                # prove the source is REACHABLE with a cheap unary rpc
+                # before trusting the subscription loop: a quiet stream
+                # and a blackholed address are otherwise indistinguishable
+                from ..pb import rpc as rpclib
+
+                host, _, port = self.source.filer_http.partition(":")
+                stub = rpclib.filer_stub(f"{host}:{int(port) + 10000}",
+                                         timeout=20)
+                stub.GetFilerConfiguration(
+                    filer_pb2.GetFilerConfigurationRequest())  # raises
+                source_seen = True
             try:
                 for resp in subscribe_metadata(
                     self.source.filer_http, self.path_prefix, resume_ns,
                     signature=self.signature,
                 ):
-                    ever_received = True
                     resume_ns = max(resume_ns, resp.ts_ns)
                     if stop_event is not None and stop_event.is_set():
                         return
@@ -88,8 +99,6 @@ class Replicator:
             except grpc.RpcError as e:
                 if e.code() == grpc.StatusCode.CANCELLED:
                     return
-                if not ever_received:
-                    raise
                 if stop_event is not None and stop_event.is_set():
                     return
                 glog.warning(
