@@ -412,3 +412,90 @@ def test_follower_redirects_admin_endpoints(tmp_path):
     finally:
         for m in masters:
             m.stop()
+
+
+def test_volume_server_chases_leader_across_failover(tmp_path):
+    """The full membership story (SURVEY §3.4): a volume server
+    heartbeating a 3-master quorum re-registers with the NEW leader
+    after the old one dies, and assigns keep working."""
+    import urllib.request
+
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    ports = [_free_port() for _ in range(3)]
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    masters = [MasterServer(ip="127.0.0.1", port=p, peers=peers,
+                            raft_state_dir=str(tmp_path))
+               for p in ports]
+    for m in masters:
+        m.start()
+    vs = None
+    try:
+        deadline = time.time() + 15
+        leader = None
+        while time.time() < deadline:
+            leaders = [m for m in masters if m.is_leader()]
+            if len(leaders) == 1:
+                leader = leaders[0]
+                break
+            time.sleep(0.05)
+        assert leader is not None
+
+        vs = VolumeServer(
+            directories=[str(tmp_path / "v")],
+            master_addresses=[f"127.0.0.1:{p + 10000}" for p in ports],
+            ip="127.0.0.1", port=_free_port(), pulse_seconds=0.5,
+            max_volume_count=20,
+        )
+        vs.start()
+        deadline = time.time() + 20
+        while time.time() < deadline and not leader.topo.nodes:
+            time.sleep(0.1)
+        assert leader.topo.nodes, "VS never registered with the leader"
+
+        def assign_ok(m) -> bool:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{m.port}/dir/assign",
+                        timeout=5) as r:
+                    import json as _json
+
+                    return "fid" in _json.loads(r.read())
+            except Exception:
+                return False
+
+        assert assign_ok(leader)
+
+        leader.stop()
+        rest = [m for m in masters if m is not leader]
+        deadline = time.time() + 30  # loaded host: elections are slow
+        new_leader = None
+        while time.time() < deadline:
+            leaders = [m for m in rest if m.is_leader()]
+            if len(leaders) == 1:
+                new_leader = leaders[0]
+                break
+            time.sleep(0.1)
+        assert new_leader is not None, "no failover leader"
+        # the VS must chase the new leader and re-register there
+        deadline = time.time() + 30
+        while time.time() < deadline and not new_leader.topo.nodes:
+            time.sleep(0.2)
+        assert new_leader.topo.nodes, "VS did not re-register after failover"
+        deadline = time.time() + 20
+        ok = False
+        while time.time() < deadline:
+            if assign_ok(new_leader):
+                ok = True
+                break
+            time.sleep(0.5)
+        assert ok, "assign does not work on the failover leader"
+    finally:
+        if vs is not None:
+            vs.stop()
+        for m in masters:
+            try:
+                m.stop()
+            except Exception:
+                pass
