@@ -9,9 +9,11 @@ constructed with the identical algorithm (Vandermonde rows `r^c`, then
 normalised so the top square is the identity) so that parity output is
 byte-identical to the reference codec.
 
-Everything in this module is plain numpy on the host: matrix construction and
-inversion involve at most 14x10 elements and are never on the hot path.  The
-hot paths live in rs_cpu.py (numpy/C++ bulk codec) and rs_jax.py (TPU codec).
+Everything in this module is plain numpy on the host: matrices involve at
+most 14x10 elements.  Bulk byte throughput lives in rs_cpu.py (numpy/C++
+codec) and rs_jax.py (TPU codec); the one piece of THIS module that a storm
+of degraded reads hammers is decode_matrix_for, whose inversion result is
+therefore cached per survivor set.
 """
 
 from __future__ import annotations
@@ -182,13 +184,29 @@ def decode_matrix_for(
 ) -> np.ndarray:
     """Given >=data_shards present shard row indices, return the (data x data)
     matrix that maps the first `data_shards` present shards back to the data
-    shards.  Rows of `matrix` correspond to shard ids."""
+    shards.  Rows of `matrix` correspond to shard ids.
+
+    Cached per (matrix, survivor set): a degraded-read storm reconstructs
+    thousands of intervals against the SAME missing shards, and the 10x10
+    GF inversion was the hottest single function in that profile."""
     if len(present) < data_shards:
         raise ValueError(
             f"need {data_shards} shards to decode, have {len(present)}"
         )
-    rows = matrix[np.asarray(present[:data_shards], dtype=np.int64)]
-    return mat_inv(rows)
+    key = (matrix.shape, matrix.tobytes(),
+           tuple(present[:data_shards]))
+    cached = _DECODE_CACHE.get(key)
+    if cached is None:
+        rows = matrix[np.asarray(present[:data_shards], dtype=np.int64)]
+        cached = mat_inv(rows)
+        cached.setflags(write=False)
+        if len(_DECODE_CACHE) > 256:  # plenty for every survivor set seen
+            _DECODE_CACHE.clear()
+        _DECODE_CACHE[key] = cached
+    return cached
+
+
+_DECODE_CACHE: dict = {}
 
 
 def bit_matrix(matrix: np.ndarray) -> np.ndarray:
