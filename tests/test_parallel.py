@@ -150,3 +150,32 @@ def test_batch_generate_ec_files_byte_identical(tmp_path):
         for i in range(ecc.TOTAL_SHARDS):
             p = base + ecc.to_ext(i)
             assert open(p, "rb").read() == expect[p], f"{p} differs"
+
+
+def test_balanced_ec_distribution_scenarios():
+    """The reference's shell/command_ec_test.go scenarios as pure tier-3
+    checks: fresh capacity spreads evenly, an uneven cluster leans on the
+    freest nodes, and insufficient capacity refuses loudly."""
+    import pytest as _pytest
+
+    from seaweedfs_tpu.topology.placement import balanced_ec_distribution
+
+    # even capacity: 14 shards over 3 equal nodes -> 5/5/4 split
+    plan = balanced_ec_distribution({"a": 50, "b": 50, "c": 50})
+    sizes = sorted(len(s) for s in plan.values())
+    assert sizes == [4, 5, 5]
+    assert sorted(sid for s in plan.values() for sid in s) == list(range(14))
+
+    # uneven capacity: the constrained node takes no more than its slots
+    plan = balanced_ec_distribution({"small": 2, "big1": 50, "big2": 50})
+    assert len(plan.get("small", [])) <= 2
+    assert sum(len(s) for s in plan.values()) == 14
+
+    # a node with zero slots is never used
+    plan = balanced_ec_distribution({"full": 0, "ok": 20})
+    assert "full" not in plan
+    assert len(plan["ok"]) == 14
+
+    # insufficient total capacity refuses instead of over-packing
+    with _pytest.raises(ValueError):
+        balanced_ec_distribution({"a": 5, "b": 5})
