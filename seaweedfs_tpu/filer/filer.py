@@ -309,8 +309,9 @@ class Filer:
                 continue
             try:
                 self._delete_fn(item)
-            except Exception:
-                pass
+            except Exception as e:  # orphaned blobs are an operator
+                # problem; losing the error hides them forever
+                glog.warning("deferred blob deletion failed: %s", e)
 
     def drain_deletions(self, timeout: float = 5.0) -> None:
         """Testing hook: wait for queued blob deletions to be processed."""

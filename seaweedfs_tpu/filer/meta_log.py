@@ -13,6 +13,8 @@ from collections import deque
 
 from ..pb import filer_pb2
 
+from ..util import glog
+
 
 class MetaLogBuffer:
     def __init__(self, capacity: int = 1 << 16):
@@ -67,8 +69,9 @@ class MetaLogBuffer:
             for fn in self._listeners:
                 try:
                     fn(resp)
-                except Exception:
-                    pass
+                except Exception as e:  # a dead notification sink must
+                    # not kill the write path, but must be visible
+                    glog.warning("meta listener failed: %s", e)
         return ts
 
     def ingest(self, resp: filer_pb2.SubscribeMetadataResponse) -> None:
@@ -83,8 +86,8 @@ class MetaLogBuffer:
             for fn in self._listeners:
                 try:
                     fn(resp)
-                except Exception:
-                    pass
+                except Exception as e:
+                    glog.warning("meta listener failed: %s", e)
 
     def add_listener(self, fn) -> None:
         """Synchronous callback per event (notification sinks)."""

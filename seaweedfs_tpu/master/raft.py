@@ -24,6 +24,8 @@ import threading
 import time
 from dataclasses import dataclass
 
+from ..util import glog
+
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
 LEADER = "leader"
@@ -261,8 +263,10 @@ class RaftNode:
                     if len(self.apply_results) > 1024:
                         for k in sorted(self.apply_results)[:-512]:
                             del self.apply_results[k]
-                except Exception:
-                    pass
+                except Exception as e:  # an apply failure risks replica
+                    # divergence — it must at least be visible
+                    glog.error("raft apply of entry %d failed: %s",
+                               self.last_applied, e)
         self._commit_cv.notify_all()
 
     # -- election ------------------------------------------------------------
