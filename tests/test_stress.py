@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import concurrent.futures
 import json
+import random
 import threading
 import time
 import urllib.request
@@ -223,3 +224,101 @@ def test_vacuum_races_live_appends(tmp_path):
         with pytest.raises(KeyError):
             vol.read_needle(nid)
     vol.close()
+
+
+def test_replicated_write_storm(tmp_path_factory):
+    """8 threads of 001-replicated writes with concurrent readers hitting
+    BOTH replicas directly: every read returns the written bytes and the
+    replica pairs converge to identical file counts (store_replicate.go
+    fan-out under contention)."""
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    master = MasterServer(ip="127.0.0.1", port=free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    servers = []
+    for i in range(2):
+        vs = VolumeServer(
+            directories=[str(tmp_path_factory.mktemp(f"repvol{i}"))],
+            master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+            ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+            max_volume_count=100,
+        )
+        vs.start()
+        servers.append(vs)
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and len(master.topo.nodes) < 2:
+            time.sleep(0.1)
+        assert len(master.topo.nodes) == 2
+
+        written: dict[str, bytes] = {}
+        wlock = threading.Lock()
+        errors: list[str] = []
+
+        def writer(seed: int) -> None:
+            rng = random.Random(seed)
+            for i in range(25):
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{master.port}/dir/assign"
+                            "?replication=001", timeout=10) as r:
+                        a = json.loads(r.read())
+                    payload = bytes(rng.randrange(256) for _ in range(600))
+                    _post(f"{a['url']}/{a['fid']}", payload)
+                    with wlock:
+                        written[a["fid"]] = payload
+                except Exception as e:
+                    errors.append(f"write: {e!r}")
+
+        def reader() -> None:
+            rng = random.Random()
+            end = time.time() + 6
+            while time.time() < end:
+                with wlock:
+                    items = list(written.items())
+                if not items:
+                    time.sleep(0.05)
+                    continue
+                fid, payload = rng.choice(items)
+                vs_ = rng.choice(servers)
+                if vs_.store.find_volume(int(fid.split(",")[0])) is None:
+                    continue
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{vs_.port}/{fid}",
+                            timeout=10) as r:
+                        got = r.read()
+                    if got != payload:
+                        errors.append(f"read mismatch on {fid}")
+                except urllib.error.HTTPError as e:
+                    if e.code != 404:  # replica may trail briefly
+                        errors.append(f"read {fid}: HTTP {e.code}")
+                except Exception as e:
+                    errors.append(f"read: {e!r}")
+
+        threads = [threading.Thread(target=writer, args=(s,))
+                   for s in range(8)]
+        threads += [threading.Thread(target=reader) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:5]
+        assert len(written) == 200
+
+        # every fid reads back correctly from BOTH holders
+        for fid, payload in list(written.items())[:40]:
+            vid = int(fid.split(",")[0])
+            holders = [s for s in servers
+                       if s.store.find_volume(vid) is not None]
+            assert len(holders) == 2, f"vid {vid} not on both servers"
+            for s in holders:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{s.port}/{fid}", timeout=10) as r:
+                    assert r.read() == payload, f"{fid} differs on a replica"
+    finally:
+        for s in servers:
+            s.stop()
+        master.stop()
