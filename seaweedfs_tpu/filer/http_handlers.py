@@ -14,6 +14,7 @@ import threading
 import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from ..util.httpd import FrameworkHTTPServer
 
 from ..pb import filer_pb2
 from . import filechunks
@@ -232,6 +233,6 @@ def serve_http(filer_server, host: str, port: int) -> ThreadingHTTPServer:
         "BoundFilerHttpHandler", (FilerHttpHandler,),
         {"filer_server": filer_server},
     )
-    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd = FrameworkHTTPServer((host, port), handler)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     return httpd

@@ -21,6 +21,7 @@ import urllib.error
 import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from .util.httpd import FrameworkHTTPServer
 
 from .util import glog
 
@@ -46,7 +47,7 @@ class GatewayServer:
     def start(self) -> None:
         handler = type("BoundGatewayHandler", (GatewayHandler,),
                        {"gw": self})
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        self._httpd = FrameworkHTTPServer(("0.0.0.0", self.port), handler)
         threading.Thread(target=self._httpd.serve_forever,
                          daemon=True).start()
         glog.info("gateway started port=%d", self.port)

@@ -24,6 +24,7 @@ import string
 import threading
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from ..util.httpd import FrameworkHTTPServer
 from urllib.parse import parse_qs
 
 from ..s3api.auth import (
@@ -166,7 +167,7 @@ class IamApiServer:
         from ..util import glog
 
         handler = type("BoundIamHandler", (IamHandler,), {"iam_server": self})
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        self._httpd = FrameworkHTTPServer(("0.0.0.0", self.port), handler)
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
         glog.info("iam api started port=%d filer=%s",
                   self.port, self.client.http_address)

@@ -15,6 +15,7 @@ import threading
 import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from ..util.httpd import FrameworkHTTPServer
 
 import grpc
 
@@ -69,6 +70,8 @@ class MasterServer:
         self._sub_lock = threading.Lock()
         self._admin_locks: dict[str, int] = {}
         self._admin_lock_mutex = threading.Lock()
+        self._grow_locks: dict[tuple, threading.Lock] = {}
+        self._grow_locks_guard = threading.Lock()
         self._stop = threading.Event()
         self._grpc_server = None
         self._httpd = None
@@ -281,9 +284,24 @@ class MasterServer:
         try:
             vid, node_ids = layout.pick_for_write()
         except LookupError:
-            self.grow_volumes(collection, replication or self.default_replication,
-                              ttl, data_center, rack)
-            vid, node_ids = layout.pick_for_write()
+            # serialize growth PER LAYOUT and re-check inside the lock: a
+            # burst of first assigns to a new collection would otherwise
+            # each grow their own batch (observed: 5 concurrent growths
+            # allocating 15 volumes where 3 suffice), while a stalled
+            # grow for one collection must not block assigns elsewhere
+            key = (collection, replication or self.default_replication, ttl)
+            with self._grow_locks_guard:
+                grow_lock = self._grow_locks.setdefault(
+                    key, threading.Lock())
+            with grow_lock:
+                try:
+                    vid, node_ids = layout.pick_for_write()
+                except LookupError:
+                    self.grow_volumes(
+                        collection,
+                        replication or self.default_replication,
+                        ttl, data_center, rack)
+                    vid, node_ids = layout.pick_for_write()
         key = self.sequencer.next_file_id(count)
         cookie = self._rng.randrange(0, 2**32)
         fid = f"{vid},{key:x}{cookie:08x}"
@@ -730,6 +748,6 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
 
 def _serve_http(master: MasterServer, host: str, port: int) -> ThreadingHTTPServer:
     handler = type("BoundMasterHttp", (_MasterHttpHandler,), {"master": master})
-    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd = FrameworkHTTPServer((host, port), handler)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     return httpd

@@ -21,6 +21,7 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 from email.utils import formatdate
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from ..util.httpd import FrameworkHTTPServer
 
 import shutil
 import urllib.error
@@ -87,7 +88,7 @@ class S3ApiServer:
         from ..util import glog
 
         handler = type("BoundS3Handler", (S3Handler,), {"s3": self})
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        self._httpd = FrameworkHTTPServer(("0.0.0.0", self.port), handler)
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
         if self.iam_config_filer_path:
             self.refresh_iam_from_filer()

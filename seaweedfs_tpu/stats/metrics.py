@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from ..util.httpd import FrameworkHTTPServer
 
 _DEFAULT_BUCKETS = (
     0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
@@ -263,6 +264,6 @@ def serve_metrics(port: int, registry: Registry = REGISTRY,
             self.end_headers()
             self.wfile.write(body)
 
-    httpd = ThreadingHTTPServer((host, port), Handler)
+    httpd = FrameworkHTTPServer((host, port), Handler)
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     return httpd

@@ -1,0 +1,20 @@
+"""Shared HTTP server base for every gateway/server in the framework.
+
+``http.server``'s default listen backlog (request_queue_size) is 5 — a
+burst of concurrent clients (the reference benchmark's c=16, replication
+fan-out storms) overflows it and the kernel resets connections that never
+reach accept().  One subclass fixes the backlog for all eight HTTP surfaces
+(master/volume/filer/s3/iam/webdav/gateway/metrics); the raw-TCP
+listeners (volume TCP data path, RESP test server, FTP control port)
+apply the same backlog to their ThreadingTCPServer subclasses.
+"""
+
+from __future__ import annotations
+
+from http.server import ThreadingHTTPServer
+
+LISTEN_BACKLOG = 128
+
+
+class FrameworkHTTPServer(ThreadingHTTPServer):
+    request_queue_size = LISTEN_BACKLOG

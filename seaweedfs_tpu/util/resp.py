@@ -192,6 +192,7 @@ class FakeRedisServer:
                     return self._send(b"-ERR unknown command\r\n")
 
         class Server(socketserver.ThreadingTCPServer):
+            request_queue_size = 128  # default 5 drops burst connections
             allow_reuse_address = True
             daemon_threads = True
 

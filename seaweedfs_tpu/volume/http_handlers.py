@@ -14,6 +14,7 @@ import time
 import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from ..util.httpd import FrameworkHTTPServer
 
 from .. import images
 from ..security.jwt import token_from_header, verify_write_jwt
@@ -307,7 +308,7 @@ def serve_http(volume_server, host: str, port: int) -> ThreadingHTTPServer:
         (VolumeHttpHandler,),
         {"volume_server": volume_server},
     )
-    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd = FrameworkHTTPServer((host, port), handler)
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
     return httpd

@@ -108,6 +108,7 @@ class _Handler(socketserver.StreamRequestHandler):
 
 
 class TcpServer(socketserver.ThreadingTCPServer):
+    request_queue_size = 128  # default 5 drops burst connections
     allow_reuse_address = True
     daemon_threads = True
 

@@ -15,6 +15,7 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 from email.utils import formatdate
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from ..util.httpd import FrameworkHTTPServer
 
 from ..s3api.filer_client import FilerClient
 from ..util import glog
@@ -38,7 +39,7 @@ class WebDavServer:
 
     def start(self) -> None:
         handler = type("BoundDavHandler", (DavHandler,), {"dav": self})
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        self._httpd = FrameworkHTTPServer(("0.0.0.0", self.port), handler)
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
         glog.info("webdav started port=%d filer=%s", self.port,
                   self.client.http_address)
