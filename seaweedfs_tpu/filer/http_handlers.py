@@ -14,7 +14,7 @@ import threading
 import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from ..util.httpd import FrameworkHTTPServer
+from ..util.httpd import FrameworkHTTPServer, shield_handler
 
 from ..pb import filer_pb2
 from . import filechunks
@@ -226,6 +226,10 @@ def _entry_json(dir_path: str, e: filer_pb2.Entry) -> dict:
         "Mime": e.attributes.mime,
         "Chunks": len(e.chunks),
     }
+
+
+
+shield_handler(FilerHttpHandler, "_json")
 
 
 def serve_http(filer_server, host: str, port: int) -> ThreadingHTTPServer:

@@ -23,6 +23,7 @@ import time
 from ..pb import filer_pb2
 from ..s3api.filer_client import FilerClient
 from ..util import glog
+from ..util.httpd import LISTEN_BACKLOG
 
 
 def _norm(path: str) -> str:
@@ -405,7 +406,7 @@ class _Handler(socketserver.StreamRequestHandler):
 
 
 class _ThreadedTCP(socketserver.ThreadingTCPServer):
-    request_queue_size = 128  # default 5 drops burst connections
+    request_queue_size = LISTEN_BACKLOG
     allow_reuse_address = True
     daemon_threads = True
 

@@ -231,6 +231,9 @@ class MasterServer:
         with self._layout_lock:
             for key in [k for k in self.layouts if k[0] == name]:
                 del self.layouts[key]
+        with self._grow_locks_guard:
+            for key in [k for k in self._grow_locks if k[0] == name]:
+                del self._grow_locks[key]
 
     def get_layout(self, collection: str, replication: str, ttl: str) -> VolumeLayout:
         replication = replication or self.default_replication

@@ -18,3 +18,36 @@ LISTEN_BACKLOG = 128
 
 class FrameworkHTTPServer(ThreadingHTTPServer):
     request_queue_size = LISTEN_BACKLOG
+
+
+def shield_handler(cls, send_json_attr: str) -> None:
+    """Wrap a BaseHTTPRequestHandler subclass's do_* verbs so an
+    unhandled exception answers 500 (via the named send-json method)
+    instead of slamming the socket shut.  The connection always closes
+    after a shielded exception: if part of a response already went out,
+    appending a 500 would corrupt the keep-alive stream, so the client
+    must re-dial either way."""
+    from . import glog
+
+    def wrap(name: str):
+        inner = getattr(cls, name)
+
+        def safe(self):
+            try:
+                inner(self)
+            except (BrokenPipeError, ConnectionResetError):
+                raise  # the CLIENT went away; nothing to answer
+            except Exception as e:  # noqa: BLE001 — boundary guard
+                glog.warning("%s %s failed: %r", name[3:], self.path, e)
+                try:
+                    getattr(self, send_json_attr)(500, {"error": str(e)})
+                except Exception:
+                    pass  # headers already sent / socket gone
+                self.close_connection = True
+
+        safe.__name__ = name
+        setattr(cls, name, safe)
+
+    for name in ("do_GET", "do_HEAD", "do_POST", "do_PUT", "do_DELETE"):
+        if hasattr(cls, name):
+            wrap(name)

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import socket
 import threading
+from ..util.httpd import LISTEN_BACKLOG
 
 
 class RespError(RuntimeError):
@@ -192,7 +193,7 @@ class FakeRedisServer:
                     return self._send(b"-ERR unknown command\r\n")
 
         class Server(socketserver.ThreadingTCPServer):
-            request_queue_size = 128  # default 5 drops burst connections
+            request_queue_size = LISTEN_BACKLOG
             allow_reuse_address = True
             daemon_threads = True
 

@@ -14,7 +14,7 @@ import time
 import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from ..util.httpd import FrameworkHTTPServer
+from ..util.httpd import FrameworkHTTPServer, shield_handler
 
 from .. import images
 from ..security.jwt import token_from_header, verify_write_jwt
@@ -300,6 +300,11 @@ def _parse_multipart(body: bytes, ctype: str) -> tuple[bytes, bytes, bytes]:
         if name or content:
             return content, name, mime
     return body, b"", b""
+
+
+
+
+shield_handler(VolumeHttpHandler, "_send_json")
 
 
 def serve_http(volume_server, host: str, port: int) -> ThreadingHTTPServer:

@@ -30,6 +30,7 @@ import threading
 from ..storage.file_id import FileId
 from ..storage.needle import Needle
 from ..util import glog
+from ..util.httpd import LISTEN_BACKLOG
 
 
 class _Handler(socketserver.StreamRequestHandler):
@@ -108,7 +109,7 @@ class _Handler(socketserver.StreamRequestHandler):
 
 
 class TcpServer(socketserver.ThreadingTCPServer):
-    request_queue_size = 128  # default 5 drops burst connections
+    request_queue_size = LISTEN_BACKLOG
     allow_reuse_address = True
     daemon_threads = True
 
