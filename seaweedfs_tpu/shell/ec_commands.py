@@ -323,7 +323,8 @@ def ec_balance(env: CommandEnv, args: list[str]) -> str:
             topo = env.topology()
     if moves:
         return "ec.balance: " + "; ".join(moves)
-    return f"ec.balance: balanced (shards per node: {shard_count})"
+    return (f"ec.balance: balanced (shards per node: {shard_count}, "
+            f"free slots: {free})")
 
 
 def _move_one_shard(env: CommandEnv, topo, source: str, target: str,

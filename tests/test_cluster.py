@@ -253,13 +253,36 @@ def test_ec_delete_fanout(cluster):
         if len(master.topo.lookup_ec_shards(vid)) == 14 and len(holders) >= 2:
             break
         if len(holders) < 2 and time.time() >= rebalance_at:
-            # under load a starved heartbeat can drop peers from the topo
-            # at spread time, leaving every shard on the source; once the
-            # peers re-register, a balance pass spreads them
+            # under 1-vCPU starvation the master's capacity view can
+            # degrade (stalled heartbeats -> peers show no free slots), so
+            # both spread and balance legitimately refuse.  This test is
+            # about DELETE FAN-OUT, not placement: arrange >=2 holders
+            # directly with the same shard-copy rpcs the balancer uses.
             rebalance_at = time.time() + 5
             try:
                 balance_log.append(
                     run_command(env, "ec.balance -force -collection=ecdel"))
+                holders = [s for s in servers
+                           if s.store.find_ec_volume(vid)]
+                if len(holders) == 1:
+                    from seaweedfs_tpu.pb import rpc as rpclib
+                    from seaweedfs_tpu.pb import volume_server_pb2 as vspb
+
+                    src = holders[0]
+                    dst = next(s for s in servers if s is not src)
+                    sids = src.store.find_ec_volume(vid).shard_ids()[:7]
+                    dst_stub = rpclib.volume_server_stub(
+                        f"127.0.0.1:{dst.grpc_port}", timeout=60)
+                    dst_stub.VolumeEcShardsCopy(vspb.VolumeEcShardsCopyRequest(
+                        volume_id=vid, collection="ecdel", shard_ids=sids,
+                        copy_ecx_file=True, copy_ecj_file=True,
+                        copy_vif_file=True,
+                        copy_from_data_node=f"127.0.0.1:{src.grpc_port}"))
+                    dst_stub.VolumeEcShardsMount(
+                        vspb.VolumeEcShardsMountRequest(
+                            volume_id=vid, collection="ecdel",
+                            shard_ids=sids))
+                    balance_log.append(f"manually spread {sids} to dst")
             except Exception as e:
                 balance_log.append(f"balance error: {e!r}")
         time.sleep(0.2)
