@@ -70,6 +70,32 @@ class ReedSolomon:
 
     # -- public API -------------------------------------------------------
 
+    def parity_into(self, inputs: list[np.ndarray],
+                    outs: list[np.ndarray]) -> None:
+        """Parity from arbitrary equal-length contiguous 1-D row buffers
+        into preallocated outputs — the zero-copy entry for the mmap'd
+        encode pipeline (rows may be views straight into the page cache)."""
+        from ..native import lib as native
+
+        # the native kernel writes len(inputs[0]) bytes through each raw
+        # out pointer with no checks of its own — validate here so a bad
+        # caller gets a ValueError on every host, not a heap scribble on
+        # SIMD hosts and a broadcast error on the numpy fallback
+        if len(inputs) != self.data_shards:
+            raise ValueError(
+                f"expected {self.data_shards} input rows, got {len(inputs)}")
+        if len(outs) != self.parity_shards:
+            raise ValueError(
+                f"expected {self.parity_shards} output rows, got {len(outs)}")
+        n = len(inputs[0])
+        if any(len(o) != n for o in outs):
+            raise ValueError("output rows must match input length")
+        if native.available():
+            native.gf_apply_arrays(self.parity_matrix, inputs, out=outs)
+            return
+        for o, r in zip(outs, self._apply(self.parity_matrix, inputs)):
+            o[:] = r
+
     def parity_of(self, data: np.ndarray) -> np.ndarray:
         """(data_shards, B) -> (parity_shards, B), the bulk-pipeline entry;
         _apply picks the native GFNI/SSSE3 kernel when available."""

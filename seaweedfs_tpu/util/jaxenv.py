@@ -1,0 +1,30 @@
+"""JAX backend-environment helpers.
+
+The one non-obvious piece: this image's axon sitecustomize wraps
+``jax._src.xla_bridge._get_backend_uncached`` and force-initialises the
+axon PJRT client even when ``JAX_PLATFORMS=cpu`` — on a wedged device
+tunnel that hangs EVERY ``jax.devices()`` call, including pure-CPU test
+runs.  ``force_cpu_backend`` makes the cpu pin effective by dropping the
+axon factory before any backend is touched.  Shared by tests/conftest.py
+and bench.py's interpreter-mode escape hatch so the workaround cannot
+drift between the two.
+"""
+
+from __future__ import annotations
+
+
+def force_cpu_backend() -> None:
+    """Pin jax to the CPU backend and neutralise the axon auto-init hook.
+
+    Must run before the first backend touch (jax import is fine; the
+    backend is only created lazily).
+    """
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass

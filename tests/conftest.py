@@ -22,8 +22,16 @@ if not os.environ.get("SEAWEEDFS_TPU_TEST_REAL"):
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
     try:
-        import jax
+        import jax  # noqa: F401
     except ImportError:
         pass
     else:
-        jax.config.update("jax_platforms", "cpu")
+        # pins the platform AND drops the axon auto-init hook, which would
+        # otherwise hang the whole suite on a wedged tunnel (see docstring
+        # of util.jaxenv)
+        import sys
+
+        sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
+        from seaweedfs_tpu.util.jaxenv import force_cpu_backend
+
+        force_cpu_backend()
