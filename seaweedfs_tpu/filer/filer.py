@@ -52,6 +52,10 @@ class Filer:
         self.store = store
         self.meta_log = MetaLogBuffer()
         self._append_lock = threading.Lock()
+        # serializes hardlink KV counter read-modify-writes: two
+        # concurrent unlinks must not both read counter=2/write 1 and
+        # leak the shared chunks forever
+        self._hardlink_lock = threading.Lock()
         self._delete_fn = delete_chunks_fn
         self._resolve_fn = resolve_chunks_fn
         self._deletion_q: queue.Queue = queue.Queue()
@@ -64,11 +68,77 @@ class Filer:
         self._deletion_q.put(None)
         self.store.close()
 
+    # -- hardlinks (filerstore_hardlink.go:12-40) --------------------------
+    #
+    # A hardlinked file's shared truth (attributes + chunks + counter)
+    # lives in the store's KV space keyed by the 17-byte hard_link_id;
+    # directory entries are stubs carrying the id.  Reads merge the KV
+    # meta back in; unlink decrements the counter and reclaims the data
+    # chunks only when the LAST link dies.
+
+    @staticmethod
+    def _encode_hardlink_meta(entry: filer_pb2.Entry) -> bytes:
+        meta = filer_pb2.Entry(
+            hard_link_id=entry.hard_link_id,
+            hard_link_counter=entry.hard_link_counter,
+        )
+        meta.attributes.CopyFrom(entry.attributes)
+        meta.chunks.extend(entry.chunks)
+        for k, v in entry.extended.items():
+            meta.extended[k] = v
+        return meta.SerializeToString()
+
+    def _set_hardlink(self, entry: filer_pb2.Entry) -> None:
+        if entry.hard_link_id:
+            with self._hardlink_lock:
+                self.store.kv_put(bytes(entry.hard_link_id),
+                                  self._encode_hardlink_meta(entry))
+
+    def _maybe_read_hardlink(
+        self, entry: filer_pb2.Entry | None
+    ) -> filer_pb2.Entry | None:
+        if entry is None or not entry.hard_link_id:
+            return entry
+        blob = self.store.kv_get(bytes(entry.hard_link_id))
+        if not blob:
+            return entry  # dangling link: serve the stub as-is
+        meta = filer_pb2.Entry.FromString(blob)
+        entry.attributes.CopyFrom(meta.attributes)
+        del entry.chunks[:]
+        entry.chunks.extend(meta.chunks)
+        entry.hard_link_counter = meta.hard_link_counter
+        for k, v in meta.extended.items():
+            entry.extended[k] = v
+        return entry
+
+    def _delete_hardlink(self, hard_link_id: bytes,
+                         is_delete_data: bool) -> None:
+        """Decrement the link counter; on the last unlink drop the KV meta
+        and reclaim the shared chunks (the per-entry stub's chunk list is
+        never trusted for deletion — the KV meta is the owner)."""
+        key = bytes(hard_link_id)
+        with self._hardlink_lock:
+            blob = self.store.kv_get(key)
+            if not blob:
+                return
+            meta = filer_pb2.Entry.FromString(blob)
+            meta.hard_link_counter -= 1
+            if meta.hard_link_counter <= 0:
+                if is_delete_data and meta.chunks:
+                    self.queue_chunk_deletion(self._all_fids(meta.chunks))
+                self.store.kv_delete(key)
+                return
+            self.store.kv_put(key, meta.SerializeToString())
+
     # -- create/update -----------------------------------------------------
 
     def create_entry(self, directory: str, entry: filer_pb2.Entry,
                      o_excl: bool = False, signatures=None) -> None:
-        old = self.store.find_entry(directory, entry.name)
+        # read the old entry MERGED so a hardlinked file's true (shared)
+        # chunk list is what the rewrite diff below runs against —
+        # diffing the stub would leak every shadowed chunk forever
+        old = self._maybe_read_hardlink(
+            self.store.find_entry(directory, entry.name))
         if old is not None and o_excl:
             raise FileExistsError(join_path(directory, entry.name))
         self._ensure_parents(directory, signatures=signatures)
@@ -76,9 +146,19 @@ class Filer:
             entry.attributes.crtime = int(time.time())
         if not entry.attributes.mtime:
             entry.attributes.mtime = int(time.time())
+        self._set_hardlink(entry)
+        broke_link = (old is not None and old.hard_link_id
+                      and old.hard_link_id != entry.hard_link_id)
+        if broke_link:
+            # overwrite breaks the old link (handleUpdateToHardLinks);
+            # the counter logic owns the shared chunks' lifetime here —
+            # other links may still reference them, so no rewrite diff
+            self._delete_hardlink(old.hard_link_id, is_delete_data=True)
         self.store.insert_entry(directory, entry)
-        # blobs shadowed by the rewrite get deleted asynchronously
-        if old is not None and old.chunks:
+        # blobs shadowed by the rewrite get deleted asynchronously; runs
+        # for plain entries AND for a hardlinked entry rewritten in place
+        # (same id: every link now sees the new chunks via the KV meta)
+        if not broke_link and old is not None and old.chunks:
             self.queue_chunk_deletion(
                 self._garbage_fids(old.chunks, entry.chunks)
             )
@@ -86,14 +166,21 @@ class Filer:
 
     def update_entry(self, directory: str, entry: filer_pb2.Entry,
                      signatures=None) -> None:
-        old = self.store.find_entry(directory, entry.name)
+        old = self._maybe_read_hardlink(
+            self.store.find_entry(directory, entry.name))
         if old is None:
             raise FileNotFoundError(join_path(directory, entry.name))
-        self.store.update_entry(directory, entry)
-        if old.chunks:
-            self.queue_chunk_deletion(
-                self._garbage_fids(old.chunks, entry.chunks)
-            )
+        self._set_hardlink(entry)
+        if (old.hard_link_id
+                and old.hard_link_id != entry.hard_link_id):
+            self._delete_hardlink(old.hard_link_id, is_delete_data=True)
+            self.store.update_entry(directory, entry)
+        else:
+            self.store.update_entry(directory, entry)
+            if old.chunks:
+                self.queue_chunk_deletion(
+                    self._garbage_fids(old.chunks, entry.chunks)
+                )
         self.meta_log.append(directory, old, entry, signatures=signatures)
 
     def _garbage_fids(self, old_chunks, new_chunks) -> list[str]:
@@ -135,7 +222,10 @@ class Filer:
         # serialize the read-modify-write: two concurrent appenders would
         # otherwise both read the same chunk list and one would lose chunks
         with self._append_lock:
-            entry = self.store.find_entry(directory, name)
+            # merged read: appending to a hardlinked file must extend the
+            # SHARED chunk list, not the stub's stale copy
+            entry = self._maybe_read_hardlink(
+                self.store.find_entry(directory, name))
             if entry is None:
                 self._ensure_parents(directory)
                 entry = filer_pb2.Entry(name=name)
@@ -149,6 +239,7 @@ class Filer:
                 entry.chunks.append(c2)
             entry.attributes.mtime = int(time.time())
             entry.attributes.file_size = offset
+            self._set_hardlink(entry)
             self.store.insert_entry(directory, entry)
             self.meta_log.append(directory, None, entry)
 
@@ -177,14 +268,16 @@ class Filer:
         if name == "":
             root = filer_pb2.Entry(name="/", is_directory=True)
             return root
-        return self.store.find_entry(directory, name)
+        return self._maybe_read_hardlink(
+            self.store.find_entry(directory, name))
 
     def list_directory(self, directory: str, start_from: str = "",
                        inclusive: bool = False, prefix: str = "",
                        limit: int = 1024):
-        return self.store.list_entries(
+        for e in self.store.list_entries(
             directory, start_from, inclusive, prefix, limit
-        )
+        ):
+            yield self._maybe_read_hardlink(e)
 
     # -- delete ------------------------------------------------------------
 
@@ -206,6 +299,9 @@ class Filer:
             except Exception:
                 if not ignore_recursive_error:
                     raise
+        elif entry.hard_link_id:
+            # unlink: the KV meta owns the shared chunks' lifetime
+            self._delete_hardlink(entry.hard_link_id, is_delete_data)
         elif is_delete_data and entry.chunks:
             self.queue_chunk_deletion(self._all_fids(entry.chunks))
         self.store.delete_entry(directory, name)
@@ -227,6 +323,8 @@ class Filer:
                 for e in batch:
                     if e.is_directory:
                         stack.append(join_path(d, e.name))
+                    elif e.hard_link_id:
+                        self._delete_hardlink(e.hard_link_id, is_delete_data)
                     elif is_delete_data and e.chunks:
                         self.queue_chunk_deletion(self._all_fids(e.chunks))
                 start = batch[-1].name

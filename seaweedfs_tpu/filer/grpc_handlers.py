@@ -24,7 +24,10 @@ class FilerGrpcService:
     # -- metadata ----------------------------------------------------------
 
     def LookupDirectoryEntry(self, request, context):
-        entry = self.filer.store.find_entry(request.directory, request.name)
+        # the Filer path (not the raw store) so hardlink stubs come back
+        # merged with their shared KV meta (filerstore_hardlink.go)
+        entry = self.filer._maybe_read_hardlink(
+            self.filer.store.find_entry(request.directory, request.name))
         if entry is None:
             context.abort(grpc.StatusCode.NOT_FOUND,
                           f"{join_path(request.directory, request.name)} not found")
@@ -97,7 +100,11 @@ class FilerGrpcService:
                 signatures=list(request.signatures),
             )
             return filer_pb2.DeleteEntryResponse()
-        except (FileNotFoundError, IsADirectoryError) as e:
+        except FileNotFoundError as e:
+            # distinguishable marker: callers (S3 multi-delete) treat a
+            # missing key as already-deleted, AWS-style
+            return filer_pb2.DeleteEntryResponse(error=f"not found: {e}")
+        except IsADirectoryError as e:
             return filer_pb2.DeleteEntryResponse(error=str(e))
 
     def AtomicRenameEntry(self, request, context):

@@ -5,6 +5,7 @@ init() (weed/server/filer_server.go:23-36).  This build ships: in-memory
 (tests), sqlite (single-file, transactional, ordered listing), leveldb
 (bitcask-style log+snapshot store covering the reference's
 embedded-leveldb default), leveldb2 (the same, md5-partitioned 8 ways),
+leveldb3 (adaptive per-bucket partitioning with O(1) bucket drops),
 redis (any RESP2 endpoint via the framework's own client), and the
 abstract_sql class with mysql / postgres kinds (DB-API drivers load
 lazily; absent drivers raise a loud ConfigurationError).
@@ -12,6 +13,7 @@ lazily; absent drivers raise a loud ConfigurationError).
 
 from . import (  # noqa: F401
     leveldb2_store,
+    leveldb3_store,
     leveldb_store,
     memory_store,
     redis_store,

@@ -90,11 +90,17 @@ class AbstractSqlStore(FilerStore):
             f"(dirhash, name, directory, meta) VALUES ({p}, {p}, {p}, {p})"
             f"{dialect.upsert_suffix}"
         )
+        # dirhash is a 64-bit hash — always scope by the directory column
+        # too, so a hash collision between two directories cannot return or
+        # delete another directory's entry (the reference's SQL gens do the
+        # same, mysql_sql_gen.go:33)
         self._sql_find = (
             f"SELECT meta FROM filemeta WHERE dirhash={p} AND name={p}"
+            f" AND directory={p}"
         )
         self._sql_delete = (
             f"DELETE FROM filemeta WHERE dirhash={p} AND name={p}"
+            f" AND directory={p}"
         )
         self._sql_delete_tree = (
             f"DELETE FROM filemeta WHERE directory={p} OR directory LIKE {p}"
@@ -149,7 +155,7 @@ class AbstractSqlStore(FilerStore):
         with self._lock:
             cur = self._conn.cursor()
             cur.execute(self._sql_find,
-                        (hash_string_to_long(directory), name))
+                        (hash_string_to_long(directory), name, directory))
             row = cur.fetchone()
         if row is None:
             return None
@@ -158,7 +164,8 @@ class AbstractSqlStore(FilerStore):
     def delete_entry(self, directory: str, name: str) -> None:
         with self._lock:
             self._conn.cursor().execute(
-                self._sql_delete, (hash_string_to_long(directory), name))
+                self._sql_delete,
+                (hash_string_to_long(directory), name, directory))
             self._commit()
 
     def delete_folder_children(self, directory: str) -> None:
@@ -180,8 +187,8 @@ class AbstractSqlStore(FilerStore):
         p = self._d.paramstyle
         op = ">=" if inclusive else ">"
         sql = (f"SELECT meta FROM filemeta WHERE dirhash={p} "
-               f"AND name {op} {p} ")
-        params: list = [hash_string_to_long(directory), start_from]
+               f"AND directory={p} AND name {op} {p} ")
+        params: list = [hash_string_to_long(directory), directory, start_from]
         if prefix:
             sql += f"AND name LIKE {p}{self._d.like_escape_clause} "
             params.append(_like_escape(prefix) + "%")

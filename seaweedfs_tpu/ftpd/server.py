@@ -15,6 +15,7 @@ modern client (including stdlib ftplib) uses.
 
 from __future__ import annotations
 
+import contextlib
 import socket
 import socketserver
 import threading
@@ -91,19 +92,39 @@ class _Handler(socketserver.StreamRequestHandler):
             self.pasv = None
 
     def _data_conn(self) -> socket.socket | None:
-        """Accept the client's connection on the passive socket."""
+        """Accept the client's connection on the passive socket.
+
+        Only the control-connection peer may claim the data port: on a
+        non-loopback bind, a stranger racing to the advertised port first
+        could otherwise read RETR payloads or inject STOR content without
+        authenticating (classic PASV hijack).  Mismatched peers are closed
+        and the accept loop continues within the deadline.
+        """
         if self.pasv is None:
             self.reply(425, "use PASV or EPSV first")
             return None
-        self.pasv.settimeout(30)
+        deadline = time.monotonic() + 30
+        expected_ip = self.client_address[0]
         try:
-            conn, _ = self.pasv.accept()
-        except OSError:
-            self.reply(425, "data connection failed")
-            return None
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.reply(425, "data connection failed")
+                    return None
+                self.pasv.settimeout(remaining)
+                try:
+                    conn, peer = self.pasv.accept()
+                except OSError:
+                    self.reply(425, "data connection failed")
+                    return None
+                if peer[0] == expected_ip:
+                    return conn
+                try:
+                    conn.close()
+                except OSError:
+                    pass
         finally:
             self._close_pasv()
-        return conn
 
     def _resolve(self, arg: str) -> str:
         if not arg:
@@ -427,13 +448,31 @@ class FtpServer:
         self._srv.path_lock = self.path_lock  # type: ignore[attr-defined]
         self.port = self._srv.server_address[1]
         self._thread: threading.Thread | None = None
-        self._path_locks: dict[str, threading.Lock] = {}
+        self._path_locks: dict[str, list] = {}  # path -> [Lock, refcount]
         self._path_locks_guard = threading.Lock()
 
-    def path_lock(self, path: str) -> threading.Lock:
-        """Per-path mutex for read-modify-write ops (APPE) in this process."""
+    @contextlib.contextmanager
+    def path_lock(self, path: str):
+        """Per-path mutex for read-modify-write ops (APPE) in this process.
+
+        Refcounted: the entry is evicted once the last holder releases, so
+        a long-lived gateway serving many distinct paths doesn't grow an
+        unbounded lock table.
+        """
         with self._path_locks_guard:
-            return self._path_locks.setdefault(path, threading.Lock())
+            entry = self._path_locks.get(path)
+            if entry is None:
+                entry = self._path_locks[path] = [threading.Lock(), 0]
+            entry[1] += 1
+        entry[0].acquire()
+        try:
+            yield
+        finally:
+            entry[0].release()
+            with self._path_locks_guard:
+                entry[1] -= 1
+                if entry[1] == 0 and self._path_locks.get(path) is entry:
+                    del self._path_locks[path]
 
     def start(self) -> None:
         self._thread = threading.Thread(

@@ -517,15 +517,7 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         unread request body first — skipping it desyncs HTTP/1.1
         keep-alive (the next request parses the stale body as a request
         line)."""
-        try:
-            length = int(self.headers.get("Content-Length") or 0)
-        except ValueError:
-            length = 0
-        while length > 0:
-            chunk = self.rfile.read(min(length, 1 << 20))
-            if not chunk:
-                break
-            length -= len(chunk)
+        self._drain_body()
         leader = self.master.leader()
         if leader == f"{self.master.ip}:{self.master.port}":
             return self._json(503, {"error": "no leader elected yet"})
@@ -534,8 +526,45 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", "0")
         self.end_headers()
 
+    def do_DELETE(self):
+        u = urllib.parse.urlparse(self.path)
+        if u.path == "/col/delete":
+            return self._col_delete(u)
+        return self._json(404, {"error": f"unknown path {u.path}"})
+
+    def _col_delete(self, u) -> None:
+        # master_server_handlers_admin.go deleteFromMasterServerHandler
+        self._drain_body()  # keep-alive hygiene: params ride the query
+        q = urllib.parse.parse_qs(u.query)
+        name = q.get("collection", [""])[0]
+        if not name:
+            return self._json(400, {"error": "collection required"})
+        if not self.master.is_leader():
+            return self._redirect_to_leader()
+        self.master.delete_collection(name)
+        return self._json(200, {"collection": name, "deleted": True})
+
+    def _drain_body(self, cap: int = 1 << 20) -> None:
+        """Read and discard an unneeded request body so the next request
+        on this keep-alive connection doesn't parse it as a request line;
+        bodies over `cap` close the connection instead."""
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+        except ValueError:
+            length = 0
+        if length > cap:
+            self.close_connection = True
+            return
+        while length > 0:
+            chunk = self.rfile.read(min(length, 1 << 16))
+            if not chunk:
+                break
+            length -= len(chunk)
+
     def do_POST(self):
         u = urllib.parse.urlparse(self.path)
+        if u.path == "/col/delete":
+            return self._col_delete(u)
         if u.path == "/cluster/raft" and self.master.raft is not None:
             length = int(self.headers.get("Content-Length") or 0)
             payload = self.rfile.read(length)
@@ -559,6 +588,15 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
             q = urllib.parse.parse_qs(u.query)
             try:
                 length = int(self.headers.get("Content-Length") or 0)
+                # the master never handles object payloads elsewhere — cap
+                # /submit bodies so one oversized POST can't exhaust its
+                # memory (413 mirrors the volume server's own size check).
+                # Draining a >limit body is impractical, so the keep-alive
+                # connection closes instead of desyncing on the unread rest
+                if length > self.master.topo.volume_size_limit:
+                    self.close_connection = True
+                    return self._json(413, {
+                        "error": "submitted object exceeds volume size limit"})
                 body = self.rfile.read(length)
                 ctype = self.headers.get("Content-Type", "")
                 name = mime = b""
@@ -733,14 +771,10 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
                         })["locations"].append(n.id)
                 return self._json(200, {"Volumes": vols})
         if u.path == "/col/delete":
-            # master_server_handlers_admin.go deleteFromMasterServerHandler
-            name = qget("collection")
-            if not name:
-                return self._json(400, {"error": "collection required"})
-            if not self.master.is_leader():
-                return self._json(503, {"error": "not the leader"})
-            self.master.delete_collection(name)
-            return self._json(200, {"collection": name, "deleted": True})
+            # state-changing: POST/DELETE only, so a stray crawler's GET
+            # can't drop a collection
+            return self._json(405, {
+                "error": "collection delete requires POST or DELETE"})
         if u.path in ("/cluster/healthz", "/stats/health"):
             own = f"{self.master.ip}:{self.master.port}"
             healthy = (self.master.is_leader()

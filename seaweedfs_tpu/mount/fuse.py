@@ -230,8 +230,8 @@ class FuseMount:
         def rename(old, new):
             w.rename(old.decode(), new.decode())
 
-        def link(_old, _new):
-            return -errno.ENOSYS  # hard links: not in the minimum surface
+        def link(old, new):
+            w.link(old.decode(), new.decode())
 
         def chmod(path, mode):
             w.set_attr(path.decode(), mode=mode)

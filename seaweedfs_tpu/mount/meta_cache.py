@@ -65,6 +65,15 @@ class MetaCache:
             for k in [k for k in self._listed_dirs if k.startswith(prefix)]:
                 self._listed_dirs.discard(k)
 
+    def invalidate_hardlink(self, hard_link_id: bytes) -> None:
+        """Drop every cached entry sharing a hardlink id: a link/unlink
+        changes the shared counter server-side, so all sibling names'
+        cached attributes are stale at once."""
+        with self._lock:
+            for k in [k for k, e in self._entries.items()
+                      if e.hard_link_id == hard_link_id]:
+                del self._entries[k]
+
     # -- directory completeness -------------------------------------------
 
     def is_dir_listed(self, dir_path: str) -> bool:

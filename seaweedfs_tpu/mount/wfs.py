@@ -163,7 +163,7 @@ class WFS:
             "st_mtime": a.mtime or int(time.time()),
             "st_ctime": a.crtime or a.mtime or int(time.time()),
             "st_atime": a.mtime or int(time.time()),
-            "st_nlink": 1,
+            "st_nlink": max(1, entry.hard_link_counter),
             "st_blocks": (size + 511) // 512,
         }
 
@@ -192,6 +192,7 @@ class WFS:
 
     def unlink(self, path: str) -> None:
         directory, name = _split(path)
+        cached = self.meta.get(path)
         resp = self._stub().DeleteEntry(
             filer_pb2.DeleteEntryRequest(
                 directory=directory, name=name, is_delete_data=True
@@ -200,6 +201,9 @@ class WFS:
         if resp.error:
             raise FuseError(errno.ENOENT, resp.error)
         self.meta.delete(path)
+        if cached is not None and cached.hard_link_id:
+            # sibling links' cached st_nlink went stale with this unlink
+            self.meta.invalidate_hardlink(cached.hard_link_id)
 
     def rmdir(self, path: str) -> None:
         if self.list_dir(path):
@@ -233,6 +237,39 @@ class WFS:
             for h in self._handles.values():
                 if h.path == old:
                     h.path = new
+
+    def link(self, old_path: str, new_path: str) -> None:
+        """Hard link (dir_link.go:25-100): promote the source entry to
+        hardlink mode on the first link (random 16-byte id + marker byte,
+        counter 1), bump the shared counter, and create the new name as a
+        stub carrying the same id — the filer's KV meta owns the shared
+        attributes/chunks from then on."""
+        entry = self.lookup_entry(old_path)
+        if entry is None:
+            raise FuseError(errno.ENOENT)
+        if entry.is_directory:
+            raise FuseError(errno.EPERM)
+        old_dir, _ = _split(old_path)
+        e = filer_pb2.Entry()
+        e.CopyFrom(entry)
+        if not e.hard_link_id:
+            e.hard_link_id = os.urandom(16) + b"\x01"  # HARD_LINK_MARKER
+            e.hard_link_counter = 1
+        e.hard_link_counter += 1
+        self._update(old_dir, e)
+        nd, nn = _split(new_path)
+        new_entry = filer_pb2.Entry(
+            name=nn, is_directory=False,
+            hard_link_id=e.hard_link_id,
+            hard_link_counter=e.hard_link_counter,
+        )
+        new_entry.attributes.CopyFrom(e.attributes)
+        new_entry.chunks.extend(e.chunks)
+        for k, v in e.extended.items():
+            new_entry.extended[k] = v
+        self._create(nd, new_entry)
+        self.meta.invalidate_dir(old_dir)
+        self.meta.invalidate_hardlink(e.hard_link_id)
 
     def symlink(self, target: str, link_path: str) -> None:
         directory, name = _split(link_path)

@@ -30,6 +30,7 @@ import threading
 
 import numpy as np
 
+from ..ops import gf256
 from ..storage.ec.constants import (
     DATA_SHARDS,
     LARGE_BLOCK_SIZE,
@@ -39,10 +40,11 @@ from ..storage.ec.constants import (
 )
 from ..storage.ec.encoder import (
     DEFAULT_SLICE,
+    _read_at,
     _slice_tasks,
     fill_stripe_rows,
 )
-from .mesh import batch_encode_sharded, make_mesh
+from .mesh import batch_encode_sharded, distributed_reconstruct, make_mesh
 
 
 def batch_generate_ec_files(
@@ -91,6 +93,76 @@ def batch_generate_ec_files(
             v["f"].close()
             for o in v["outs"]:
                 o.close()
+
+
+def mesh_rebuild_ec_files(
+    base_name: str,
+    mesh=None,
+    slice_size: int = DEFAULT_SLICE,
+    progress=None,
+) -> list[int]:
+    """Regenerate missing `.ecNN` files with the decode matmul sharded over
+    the mesh: survivors' shard axis splits over ``dp`` (partial bit-plane
+    matmuls psum over the ICI), columns over ``sp``.
+
+    The distributed analogue of storage.ec.encoder.rebuild_ec_files
+    (reference envelope: ec_encoder.go:233-287) — same file semantics,
+    byte-identical output (pinned in tests/test_parallel.py), but the GF
+    work runs as ONE collective program per slice instead of a host loop.
+    Missing parity rows are composed into the same survivor->wanted matrix
+    (parity = generator-row x decode-matrix over GF), so data and parity
+    shards rebuild in a single sharded dispatch.
+
+    `progress(shard_bytes_done)` mirrors the serial rebuild's callback.
+    """
+    present = [i for i in range(TOTAL_SHARDS)
+               if os.path.exists(base_name + to_ext(i))]
+    missing = [i for i in range(TOTAL_SHARDS) if i not in present]
+    if not missing:
+        return []
+    if len(present) < DATA_SHARDS:
+        raise ValueError(
+            f"cannot rebuild: only {len(present)} of {TOTAL_SHARDS} "
+            "shards present")
+    if mesh is None:
+        mesh = make_mesh()
+    sp = mesh.shape["sp"]
+
+    sub = present[:DATA_SHARDS]  # survivors actually read, in shard order
+    matrix = gf256.rs_matrix(DATA_SHARDS, TOTAL_SHARDS)
+    dec = gf256.decode_matrix_for(matrix, DATA_SHARDS, present)
+    # survivor -> wanted rows: data rows straight from the decode matrix,
+    # parity rows composed through it (GF matrix product)
+    rows = np.stack([
+        dec[i] if i < DATA_SHARDS
+        else gf256.mat_mul(matrix[i:i + 1, :DATA_SHARDS], dec)[0]
+        for i in missing
+    ]).astype(np.uint8)
+
+    shard_size = os.path.getsize(base_name + to_ext(sub[0]))
+    ins = {i: open(base_name + to_ext(i), "rb") for i in sub}
+    outs = {i: open(base_name + to_ext(i), "wb") for i in missing}
+    try:
+        for off in range(0, shard_size, slice_size):
+            width = min(slice_size, shard_size - off)
+            # columns must split evenly over sp for the shard_map
+            w_pad = -(-width // sp) * sp
+            inputs = np.zeros((DATA_SHARDS, w_pad), dtype=np.uint8)
+            for row, i in enumerate(sub):
+                inputs[row, :width] = _read_at(ins[i], off, width)
+            rebuilt = np.asarray(
+                distributed_reconstruct(mesh, rows, inputs))
+            for row, i in enumerate(missing):
+                outs[i].write(
+                    np.ascontiguousarray(rebuilt[row, :width]))
+            if progress is not None:
+                progress(off + width)
+    finally:
+        for h in ins.values():
+            h.close()
+        for h in outs.values():
+            h.close()
+    return missing
 
 
 def _run_steps(vols, mesh, dp: int, progress) -> None:

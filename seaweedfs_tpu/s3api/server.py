@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import re
 import threading
 import time
 import urllib.parse
@@ -157,6 +158,21 @@ class S3ApiServer:
 
 
 # -- XML helpers --------------------------------------------------------------
+
+
+_BUCKET_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9.-]{1,61}[a-z0-9]$")
+_IPV4_RE = re.compile(r"^\d+\.\d+\.\d+\.\d+$")
+
+
+def _valid_bucket_name(name: str) -> bool:
+    """AWS bucket naming rules (the subset s3-tests pins): 3-63 chars of
+    lowercase/digits/dot/hyphen, alphanumeric ends, no '..'/'.-'/'-.'
+    runs, not formatted like an IPv4 address."""
+    if not _BUCKET_NAME_RE.match(name):
+        return False
+    if ".." in name or ".-" in name or "-." in name:
+        return False
+    return not _IPV4_RE.match(name)
 
 
 def _el(parent, tag: str, text: str | None = None):
@@ -396,6 +412,10 @@ class S3Handler(BaseHTTPRequestHandler):
 
     def put_bucket(self, bucket: str):
         self._authz(ACTION_ADMIN, bucket)
+        if not _valid_bucket_name(bucket):
+            raise S3Error(400, "InvalidBucketName",
+                          "bucket names are 3-63 chars of [a-z0-9.-], "
+                          "starting/ending alphanumeric")
         if self.s3.client.find_entry(BUCKETS_DIR, bucket) is not None:
             raise S3Error(409, "BucketAlreadyExists", "duplicate bucket")
         self.s3.client.mkdir(BUCKETS_DIR, bucket)
@@ -456,7 +476,23 @@ class S3Handler(BaseHTTPRequestHandler):
         q = self.query
         prefix = q.get("prefix", "")
         delimiter = q.get("delimiter", "")
-        max_keys = min(int(q.get("max-keys", "1000") or "1000"), 1000)
+        try:
+            max_keys = min(int(q.get("max-keys", "1000") or "1000"), 1000)
+        except ValueError:
+            raise S3Error(400, "InvalidArgument",
+                          "max-keys must be an integer")
+        if max_keys < 0:
+            raise S3Error(400, "InvalidArgument",
+                          "max-keys must be non-negative")
+        encoding = q.get("encoding-type", "")
+        if encoding and encoding != "url":
+            raise S3Error(400, "InvalidArgument",
+                          "encoding-type must be 'url'")
+
+        def enc(s: str) -> str:
+            # AWS url-encodes Key/Prefix values, '/' kept literal
+            return urllib.parse.quote(s, safe="/") if encoding else s
+
         if v2:
             marker = q.get("continuation-token") or q.get("start-after", "")
         else:
@@ -467,24 +503,30 @@ class S3Handler(BaseHTTPRequestHandler):
         tag = "ListBucketResult"
         root = ET.Element(tag, xmlns=XMLNS)
         _el(root, "Name", bucket)
-        _el(root, "Prefix", prefix)
+        _el(root, "Prefix", enc(prefix))
         if delimiter:
-            _el(root, "Delimiter", delimiter)
+            _el(root, "Delimiter", enc(delimiter))
         _el(root, "MaxKeys", str(max_keys))
         _el(root, "IsTruncated", "true" if truncated else "false")
+        # paging markers are keys too: they must be encoded with the same
+        # rule as Contents/Key or pagination breaks on the exact keys
+        # encoding-type exists for (bytes illegal in XML 1.0)
         if v2:
             _el(root, "KeyCount", str(len(contents)))
             if truncated:
-                _el(root, "NextContinuationToken", next_marker)
+                _el(root, "NextContinuationToken", enc(next_marker))
             if q.get("continuation-token"):
-                _el(root, "ContinuationToken", q["continuation-token"])
+                _el(root, "ContinuationToken",
+                    enc(q["continuation-token"]))
         else:
-            _el(root, "Marker", marker)
+            _el(root, "Marker", enc(marker))
             if truncated and delimiter:
-                _el(root, "NextMarker", next_marker)
+                _el(root, "NextMarker", enc(next_marker))
+        if encoding:
+            _el(root, "EncodingType", "url")
         for key, entry in contents:
             c = _el(root, "Contents")
-            _el(c, "Key", key)
+            _el(c, "Key", enc(key))
             _el(c, "LastModified", _iso(entry.attributes.mtime))
             _el(c, "ETag", f'"{_entry_etag(entry)}"')
             _el(c, "Size", str(_entry_size(entry)))
@@ -493,7 +535,7 @@ class S3Handler(BaseHTTPRequestHandler):
             _el(owner, "ID", OWNER_ID)
         for p in prefixes:
             cp = _el(root, "CommonPrefixes")
-            _el(cp, "Prefix", p)
+            _el(cp, "Prefix", enc(p))
         self._send(200, _xml_bytes(root))
 
     def _list(self, bucket: str, prefix: str, delimiter: str,
@@ -663,9 +705,33 @@ class S3Handler(BaseHTTPRequestHandler):
                 h["x-amz-meta-" + k[len(META_PREFIX):]] = v.decode()
         return h
 
+    def _check_conditionals(self, entry) -> bool:
+        """If-Match / If-None-Match (RFC 7232 as S3 applies it):
+        mismatched If-Match -> 412 PreconditionFailed; matching
+        If-None-Match -> True (caller answers 304).  ETags compare
+        without quotes; '*' matches any existing entry."""
+        etag = _entry_etag(entry)
+        if_match = self.headers.get("If-Match")
+        if if_match is not None and if_match != "*" and all(
+            t.strip().strip('"') != etag
+            for t in if_match.split(",")
+        ):
+            raise S3Error(412, "PreconditionFailed",
+                          "If-Match condition failed")
+        inm = self.headers.get("If-None-Match")
+        if inm is not None and (inm == "*" or any(
+            t.strip().strip('"') == etag for t in inm.split(","))):
+            return True
+        return False
+
     def get_object(self, bucket: str, key: str):
         self._authz(ACTION_READ, bucket)
         entry = self._find_object(bucket, key)
+        if self._check_conditionals(entry):
+            self.send_response(304)
+            self.send_header("ETag", f'"{_entry_etag(entry)}"')
+            self.end_headers()
+            return
         try:
             resp = self.s3.client.open_object(
                 self.s3.object_path(bucket, key),
@@ -696,6 +762,11 @@ class S3Handler(BaseHTTPRequestHandler):
     def head_object(self, bucket: str, key: str):
         self._authz(ACTION_READ, bucket)
         entry = self._find_object(bucket, key)
+        if self._check_conditionals(entry):
+            self.send_response(304)
+            self.send_header("ETag", f'"{_entry_etag(entry)}"')
+            self.end_headers()
+            return
         extra = self._object_headers(entry)
         extra["Content-Length"] = str(_entry_size(entry))
         self.send_response(200)
@@ -738,7 +809,9 @@ class S3Handler(BaseHTTPRequestHandler):
             err = self.s3.client.delete_entry(
                 directory, name, is_delete_data=True, is_recursive=True
             )
-            if err and "not found" not in err:
+            # AWS semantics: deleting a nonexistent key reports Deleted
+            # (the filer marks missing entries with a "not found:" prefix)
+            if err and not err.startswith("not found"):
                 e = _el(root, "Error")
                 _el(e, "Key", key)
                 _el(e, "Code", "InternalError")
@@ -857,6 +930,10 @@ class S3Handler(BaseHTTPRequestHandler):
         }
         if not wanted:
             wanted = [(n, "") for n in sorted(parts)]
+        elif [n for n, _ in wanted] != sorted(n for n, _ in wanted):
+            # AWS requires ascending part order in the complete request
+            raise S3Error(400, "InvalidPartOrder",
+                          "parts must be listed in ascending order")
         chunks: list[filer_pb2.FileChunk] = []
         offset = 0
         digests = b""
@@ -907,6 +984,9 @@ class S3Handler(BaseHTTPRequestHandler):
     def abort_multipart(self, bucket: str, key: str):
         self._authz(ACTION_WRITE, bucket)
         upload_id = self.query["uploadId"]
+        if self.s3.client.find_entry(
+                self._uploads_dir(bucket), upload_id) is None:
+            raise S3Error(404, "NoSuchUpload", "upload id not found")
         self.s3.client.delete_entry(
             self._uploads_dir(bucket), upload_id,
             is_delete_data=True, is_recursive=True,

@@ -89,9 +89,12 @@ def run_command(env: CommandEnv, line: str) -> str:
 
 
 DEFAULT_MAINTENANCE_SCRIPT = (
+    # the scaffold default block, line-for-line (command/scaffold.go:503-518;
+    # lock/unlock are implicit — run_maintenance holds the admin lock)
     "ec.encode -fullPercent=95 -quietFor=1h",
     "ec.rebuild -force",
     "ec.balance -force",
+    "volume.balance -force",
     "volume.fix.replication",
 )
 

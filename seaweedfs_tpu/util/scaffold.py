@@ -59,6 +59,7 @@ scripts = [
   "ec.encode -fullPercent=95 -quietFor=1h",
   "ec.rebuild -force",
   "ec.balance -force",
+  "volume.balance -force",
   "volume.fix.replication",
 ]
 # Seconds between runs (the reference's default is ~17 minutes).

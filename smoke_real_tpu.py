@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Opt-in real-chip smoke test: compiled-Mosaic byte-identity in ~seconds.
+
+CI runs the whole suite on a virtual CPU mesh (tests/conftest.py pins
+JAX_PLATFORMS=cpu), so the Pallas kernel is only ever exercised in
+interpreter mode there — compiled-Mosaic breakage on the real chip is
+structurally invisible to CI.  This script is the gap-closer: it encodes
+16MB through ``get_codec("tpu")`` on the real backend inside a
+subprocess with a hard 120s bound, asserts byte-equality against the CPU
+codec, and prints ONE JSON line either way.
+
+Run it at round start and commit the output as SMOKE_r{N}.json:
+
+    python smoke_real_tpu.py | tee SMOKE_r05.json
+
+A wedged axon tunnel (see .claude/skills/verify/SKILL.md) shows up as
+``{"ok": false, "error": "timeout ..."}`` — a true kernel regression as a
+byte mismatch.  Exit code 0 iff ok.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_CHILD_FLAG = "--child"
+_MB = 16
+
+
+def _child() -> None:
+    import numpy as np
+
+    t0 = time.perf_counter()
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops.codec import get_codec
+
+    rng = np.random.default_rng(0x5EED)
+    data = rng.integers(0, 256, (10, _MB << 20), dtype=np.uint8)
+    cpu = get_codec("cpu").parity_of(data)
+    t_cpu = time.perf_counter() - t0
+
+    tpu = get_codec("tpu")
+    t0 = time.perf_counter()
+    d3 = data.view(np.uint32).reshape(10, -1, 128)
+    out = tpu.encode_device_u32_3d(jnp.asarray(d3))
+    if out is None:
+        out = tpu.encode_device(jnp.asarray(data))
+        parity = np.asarray(out)
+    else:
+        parity = np.asarray(out).view(np.uint8).reshape(4, -1)
+    t_tpu = time.perf_counter() - t0
+    ok = bool(np.array_equal(parity, cpu))
+    print(json.dumps({
+        "ok": ok,
+        "bytes": int(data.size),
+        "cpu_seconds": round(t_cpu, 2),
+        "tpu_seconds_inc_compile": round(t_tpu, 2),
+        "backend": __import__("jax").devices()[0].platform,
+    }))
+    sys.exit(0 if ok else 1)
+
+
+def main() -> int:
+    if _CHILD_FLAG in sys.argv:
+        _child()
+        return 0
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), _CHILD_FLAG],
+            capture_output=True, text=True, timeout=120,
+        )
+    except subprocess.TimeoutExpired:
+        print(json.dumps({
+            "ok": False,
+            "error": "timeout after 120s (axon tunnel wedged or chip busy)",
+        }))
+        return 1
+    line = (proc.stdout.strip().splitlines() or ["{}"])[-1]
+    try:
+        parsed = json.loads(line)
+    except ValueError:
+        parsed = {"ok": False,
+                  "error": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+    print(json.dumps(parsed))
+    return 0 if parsed.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

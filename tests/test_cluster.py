@@ -479,7 +479,10 @@ def test_master_admin_http_endpoints(cluster):
     assert any(v["collection"] == "subm" for v in vols.values())
     code, body = _http("GET", f"{base}/vol/grow?collection=grown&count=1")
     assert code == 200 and json.loads(body)["count"] == 1
+    # state-changing: GET must refuse (crawler safety), POST/DELETE work
     code, body = _http("GET", f"{base}/col/delete?collection=subm")
+    assert code == 405
+    code, body = _http("POST", f"{base}/col/delete?collection=subm")
     assert code == 200 and json.loads(body)["deleted"]
     deadline = time.time() + 10
     while time.time() < deadline:

@@ -73,7 +73,7 @@ def test_minus_chunks():
 
 
 @pytest.fixture(params=["memory", "sqlite", "leveldb", "leveldb2",
-                        "redis", "abstract_sql"])
+                        "leveldb3", "redis", "abstract_sql"])
 def store(request, tmp_path):
     fake = None
     if request.param == "sqlite":
@@ -82,6 +82,8 @@ def store(request, tmp_path):
         s = make_store("leveldb", path=str(tmp_path / "filerldb"))
     elif request.param == "leveldb2":
         s = make_store("leveldb2", path=str(tmp_path / "filerldb2"))
+    elif request.param == "leveldb3":
+        s = make_store("leveldb3", path=str(tmp_path / "filerldb3"))
     elif request.param == "abstract_sql":
         # the shared mysql/postgres SQL layer, driven by the stdlib
         # DB-API driver so its dialect plumbing is exercised offline
@@ -624,3 +626,86 @@ def test_leveldb2_partitions_span_directories(tmp_path):
     s.delete_folder_children("/t")
     assert s.find_entry("/t/sub", "leaf.txt") is None
     s.close()
+
+
+def test_leveldb3_bucket_partitioning(tmp_path):
+    """leveldb3 routes /buckets/<b>/... into a per-bucket DB directory,
+    drops the whole DB on bucket subtree delete (leveldb3_store.go:248-261),
+    and survives a close/reopen with bucket DBs adopted from disk."""
+    import os
+
+    path = str(tmp_path / "ldb3")
+    s = make_store("leveldb3", path=path)
+    e = filer_pb2.Entry(name="o1")
+    e.attributes.file_size = 7
+    s.insert_entry("/buckets/b1/dirx", e)
+    # objects at bucket TOP LEVEL (the common S3 shape) route to the
+    # bucket DB too — the entry's FULL path decides, not its parent dir
+    s.insert_entry("/buckets/b1", filer_pb2.Entry(name="top.txt"))
+    s.insert_entry("/plain/dir", filer_pb2.Entry(name="p1"))
+    # the bucket entry itself is a child of /buckets in _main
+    s.insert_entry("/buckets", filer_pb2.Entry(name="b1", is_directory=True))
+    # the bucket got its own partition on disk; plain paths go to _main
+    assert os.path.isdir(os.path.join(path, "b1"))
+    assert os.path.isdir(os.path.join(path, "_main"))
+    assert s.find_entry("/buckets/b1/dirx", "o1").attributes.file_size == 7
+    assert s.find_entry("/buckets/b1", "top.txt") is not None
+    assert s.find_entry("/plain/dir", "p1") is not None
+    assert [x.name for x in s.list_entries("/buckets/b1/dirx")] == ["o1"]
+    assert [x.name for x in s.list_entries("/buckets/b1")] == ["top.txt"]
+    assert [x.name for x in s.list_entries("/buckets")] == ["b1"]
+
+    # reopen: bucket DBs adopted from disk
+    s.close()
+    s = make_store("leveldb3", path=path)
+    assert s.find_entry("/buckets/b1/dirx", "o1").attributes.file_size == 7
+
+    # whole-bucket delete drops the DB directory in O(1)
+    s.delete_folder_children("/buckets/b1")
+    assert not os.path.isdir(os.path.join(path, "b1"))
+    assert s.find_entry("/buckets/b1/dirx", "o1") is None
+    assert s.find_entry("/buckets/b1", "top.txt") is None
+    assert s.find_entry("/plain/dir", "p1") is not None
+
+    # wiping /buckets itself must drop EVERY bucket DB — a recreated
+    # bucket must not resurrect old objects from a lazily-reopened DB
+    s.insert_entry("/buckets/b2/d", filer_pb2.Entry(name="ghost.txt"))
+    assert os.path.isdir(os.path.join(path, "b2"))
+    s.delete_folder_children("/buckets")
+    assert not os.path.isdir(os.path.join(path, "b2"))
+    assert s.find_entry("/buckets/b2/d", "ghost.txt") is None
+    s.close()
+
+
+def test_filer_hardlink_rewrite_reclaims_shadowed_chunks():
+    """Rewriting a hardlinked file in place must garbage-collect the
+    shadowed shared chunks (every link sees the new content through the
+    KV meta), and unlinking the last name reclaims the rest."""
+    deleted = []
+    f = Filer(make_store("memory"), delete_chunks_fn=deleted.extend)
+    e = filer_pb2.Entry(name="a", hard_link_id=b"x" * 17,
+                        hard_link_counter=2)
+    e.chunks.append(chunk("1,old", 0, 10, 1))
+    f.create_entry("/hl", e)
+    b = filer_pb2.Entry(name="b", hard_link_id=b"x" * 17,
+                        hard_link_counter=2)
+    b.chunks.append(chunk("1,old", 0, 10, 1))
+    f.create_entry("/hl", b)
+    # rewrite a in place with a new chunk: the old shared chunk is
+    # shadowed for EVERY link and must be queued
+    e2 = filer_pb2.Entry(name="a", hard_link_id=b"x" * 17,
+                         hard_link_counter=2)
+    e2.chunks.append(chunk("2,new", 0, 10, 2))
+    f.update_entry("/hl", e2)
+    f.drain_deletions()
+    assert deleted == ["1,old"]
+    # both names read the new chunk through the KV meta
+    assert [c.file_id for c in f.find_entry("/hl/b").chunks] == ["2,new"]
+    # unlink both: data reclaimed exactly once, at the last unlink
+    f.delete_entry("/hl", "a")
+    f.drain_deletions()
+    assert deleted == ["1,old"]
+    f.delete_entry("/hl", "b")
+    f.drain_deletions()
+    assert deleted == ["1,old", "2,new"]
+    f.close()

@@ -314,7 +314,18 @@ def test_kernel_fuse_mount(mount_cluster, tmp_path):
         os.rename(f"{mp}/docs/blob.bin", f"{mp}/docs/blob2.bin")
         with open(f"{mp}/docs/blob2.bin", "rb") as f:
             assert f.read(16) == payload[:16]
+        # hard links through the KERNEL: os.link -> FUSE link op ->
+        # filer hardlink KV (dir_link.go parity)
+        os.link(f"{mp}/docs/blob2.bin", f"{mp}/docs/blob3.bin")
+        st = os.stat(f"{mp}/docs/blob2.bin")
+        assert st.st_nlink == 2
+        with open(f"{mp}/docs/blob3.bin", "rb") as f:
+            assert f.read(16) == payload[:16]
         os.remove(f"{mp}/docs/blob2.bin")
+        with open(f"{mp}/docs/blob3.bin", "rb") as f:  # survives unlink
+            assert f.read(16) == payload[:16]
+        assert os.stat(f"{mp}/docs/blob3.bin").st_nlink == 1
+        os.remove(f"{mp}/docs/blob3.bin")
         assert os.listdir(f"{mp}/docs") == []
         os.rmdir(f"{mp}/docs")
         # the durable state lives in the filer, not the mount
@@ -367,3 +378,37 @@ def test_wfs_cipher_write_and_read(mount_cluster, tmp_path):
     finally:
         w.close()
         filer.stop()
+
+
+def test_wfs_hardlink_roundtrip(wfs):
+    """Hard links through the WFS surface (dir_link.go semantics): both
+    names read the shared bytes, st_nlink reflects the counter, unlinking
+    one name keeps the data alive, unlinking the last reclaims it."""
+    wfs.mkdir("/hl")
+    h = wfs.open("/hl/a.txt", create=True)
+    h.write(0, b"shared-bytes" * 100000)  # >1MB so real chunks exist
+    h.flush()
+    wfs.release(h)
+
+    wfs.link("/hl/a.txt", "/hl/b.txt")
+    ea = wfs.lookup_entry("/hl/a.txt")
+    eb = wfs.lookup_entry("/hl/b.txt")
+    assert ea.hard_link_id and ea.hard_link_id == eb.hard_link_id
+    assert wfs.getattr("/hl/a.txt")["st_nlink"] == 2
+    assert wfs.getattr("/hl/b.txt")["st_nlink"] == 2
+    h = wfs.open("/hl/b.txt")
+    assert h.read(0, 12) == b"shared-bytes"
+    wfs.release(h)
+
+    # link to a third name, drop the original: data stays readable
+    wfs.link("/hl/b.txt", "/hl/c.txt")
+    wfs.unlink("/hl/a.txt")
+    assert wfs.getattr("/hl/b.txt")["st_nlink"] == 2
+    assert wfs.getattr("/hl/c.txt")["st_nlink"] == 2
+    h = wfs.open("/hl/c.txt")
+    assert h.read(0, 12) == b"shared-bytes"
+    wfs.release(h)
+
+    wfs.unlink("/hl/b.txt")
+    wfs.unlink("/hl/c.txt")
+    assert wfs.lookup_entry("/hl/c.txt") is None

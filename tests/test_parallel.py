@@ -179,3 +179,41 @@ def test_balanced_ec_distribution_scenarios():
     # insufficient total capacity refuses instead of over-packing
     with _pytest.raises(ValueError):
         balanced_ec_distribution({"a": 5, "b": 5})
+
+
+def test_mesh_rebuild_ec_files_byte_identical(tmp_path):
+    """The file-level distributed rebuild (BASELINE config 3 at scale):
+    lose the 4 FIRST data shards (worst case, full decode-matrix inversion)
+    plus a parity shard, rebuild through the dp-psum decode matmul, and
+    every regenerated shard file is byte-identical to the originals."""
+    import os
+
+    import numpy as np
+
+    from seaweedfs_tpu.parallel.batch import mesh_rebuild_ec_files
+    from seaweedfs_tpu.parallel.mesh import make_mesh
+    from seaweedfs_tpu.storage.ec import constants as ecc
+    from seaweedfs_tpu.storage.ec.encoder import generate_ec_files
+
+    rng = np.random.default_rng(9)
+    base = str(tmp_path / "v")
+    with open(base + ".dat", "wb") as f:
+        f.write(rng.integers(0, 256, 33_077, dtype=np.uint8).tobytes())
+    generate_ec_files(base, large_block_size=10000, small_block_size=100,
+                      slice_size=512)
+    mesh = make_mesh()
+    for lost in ([0, 1, 2, 3],   # worst case: full decode-matrix inversion
+                 [7, 11, 13]):   # data + parity mix (composed parity rows)
+        expect = {}
+        for i in lost:
+            p = base + ecc.to_ext(i)
+            expect[p] = open(p, "rb").read()
+            os.remove(p)
+        seen = []
+        rebuilt = mesh_rebuild_ec_files(base, mesh=mesh, slice_size=511,
+                                        progress=seen.append)
+        assert rebuilt == lost
+        shard_size = os.path.getsize(base + ecc.to_ext(4))
+        assert seen and seen[-1] == shard_size
+        for p, want in expect.items():
+            assert open(p, "rb").read() == want, f"{p} differs"

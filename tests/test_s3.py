@@ -237,13 +237,13 @@ def _base(s3_cluster):
 
 def test_s3_bucket_lifecycle(s3_cluster):
     base = _base(s3_cluster)
-    code, _, _ = _req("PUT", f"{base}/b1")
+    code, _, _ = _req("PUT", f"{base}/bk1")
     assert code == 200
-    code, _, _ = _req("PUT", f"{base}/b1")
+    code, _, _ = _req("PUT", f"{base}/bk1")
     assert code == 409  # duplicate
     code, _, body = _req("GET", f"{base}/")
-    assert code == 200 and b"<Name>b1</Name>" in body
-    code, _, _ = _req("HEAD", f"{base}/b1")
+    assert code == 200 and b"<Name>bk1</Name>" in body
+    code, _, _ = _req("HEAD", f"{base}/bk1")
     assert code == 200
     code, _, _ = _req("HEAD", f"{base}/nope")
     assert code == 404
@@ -335,8 +335,8 @@ def test_s3_listing(s3_cluster):
 
 def test_s3_multipart(s3_cluster):
     base = _base(s3_cluster)
-    _req("PUT", f"{base}/mp")
-    code, _, body = _req("POST", f"{base}/mp/big.bin?uploads", b"")
+    _req("PUT", f"{base}/mpb")
+    code, _, body = _req("POST", f"{base}/mpb/big.bin?uploads", b"")
     assert code == 200
     upload_id = ET.fromstring(body).findtext(
         "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId"
@@ -348,12 +348,12 @@ def test_s3_multipart(s3_cluster):
     etags = []
     for i, p in ((1, p1), (2, p2)):
         code, headers, _ = _req(
-            "PUT", f"{base}/mp/big.bin?partNumber={i}&uploadId={upload_id}", p
+            "PUT", f"{base}/mpb/big.bin?partNumber={i}&uploadId={upload_id}", p
         )
         assert code == 200
         etags.append(headers["ETag"])
     # list parts
-    code, _, body = _req("GET", f"{base}/mp/big.bin?uploadId={upload_id}")
+    code, _, body = _req("GET", f"{base}/mpb/big.bin?uploadId={upload_id}")
     assert code == 200 and b"<PartNumber>1</PartNumber>" in body
     complete = (
         "<CompleteMultipartUpload>"
@@ -364,14 +364,14 @@ def test_s3_multipart(s3_cluster):
         + "</CompleteMultipartUpload>"
     ).encode()
     code, _, body = _req(
-        "POST", f"{base}/mp/big.bin?uploadId={upload_id}", complete
+        "POST", f"{base}/mpb/big.bin?uploadId={upload_id}", complete
     )
     assert code == 200 and b"CompleteMultipartUploadResult" in body
-    code, headers, got = _req("GET", f"{base}/mp/big.bin")
+    code, headers, got = _req("GET", f"{base}/mpb/big.bin")
     assert code == 200 and got == p1 + p2
     assert headers["ETag"].endswith('-2"')
     # upload dir is gone
-    code, _, body = _req("GET", f"{base}/mp?uploads")
+    code, _, body = _req("GET", f"{base}/mpb?uploads")
     assert upload_id.encode() not in body
 
 
@@ -394,41 +394,41 @@ def test_s3_multipart_abort(s3_cluster):
 
 def test_s3_delete_multiple(s3_cluster):
     base = _base(s3_cluster)
-    _req("PUT", f"{base}/dm")
+    _req("PUT", f"{base}/dmb")
     for k in ["x1", "x2", "x3"]:
-        _req("PUT", f"{base}/dm/{k}", b"v")
+        _req("PUT", f"{base}/dmb/{k}", b"v")
     payload = (
         "<Delete>"
         "<Object><Key>x1</Key></Object>"
         "<Object><Key>x3</Key></Object>"
         "</Delete>"
     ).encode()
-    code, _, body = _req("POST", f"{base}/dm?delete", payload)
+    code, _, body = _req("POST", f"{base}/dmb?delete", payload)
     assert code == 200
     assert body.count(b"<Deleted>") == 2
-    code, _, _ = _req("GET", f"{base}/dm/x1")
+    code, _, _ = _req("GET", f"{base}/dmb/x1")
     assert code == 404
-    code, _, _ = _req("GET", f"{base}/dm/x2")
+    code, _, _ = _req("GET", f"{base}/dmb/x2")
     assert code == 200
 
 
 def test_s3_tagging(s3_cluster):
     base = _base(s3_cluster)
-    _req("PUT", f"{base}/tg")
-    _req("PUT", f"{base}/tg/obj", b"v")
+    _req("PUT", f"{base}/tgb")
+    _req("PUT", f"{base}/tgb/obj", b"v")
     tags = (
         "<Tagging><TagSet>"
         "<Tag><Key>env</Key><Value>prod</Value></Tag>"
         "<Tag><Key>team</Key><Value>tpu</Value></Tag>"
         "</TagSet></Tagging>"
     ).encode()
-    code, _, _ = _req("PUT", f"{base}/tg/obj?tagging", tags)
+    code, _, _ = _req("PUT", f"{base}/tgb/obj?tagging", tags)
     assert code == 200
-    code, _, body = _req("GET", f"{base}/tg/obj?tagging")
+    code, _, body = _req("GET", f"{base}/tgb/obj?tagging")
     assert code == 200 and b"<Key>env</Key>" in body and b"prod" in body
-    code, _, _ = _req("DELETE", f"{base}/tg/obj?tagging")
+    code, _, _ = _req("DELETE", f"{base}/tgb/obj?tagging")
     assert code == 204
-    code, _, body = _req("GET", f"{base}/tg/obj?tagging")
+    code, _, body = _req("GET", f"{base}/tgb/obj?tagging")
     assert b"<Tag>" not in body
 
 

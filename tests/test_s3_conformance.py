@@ -1,0 +1,599 @@
+"""S3 conformance corpus: ported assertions from the ceph/s3-tests suite.
+
+The reference runs ceph/s3-tests in a container
+(/root/reference/docker/Dockerfile.s3tests:20,
+docker/compose/local-s3tests-compose.yml); that suite cannot run here
+(zero egress, no boto), so this file ports a representative subset of its
+functional assertions (~30 cases) against the gateway, named after the
+s3-tests cases they mirror (s3tests/functional/test_s3.py).  Unlike
+tests/test_s3.py — written alongside the implementation, sharing its
+blind spots — these assertions encode EXTERNAL expectations: list
+continuation/delimiter behavior, ranged and conditional reads, multipart
+edge cases, and error-code XML bodies.
+"""
+
+import hashlib
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from helpers import free_port
+
+_NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+def _free_port():
+    return free_port()
+
+
+def _req(method, url, data=None, headers=None):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=20) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+@pytest.fixture(scope="module")
+def s3(tmp_path_factory):
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.s3api.server import S3ApiServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    master = MasterServer(ip="127.0.0.1", port=_free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("confvol"))],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=_free_port(), pulse_seconds=0.5,
+        max_volume_count=200,
+    )
+    vs.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and len(master.topo.nodes) < 1:
+        time.sleep(0.1)
+    filer = FilerServer(
+        masters=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=_free_port(),
+        store="leveldb3",
+        store_path=str(tmp_path_factory.mktemp("confdb") / "ldb3"),
+        max_mb=1,
+    )
+    filer.start()
+    gw = S3ApiServer(filer=f"127.0.0.1:{filer.port}", port=_free_port())
+    gw.start()
+    yield f"http://127.0.0.1:{gw.port}"
+    gw.stop()
+    filer.stop()
+    vs.stop()
+    master.stop()
+
+
+def _mk_bucket(base, name):
+    code, _, _ = _req("PUT", f"{base}/{name}")
+    assert code in (200, 409)
+
+
+def _put(base, bucket, key, body=b"x", headers=None):
+    code, hdrs, _ = _req("PUT", f"{base}/{bucket}/{key}", body, headers)
+    assert code == 200, (bucket, key, code)
+    return hdrs
+
+
+def _xml(body):
+    return ET.fromstring(body)
+
+
+def _findall(root, tag):
+    return root.findall(f"{_NS}{tag}") + root.findall(tag)
+
+
+def _find(root, tag):
+    el = root.find(f"{_NS}{tag}")
+    return el if el is not None else root.find(tag)
+
+
+def _text(root, tag, default=None):
+    el = _find(root, tag)
+    return el.text if el is not None and el.text is not None else default
+
+
+def _keys(root):
+    out = []
+    for c in _findall(root, "Contents"):
+        out.append(_text(c, "Key"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Listing (s3tests: test_bucket_list_*)
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_list_empty(s3):
+    _mk_bucket(s3, "empty-b")
+    code, _, body = _req("GET", f"{s3}/empty-b")
+    assert code == 200
+    root = _xml(body)
+    assert _keys(root) == []
+    assert _text(root, "IsTruncated") == "false"
+
+
+def test_bucket_list_delimiter_basic(s3):
+    # s3tests test_bucket_list_delimiter_basic: keys foo/bar, foo/baz/xyzzy,
+    # quux/thud, asdf with delimiter '/' -> one key + two common prefixes
+    _mk_bucket(s3, "delim-b")
+    for k in ("foo/bar", "foo/baz/xyzzy", "quux/thud", "asdf"):
+        _put(s3, "delim-b", k)
+    code, _, body = _req("GET", f"{s3}/delim-b?delimiter=/")
+    assert code == 200
+    root = _xml(body)
+    assert _keys(root) == ["asdf"]
+    prefixes = sorted(
+        _text(p, "Prefix") for p in _findall(root, "CommonPrefixes"))
+    assert prefixes == ["foo/", "quux/"]
+    assert _text(root, "Delimiter") == "/"
+
+
+def test_bucket_list_delimiter_prefix(s3):
+    # s3tests test_bucket_list_delimiter_prefix: prefix+delimiter paging
+    _mk_bucket(s3, "dp-b")
+    for k in ("asdf", "boo/bar", "boo/baz/xyzzy", "cquux/thud"):
+        _put(s3, "dp-b", k)
+    code, _, body = _req("GET", f"{s3}/dp-b?prefix=boo/&delimiter=/")
+    root = _xml(body)
+    assert _keys(root) == ["boo/bar"]
+    assert [_text(p, "Prefix") for p in _findall(root, "CommonPrefixes")] \
+        == ["boo/baz/"]
+
+
+def test_bucket_list_maxkeys_one(s3):
+    # s3tests test_bucket_list_maxkeys_one: truncation + marker resume
+    _mk_bucket(s3, "mk1-b")
+    keys = ["bar", "baz", "foo", "quxx"]
+    for k in keys:
+        _put(s3, "mk1-b", k)
+    code, _, body = _req("GET", f"{s3}/mk1-b?max-keys=1")
+    root = _xml(body)
+    assert _keys(root) == keys[:1]
+    assert _text(root, "IsTruncated") == "true"
+    code, _, body = _req("GET", f"{s3}/mk1-b?marker={keys[0]}")
+    root = _xml(body)
+    assert _keys(root) == keys[1:]
+    assert _text(root, "IsTruncated") == "false"
+
+
+def test_bucket_list_maxkeys_invalid(s3):
+    # s3tests test_bucket_list_maxkeys_invalid: non-numeric -> 400
+    _mk_bucket(s3, "mki-b")
+    code, _, body = _req("GET", f"{s3}/mki-b?max-keys=blah")
+    assert code == 400
+    assert b"InvalidArgument" in body
+
+
+def test_bucket_list_marker_after_list(s3):
+    # s3tests test_bucket_list_marker_after_list: marker past the end
+    _mk_bucket(s3, "mal-b")
+    for k in ("aaa", "bbb"):
+        _put(s3, "mal-b", k)
+    code, _, body = _req("GET", f"{s3}/mal-b?marker=zzz")
+    root = _xml(body)
+    assert _keys(root) == []
+    assert _text(root, "IsTruncated") == "false"
+
+
+def test_bucket_listv2_continuationtoken(s3):
+    # s3tests test_bucket_listv2_continuationtoken
+    _mk_bucket(s3, "v2ct-b")
+    keys = ["bar", "baz", "foo", "quxx"]
+    for k in keys:
+        _put(s3, "v2ct-b", k)
+    code, _, body = _req("GET", f"{s3}/v2ct-b?list-type=2&max-keys=1")
+    root = _xml(body)
+    assert _keys(root) == ["bar"]
+    assert _text(root, "IsTruncated") == "true"
+    token = _text(root, "NextContinuationToken")
+    assert token
+    code, _, body = _req(
+        "GET",
+        f"{s3}/v2ct-b?list-type=2&continuation-token="
+        f"{urllib.parse.quote(token)}")
+    root = _xml(body)
+    assert _keys(root) == keys[1:]
+    assert _text(root, "IsTruncated") == "false"
+
+
+def test_bucket_listv2_startafter(s3):
+    # s3tests test_bucket_listv2_startafter_after_list
+    _mk_bucket(s3, "v2sa-b")
+    for k in ("bar", "baz", "foo"):
+        _put(s3, "v2sa-b", k)
+    code, _, body = _req("GET", f"{s3}/v2sa-b?list-type=2&start-after=baz")
+    root = _xml(body)
+    assert _keys(root) == ["foo"]
+    code, _, body = _req("GET", f"{s3}/v2sa-b?list-type=2&start-after=zzz")
+    assert _keys(_xml(body)) == []
+
+
+def test_bucket_listv2_keycount(s3):
+    # v2 responses carry KeyCount
+    _mk_bucket(s3, "v2kc-b")
+    for k in ("a", "b", "c"):
+        _put(s3, "v2kc-b", k)
+    code, _, body = _req("GET", f"{s3}/v2kc-b?list-type=2")
+    assert _text(_xml(body), "KeyCount") == "3"
+
+
+def test_bucket_list_encoding_url(s3):
+    # s3tests encoding-type=url: keys come back percent-encoded.
+    # PUT "sp%20ace+plus" stores key "sp ace+plus" (the path decodes),
+    # so the url-encoded listing must round-trip back to that.
+    _mk_bucket(s3, "enc-b")
+    _put(s3, "enc-b", "sp%20ace+plus")
+    code, _, body = _req("GET", f"{s3}/enc-b?encoding-type=url")
+    root = _xml(body)
+    assert _text(root, "EncodingType") == "url"
+    keys = _keys(root)
+    assert len(keys) == 1
+    assert keys[0] != "sp ace+plus"  # actually encoded on the wire
+    assert urllib.parse.unquote(keys[0]) == "sp ace+plus"
+
+
+# ---------------------------------------------------------------------------
+# Objects (s3tests: test_object_*)
+# ---------------------------------------------------------------------------
+
+
+def test_object_read_notexist(s3):
+    _mk_bucket(s3, "or-b")
+    code, _, body = _req("GET", f"{s3}/or-b/missing-key")
+    assert code == 404
+    assert b"NoSuchKey" in body
+
+
+def test_object_in_nonexistent_bucket(s3):
+    code, _, body = _req("PUT", f"{s3}/no-such-bkt-xyz/k", b"x")
+    assert code == 404
+    assert b"NoSuchBucket" in body
+
+
+def test_object_head_zero_bytes(s3):
+    _mk_bucket(s3, "zero-b")
+    _put(s3, "zero-b", "empty", b"")
+    code, headers, _ = _req("HEAD", f"{s3}/zero-b/empty")
+    assert code == 200
+    assert headers.get("Content-Length") == "0"
+
+
+def test_object_write_read_update_read_delete(s3):
+    _mk_bucket(s3, "wrud-b")
+    _put(s3, "wrud-b", "k", b"zzz")
+    code, _, body = _req("GET", f"{s3}/wrud-b/k")
+    assert (code, body) == (200, b"zzz")
+    _put(s3, "wrud-b", "k", b"new-content")
+    code, _, body = _req("GET", f"{s3}/wrud-b/k")
+    assert (code, body) == (200, b"new-content")
+    code, _, _ = _req("DELETE", f"{s3}/wrud-b/k")
+    assert code == 204
+    code, _, _ = _req("GET", f"{s3}/wrud-b/k")
+    assert code == 404
+
+
+def test_object_set_get_metadata_overwrite(s3):
+    # s3tests test_object_set_get_metadata_overwrite_to_empty
+    _mk_bucket(s3, "meta-b")
+    _put(s3, "meta-b", "m", b"1", {"x-amz-meta-meta1": "bar"})
+    code, headers, _ = _req("GET", f"{s3}/meta-b/m")
+    assert headers.get("x-amz-meta-meta1") == "bar"
+    _put(s3, "meta-b", "m", b"2")  # rewrite without metadata clears it
+    code, headers, _ = _req("GET", f"{s3}/meta-b/m")
+    assert headers.get("x-amz-meta-meta1") is None
+
+
+def test_object_copy_same_bucket(s3):
+    # s3tests test_object_copy_same_bucket
+    _mk_bucket(s3, "copy-b")
+    _put(s3, "copy-b", "src", b"copy-me")
+    code, _, body = _req(
+        "PUT", f"{s3}/copy-b/dst", b"",
+        {"x-amz-copy-source": "/copy-b/src"})
+    assert code == 200
+    assert b"CopyObjectResult" in body
+    code, _, body = _req("GET", f"{s3}/copy-b/dst")
+    assert body == b"copy-me"
+
+
+def test_object_copy_notexist(s3):
+    _mk_bucket(s3, "copy404-b")
+    code, _, body = _req(
+        "PUT", f"{s3}/copy404-b/dst", b"",
+        {"x-amz-copy-source": "/copy404-b/ghost"})
+    assert code == 404
+
+
+def test_ranged_request_response_code(s3):
+    # s3tests test_ranged_request_response_code: bytes=4-7 of 11 bytes
+    _mk_bucket(s3, "range-b")
+    _put(s3, "range-b", "r", b"testcontent")
+    code, headers, body = _req(
+        "GET", f"{s3}/range-b/r", headers={"Range": "bytes=4-7"})
+    assert code == 206
+    assert body == b"cont"
+    assert headers.get("Content-Range") == "bytes 4-7/11"
+
+
+def test_ranged_request_skip_leading_and_suffix(s3):
+    # s3tests test_ranged_request_skip_leading_bytes_response_code and
+    # test_ranged_request_return_trailing_bytes_response_code
+    _mk_bucket(s3, "range2-b")
+    _put(s3, "range2-b", "r", b"testcontent")
+    code, _, body = _req(
+        "GET", f"{s3}/range2-b/r", headers={"Range": "bytes=4-"})
+    assert (code, body) == (206, b"content")
+    code, _, body = _req(
+        "GET", f"{s3}/range2-b/r", headers={"Range": "bytes=-7"})
+    assert (code, body) == (206, b"content")
+
+
+def test_ranged_request_invalid_range(s3):
+    # s3tests test_ranged_request_invalid_range: out of bounds -> 416
+    _mk_bucket(s3, "range3-b")
+    _put(s3, "range3-b", "r", b"short")
+    code, _, body = _req(
+        "GET", f"{s3}/range3-b/r", headers={"Range": "bytes=40-50"})
+    assert code == 416
+
+
+def test_ranged_request_empty_object(s3):
+    # s3tests test_ranged_request_empty_object: any range on empty -> 416
+    _mk_bucket(s3, "range4-b")
+    _put(s3, "range4-b", "r", b"")
+    code, _, _ = _req(
+        "GET", f"{s3}/range4-b/r", headers={"Range": "bytes=0-10"})
+    assert code == 416
+
+
+def test_get_object_ifmatch_failed(s3):
+    # s3tests test_get_object_ifmatch_failed: wrong etag -> 412
+    _mk_bucket(s3, "cond-b")
+    _put(s3, "cond-b", "c", b"conditional")
+    code, _, _ = _req(
+        "GET", f"{s3}/cond-b/c",
+        headers={"If-Match": '"bogus-etag"'})
+    assert code == 412
+    good = _req("HEAD", f"{s3}/cond-b/c")[1].get("ETag")
+    code, _, body = _req(
+        "GET", f"{s3}/cond-b/c", headers={"If-Match": good})
+    assert (code, body) == (200, b"conditional")
+
+
+def test_get_object_ifnonematch_good(s3):
+    # s3tests test_get_object_ifnonematch_good: matching etag -> 304
+    _mk_bucket(s3, "cond2-b")
+    _put(s3, "cond2-b", "c", b"abc")
+    etag = _req("HEAD", f"{s3}/cond2-b/c")[1].get("ETag")
+    code, _, _ = _req(
+        "GET", f"{s3}/cond2-b/c", headers={"If-None-Match": etag})
+    assert code == 304
+
+
+# ---------------------------------------------------------------------------
+# Buckets (s3tests: test_bucket_*)
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_delete_notexist(s3):
+    code, _, body = _req("DELETE", f"{s3}/ghost-bucket-zz")
+    assert code == 404
+    assert b"NoSuchBucket" in body
+
+
+def test_bucket_delete_nonempty(s3):
+    _mk_bucket(s3, "full-b")
+    _put(s3, "full-b", "k")
+    code, _, body = _req("DELETE", f"{s3}/full-b")
+    assert code == 409
+    assert b"BucketNotEmpty" in body
+
+
+def test_bucket_create_naming_bad_short_one(s3):
+    # s3tests test_bucket_create_naming_bad_short_one: "a" -> 400
+    code, _, body = _req("PUT", f"{s3}/a")
+    assert code == 400
+    assert b"InvalidBucketName" in body
+
+
+def test_bucket_create_naming_bad_uppercase(s3):
+    code, _, body = _req("PUT", f"{s3}/BadUpper")
+    assert code == 400
+    assert b"InvalidBucketName" in body
+
+
+def test_bucket_head_extended(s3):
+    # HEAD on missing bucket: bare 404, no body parsing required
+    code, _, _ = _req("HEAD", f"{s3}/head-ghost-b")
+    assert code == 404
+
+
+# ---------------------------------------------------------------------------
+# Multipart (s3tests: test_multipart_*, test_abort_multipart_*)
+# ---------------------------------------------------------------------------
+
+
+def _initiate(s3, bucket, key):
+    code, _, body = _req("POST", f"{s3}/{bucket}/{key}?uploads", b"")
+    assert code == 200
+    return _text(_xml(body), "UploadId")
+
+
+def _upload_part(s3, bucket, key, upload_id, num, data):
+    code, headers, _ = _req(
+        "PUT",
+        f"{s3}/{bucket}/{key}?partNumber={num}&uploadId={upload_id}",
+        data)
+    assert code == 200
+    return headers.get("ETag")
+
+
+def _complete_xml(parts):
+    inner = "".join(
+        f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+        for n, e in parts)
+    return f"<CompleteMultipartUpload>{inner}</CompleteMultipartUpload>" \
+        .encode()
+
+
+def test_multipart_upload(s3):
+    # s3tests test_multipart_upload: 3 parts, ETag gets "-<n>" suffix
+    _mk_bucket(s3, "mp-b")
+    uid = _initiate(s3, "mp-b", "big")
+    part = b"p" * (5 << 20)
+    etags = [_upload_part(s3, "mp-b", "big", uid, n, part)
+             for n in (1, 2)]
+    etags.append(_upload_part(s3, "mp-b", "big", uid, 3, b"tail"))
+    code, _, body = _req(
+        "POST", f"{s3}/mp-b/big?uploadId={uid}",
+        _complete_xml(list(zip((1, 2, 3), etags))))
+    assert code == 200
+    assert b"CompleteMultipartUploadResult" in body
+    etag = _text(_xml(body), "ETag")
+    assert etag and etag.strip('"').endswith("-3")
+    code, headers, got = _req("GET", f"{s3}/mp-b/big")
+    assert code == 200
+    assert got == part * 2 + b"tail"
+
+
+def test_multipart_upload_incorrect_etag(s3):
+    # s3tests test_multipart_upload_incorrect_etag -> 400 InvalidPart
+    _mk_bucket(s3, "mpe-b")
+    uid = _initiate(s3, "mpe-b", "bad")
+    _upload_part(s3, "mpe-b", "bad", uid, 1, b"data")
+    code, _, body = _req(
+        "POST", f"{s3}/mpe-b/bad?uploadId={uid}",
+        _complete_xml([(1, '"ffffffffffffffffffffffffffffffff"')]))
+    assert code == 400
+    assert b"InvalidPart" in body
+
+
+def test_multipart_upload_missing_part(s3):
+    # complete references a part never uploaded -> 400 InvalidPart
+    _mk_bucket(s3, "mpm-b")
+    uid = _initiate(s3, "mpm-b", "miss")
+    _upload_part(s3, "mpm-b", "miss", uid, 1, b"data")
+    code, _, body = _req(
+        "POST", f"{s3}/mpm-b/miss?uploadId={uid}",
+        _complete_xml([(1, '"%s"' % hashlib.md5(b"data").hexdigest()),
+                       (2, '"%s"' % hashlib.md5(b"x").hexdigest())]))
+    assert code == 400
+    assert b"InvalidPart" in body
+
+
+def test_abort_multipart_upload(s3):
+    # s3tests test_abort_multipart_upload + abort_multipart_upload_not_found
+    _mk_bucket(s3, "mpa-b")
+    uid = _initiate(s3, "mpa-b", "gone")
+    _upload_part(s3, "mpa-b", "gone", uid, 1, b"data")
+    code, _, _ = _req("DELETE", f"{s3}/mpa-b/gone?uploadId={uid}")
+    assert code == 204
+    # the aborted upload is no longer listable / completable
+    code, _, body = _req(
+        "POST", f"{s3}/mpa-b/gone?uploadId={uid}",
+        _complete_xml([(1, '"x"')]))
+    assert code == 404
+    assert b"NoSuchUpload" in body
+    code, _, body = _req(
+        "DELETE", f"{s3}/mpa-b/gone?uploadId=bogus-upload-id")
+    assert code == 404
+
+
+def test_multipart_upload_list_parts(s3):
+    _mk_bucket(s3, "mpl-b")
+    uid = _initiate(s3, "mpl-b", "lp")
+    for n in (1, 2):
+        _upload_part(s3, "mpl-b", "lp", uid, n, b"block-%d" % n)
+    code, _, body = _req("GET", f"{s3}/mpl-b/lp?uploadId={uid}")
+    assert code == 200
+    root = _xml(body)
+    nums = sorted(_text(p, "PartNumber") for p in _findall(root, "Part"))
+    assert nums == ["1", "2"]
+
+
+def test_multipart_invalid_part_order(s3):
+    # s3tests test_multipart_upload_contents wrong order -> InvalidPartOrder
+    _mk_bucket(s3, "mpo-b")
+    uid = _initiate(s3, "mpo-b", "ord")
+    e1 = _upload_part(s3, "mpo-b", "ord", uid, 1, b"one")
+    e2 = _upload_part(s3, "mpo-b", "ord", uid, 2, b"two")
+    code, _, body = _req(
+        "POST", f"{s3}/mpo-b/ord?uploadId={uid}",
+        _complete_xml([(2, e2), (1, e1)]))
+    assert code == 400
+    assert b"InvalidPartOrder" in body
+
+
+def test_list_multipart_uploads(s3):
+    _mk_bucket(s3, "mpu-b")
+    uid = _initiate(s3, "mpu-b", "u1")
+    code, _, body = _req("GET", f"{s3}/mpu-b?uploads")
+    assert code == 200
+    root = _xml(body)
+    uploads = _findall(root, "Upload")
+    assert any(_text(u, "UploadId") == uid for u in uploads)
+    _req("DELETE", f"{s3}/mpu-b/u1?uploadId={uid}")
+
+
+# ---------------------------------------------------------------------------
+# Multi-object delete (s3tests: test_multi_object_delete)
+# ---------------------------------------------------------------------------
+
+
+def test_multi_object_delete(s3):
+    _mk_bucket(s3, "mdel-b")
+    for k in ("key0", "key1", "key2"):
+        _put(s3, "mdel-b", k)
+    payload = (
+        b"<Delete>"
+        b"<Object><Key>key0</Key></Object>"
+        b"<Object><Key>key1</Key></Object>"
+        b"<Object><Key>ghost</Key></Object>"
+        b"</Delete>")
+    code, _, body = _req("POST", f"{s3}/mdel-b?delete", payload)
+    assert code == 200
+    root = _xml(body)
+    deleted = sorted(_text(d, "Key") for d in _findall(root, "Deleted"))
+    # AWS semantics: deleting a nonexistent key still reports Deleted
+    assert deleted == ["ghost", "key0", "key1"]
+    code, _, body = _req("GET", f"{s3}/mdel-b")
+    assert _keys(_xml(body)) == ["key2"]
+
+
+# ---------------------------------------------------------------------------
+# ACL surface (s3tests: test_bucket_acl_default)
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_acl_default(s3):
+    _mk_bucket(s3, "acl-b")
+    code, _, body = _req("GET", f"{s3}/acl-b?acl")
+    assert code == 200
+    root = _xml(body)
+    grants = _findall(_find(root, "AccessControlList"), "Grant")
+    assert len(grants) == 1
+    assert _text(grants[0], "Permission") == "FULL_CONTROL"
+
+
+def test_object_acl_default(s3):
+    _mk_bucket(s3, "acl2-b")
+    _put(s3, "acl2-b", "o")
+    code, _, body = _req("GET", f"{s3}/acl2-b/o?acl")
+    assert code == 200
+    assert b"FULL_CONTROL" in body

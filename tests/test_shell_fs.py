@@ -298,3 +298,30 @@ def test_fs_meta_notify(populated, tmp_path):
     keys = {k for k, _ in events}
     assert any(k.endswith("/a.txt") for k in keys)
     assert any(k.endswith("/c.bin") for k in keys)
+
+
+def test_default_maintenance_script_matches_scaffold():
+    """Pin the default [master.maintenance] suite to the reference scaffold
+    block (command/scaffold.go:503-518): same commands, same order, and
+    every line resolvable in the shell registry — so a command rename can't
+    silently hollow out the leader's elastic-recovery loop."""
+    import shlex
+
+    from seaweedfs_tpu.shell.commands import (
+        COMMANDS,
+        DEFAULT_MAINTENANCE_SCRIPT,
+    )
+    from seaweedfs_tpu.util.scaffold import MASTER_TOML
+
+    assert [shlex.split(line)[0] for line in DEFAULT_MAINTENANCE_SCRIPT] == [
+        "ec.encode",
+        "ec.rebuild",
+        "ec.balance",
+        "volume.balance",
+        "volume.fix.replication",
+    ]
+    for line in DEFAULT_MAINTENANCE_SCRIPT:
+        assert shlex.split(line)[0] in COMMANDS, line
+    # the scaffold master.toml must ship the same suite it documents
+    for line in DEFAULT_MAINTENANCE_SCRIPT:
+        assert f'"{line}"' in MASTER_TOML, line
