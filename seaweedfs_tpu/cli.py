@@ -38,6 +38,8 @@ def cmd_master(args) -> None:
         maintenance_script=script,
         sequencer=sequencer,
         sequencer_node_id=node_id,
+        sequencer_etcd_urls=mconf.get_string(
+            "master.sequencer.sequencer_etcd_urls", "127.0.0.1:2379"),
         metrics_port=args.metricsPort,
         jwt_signing_key=args.jwtKey or _security_jwt_key(),
         peers=args.peers.split(",") if args.peers else None,
@@ -147,7 +149,8 @@ def _filer_store_selection(flag_store: str) -> tuple[str, str, dict]:
     fconf = load_configuration("filer")
     if fconf.loaded and flag_store == "./filer.db":  # flag left at default
         for kind, path_key in (("sqlite", "dbFile"), ("leveldb", "dir"),
-                               ("leveldb2", "dir"), ("redis", ""),
+                               ("leveldb2", "dir"), ("leveldb3", "dir"),
+                               ("redis", ""), ("etcd", ""),
                                ("mysql", ""), ("postgres", ""),
                                ("memory", "")):
             if fconf.get_bool(f"{kind}.enabled"):
@@ -161,6 +164,11 @@ def _filer_store_selection(flag_store: str) -> tuple[str, str, dict]:
                 "host": fconf.get_string("redis.host", "127.0.0.1"),
                 "port": fconf.get_int("redis.port", 6379),
                 "db": fconf.get_int("redis.db", 0),
+            }
+        elif store == "etcd":
+            store_options = {
+                "servers": fconf.get_string("etcd.servers",
+                                            "127.0.0.1:2379"),
             }
         elif store in ("mysql", "postgres"):
             port_default = {"mysql": 3306, "postgres": 5432}[store]

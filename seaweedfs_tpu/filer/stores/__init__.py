@@ -12,6 +12,7 @@ lazily; absent drivers raise a loud ConfigurationError).
 """
 
 from . import (  # noqa: F401
+    etcd_store,
     leveldb2_store,
     leveldb3_store,
     leveldb_store,

@@ -85,10 +85,22 @@ class AbstractSqlStore(FilerStore):
         self._lock = threading.RLock()
         self._in_tx = False
         p = dialect.paramstyle
+        # plain INSERT + directory-scoped UPDATE fallback, NOT an upsert:
+        # the PK is (dirhash, name), so a blind upsert would let a 64-bit
+        # dirhash collision between two directories silently replace the
+        # other directory's row; the reference instead updates WHERE
+        # dirhash AND name AND directory and errors when that matches
+        # nothing (abstract_sql_store.go InsertEntry fallback)
         self._sql_insert = (
-            f"{dialect.insert_verb} INTO filemeta "
+            "INSERT INTO filemeta "
             f"(dirhash, name, directory, meta) VALUES ({p}, {p}, {p}, {p})"
-            f"{dialect.upsert_suffix}"
+        )
+        self._sql_update = (
+            f"UPDATE filemeta SET meta={p} WHERE dirhash={p} AND name={p}"
+            f" AND directory={p}"
+        )
+        self._sql_find_dir = (
+            f"SELECT directory FROM filemeta WHERE dirhash={p} AND name={p}"
         )
         # dirhash is a 64-bit hash — always scope by the directory column
         # too, so a hash collision between two directories cannot return or
@@ -141,12 +153,27 @@ class AbstractSqlStore(FilerStore):
     # -- entries -----------------------------------------------------------
 
     def insert_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
+        dirhash = hash_string_to_long(directory)
+        meta = entry.SerializeToString()
         with self._lock:
-            self._conn.cursor().execute(
-                self._sql_insert,
-                (hash_string_to_long(directory), entry.name, directory,
-                 entry.SerializeToString()),
-            )
+            cur = self._conn.cursor()
+            # existence check instead of insert-then-catch: a failed
+            # INSERT aborts the surrounding transaction on postgres, and
+            # the check also distinguishes a legitimate rewrite from a
+            # cross-directory dirhash collision (which must fail loudly,
+            # not replace the other directory's row)
+            cur.execute(self._sql_find_dir, (dirhash, entry.name))
+            row = cur.fetchone()
+            if row is None:
+                cur.execute(self._sql_insert,
+                            (dirhash, entry.name, directory, meta))
+            elif str(row[0]) == directory:
+                cur.execute(self._sql_update,
+                            (meta, dirhash, entry.name, directory))
+            else:
+                raise ValueError(
+                    f"dirhash collision: ({directory!r}, {entry.name!r}) "
+                    f"conflicts with {str(row[0])!r}")
             self._commit()
 
     update_entry = insert_entry

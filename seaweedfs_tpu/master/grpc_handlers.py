@@ -53,6 +53,7 @@ class MasterGrpcService:
                         data_center=hb.data_center or "DefaultDataCenter",
                         rack=hb.rack or "DefaultRack",
                         max_volumes=sum(hb.max_volume_counts.values()) or 7,
+                        max_volume_counts=dict(hb.max_volume_counts),
                     )
                 # EVERY beat re-registers (idempotent): if the liveness
                 # sweep unregistered a starved node while its stream stayed

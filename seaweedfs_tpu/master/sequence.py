@@ -73,13 +73,74 @@ class SnowflakeSequencer:
         pass  # timestamps make collisions impossible
 
 
-def make_sequencer(kind: str = "memory", node_id: int = 0):
+class EtcdSequencer:
+    """Chunked ids leased from etcd via CAS (etcd_sequencer.go:26-110).
+
+    Holds a local range [current, max); when exhausted, atomically bumps
+    the shared counter key by `steps` with a value-CAS transaction, so
+    multiple masters lease disjoint ranges from one etcd cluster.  Built
+    on the framework-native etcd v3 client (util.etcd.EtcdClient).
+    """
+
+    KEY = b"/seaweedfs/master/sequence"
+    DEFAULT_STEPS = 500  # reference DefaultEtcdSteps
+
+    def __init__(self, endpoint: str = "127.0.0.1:2379",
+                 steps: int = DEFAULT_STEPS):
+        from ..util.etcd import EtcdClient
+
+        self._client = EtcdClient(endpoint)
+        self._steps = max(1, steps)
+        self._lock = threading.Lock()
+        self._current = 0
+        self._max = 0  # exclusive
+
+    def _lease_range(self, need: int) -> None:
+        steps = self._steps + (need if need > self._steps else 0)
+        while True:
+            cur = self._client.get(self.KEY)
+            base = int(cur) if cur else 1
+            if self._client.cas(self.KEY, cur, str(base + steps).encode()):
+                self._current, self._max = base, base + steps
+                return
+
+    def next_file_id(self, count: int = 1) -> int:
+        with self._lock:
+            if self._current + count > self._max:
+                self._lease_range(count)
+            start = self._current
+            self._current += count
+            return start
+
+    def set_max(self, seen_value: int) -> None:
+        """A volume server reported ids >= the shared counter: push the
+        etcd counter past them AND drop the local lease — ids below
+        seen_value are live needle ids, so handing out the rest of the
+        current range would alias existing needles."""
+        with self._lock:
+            if seen_value < self._max:
+                return
+            self._current = self._max = 0  # force a fresh lease
+            while True:
+                cur = self._client.get(self.KEY)
+                base = int(cur) if cur else 1
+                if base > seen_value:
+                    return
+                if self._client.cas(self.KEY, cur,
+                                    str(seen_value + 1).encode()):
+                    return
+
+    def peek(self) -> int:
+        with self._lock:
+            return self._current
+
+
+def make_sequencer(kind: str = "memory", node_id: int = 0,
+                   etcd_endpoint: str = "127.0.0.1:2379"):
     if kind == "memory":
         return MemorySequencer()
     if kind == "snowflake":
         return SnowflakeSequencer(node_id)
     if kind == "etcd":
-        raise ValueError(
-            "the etcd sequencer needs an etcd endpoint + client, which "
-            "this deployment does not ship; use memory or snowflake")
+        return EtcdSequencer(etcd_endpoint)
     raise ValueError(f"unknown sequencer {kind!r}")

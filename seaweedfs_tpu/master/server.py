@@ -44,6 +44,7 @@ class MasterServer:
         pulse_seconds: float = 3.0,
         sequencer: str = "memory",
         sequencer_node_id: int = 0,  # snowflake worker id
+        sequencer_etcd_urls: str = "127.0.0.1:2379",
         garbage_threshold: float = 0.3,
         maintenance_interval: float = 0.0,  # seconds; 0 disables
         maintenance_script: list[str] | None = None,  # None = default suite
@@ -63,7 +64,9 @@ class MasterServer:
         self.garbage_threshold = garbage_threshold
         self.maintenance_interval = maintenance_interval
         self.maintenance_script = maintenance_script
-        self.sequencer = make_sequencer(sequencer, sequencer_node_id)
+        self.sequencer = make_sequencer(
+            sequencer, sequencer_node_id,
+            etcd_endpoint=sequencer_etcd_urls.split(",")[0])
         self.layouts: dict[tuple[str, str, str], VolumeLayout] = {}
         self._layout_lock = threading.RLock()
         self._subscribers: list = []
@@ -533,14 +536,18 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         return self._json(404, {"error": f"unknown path {u.path}"})
 
     def _col_delete(self, u) -> None:
-        # master_server_handlers_admin.go deleteFromMasterServerHandler
-        self._drain_body()  # keep-alive hygiene: params ride the query
+        # master_server_handlers_admin.go deleteFromMasterServerHandler.
+        # Exactly ONE drain per request: _redirect_to_leader drains for
+        # itself, so the leader/error paths drain here and the redirect
+        # path must not (draining twice blocks on already-consumed bytes)
         q = urllib.parse.parse_qs(u.query)
         name = q.get("collection", [""])[0]
         if not name:
+            self._drain_body()
             return self._json(400, {"error": "collection required"})
         if not self.master.is_leader():
             return self._redirect_to_leader()
+        self._drain_body()  # keep-alive hygiene: params ride the query
         self.master.delete_collection(name)
         return self._json(200, {"collection": name, "deleted": True})
 

@@ -38,6 +38,8 @@ def _host_simd_tier() -> int:
 
 def _load() -> ctypes.CDLL | None:
     global _lib, _tried
+    if _tried:  # lock-free fast path: GIL-atomic read of a settled state
+        return _lib
     with _lock:
         if _tried:
             return _lib
@@ -99,8 +101,10 @@ def _load() -> ctypes.CDLL | None:
             ctypes.c_char_p,  # matrix rows (R*S bytes)
             ctypes.c_int,  # R
             ctypes.c_int,  # S
-            ctypes.POINTER(ctypes.c_char_p),  # inputs
-            ctypes.POINTER(ctypes.c_char_p),  # outputs
+            # raw-address arrays (c_void_p): callers fill them from
+            # ndarray.ctypes.data without per-pointer c_char_p casts
+            ctypes.POINTER(ctypes.c_void_p),  # inputs
+            ctypes.POINTER(ctypes.c_void_p),  # outputs
             ctypes.c_size_t,  # block len
         ]
         _lib = lib
@@ -135,11 +139,13 @@ def gf_apply(matrix_rows, inputs: list[bytes], out_count: int) -> list[bytearray
         raise ValueError(f"matrix has {s} cols, got {len(inputs)} inputs")
     n = len(inputs[0])
     outs = [bytearray(n) for _ in range(r)]
-    in_ptrs = (ctypes.c_char_p * s)(*inputs)
+    # zero-copy in: the void* values point into the caller's bytes
+    # objects, which `inputs` keeps alive across the call
+    in_ptrs = (ctypes.c_void_p * s)(
+        *[ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p) for b in inputs])
     out_bufs = [(ctypes.c_char * n).from_buffer(o) for o in outs]
-    out_ptrs = (ctypes.c_char_p * r)(
-        *[ctypes.cast(ob, ctypes.c_char_p) for ob in out_bufs]
-    )
+    out_ptrs = (ctypes.c_void_p * r)(
+        *[ctypes.addressof(ob) for ob in out_bufs])
     lib.sw_gf_apply(m.tobytes(), r, s, in_ptrs, out_ptrs, n)
     return outs
 
@@ -168,9 +174,10 @@ def gf_apply_arrays(matrix_rows, inputs, out=None):
         arrs.append(a)
     if out is None:
         out = [np.empty(n, dtype=np.uint8) for _ in range(r)]
-    in_ptrs = (ctypes.c_char_p * s)(
-        *[ctypes.cast(a.ctypes.data, ctypes.c_char_p) for a in arrs])
-    out_ptrs = (ctypes.c_char_p * r)(
-        *[ctypes.cast(o.ctypes.data, ctypes.c_char_p) for o in out])
+    # void* arrays filled with raw addresses: building c_char_p casts per
+    # pointer costs ~100us/call, which dominates small degraded-read
+    # decodes (the per-needle latency path calls this per interval)
+    in_ptrs = (ctypes.c_void_p * s)(*[a.ctypes.data for a in arrs])
+    out_ptrs = (ctypes.c_void_p * r)(*[o.ctypes.data for o in out])
     lib.sw_gf_apply(m.tobytes(), r, s, in_ptrs, out_ptrs, n)
     return out

@@ -34,15 +34,22 @@ def _tables() -> np.ndarray:
     return t
 
 
+_native_update = None  # resolved once; False = no native lib
+
+
 def update(crc: int, data: bytes | np.ndarray) -> int:
     """crc32c update (unmasked), matching crc32.Update over the Castagnoli table."""
-    try:
-        from ..native import lib as _native
+    global _native_update
+    if _native_update is None:
+        try:
+            from ..native import lib as _native
 
-        if _native.available():
-            return _native.crc32c_update(crc, bytes(data))
-    except Exception:
-        pass
+            _native_update = (_native.crc32c_update
+                              if _native.available() else False)
+        except Exception:
+            _native_update = False
+    if _native_update:
+        return _native_update(crc, bytes(data))
     t = _tables()
     buf = np.frombuffer(bytes(data), dtype=np.uint8)
     crc = crc ^ 0xFFFFFFFF

@@ -127,6 +127,31 @@ class ReedSolomon:
     def reconstruct(self, shards: list[np.ndarray | None]) -> list[np.ndarray]:
         return self._reconstruct(shards, data_only=False)
 
+    def reconstruct_one(
+        self, shards: list[np.ndarray | None], shard_id: int
+    ) -> np.ndarray:
+        """Decode ONLY shard_id from >= data_shards present shards.
+
+        The per-needle degraded read needs exactly one missing interval;
+        computing all 4 lost rows (reconstruct) would quadruple the GF
+        work on the latency path (store_ec.go's ReconstructData analogue,
+        narrowed to the single wanted row)."""
+        if shards[shard_id] is not None:
+            return np.asarray(shards[shard_id], dtype=np.uint8)
+        present = [i for i, s in enumerate(shards) if s is not None]
+        if len(present) < self.data_shards:
+            raise ValueError("too few shards to reconstruct")
+        sub = present[: self.data_shards]
+        sub_shards = [np.asarray(shards[i], dtype=np.uint8) for i in sub]
+        dec = gf256.decode_matrix_for(self.matrix, self.data_shards, present)
+        if shard_id < self.data_shards:
+            row = dec[shard_id:shard_id + 1]
+        else:
+            # parity row composed through the decode matrix (GF product)
+            row = gf256.mat_mul(
+                self.matrix[shard_id:shard_id + 1, : self.data_shards], dec)
+        return self._apply(row, sub_shards)[0]
+
     def reconstruct_data(self, shards: list[np.ndarray | None]) -> list[np.ndarray]:
         return self._reconstruct(shards, data_only=True)
 

@@ -17,6 +17,7 @@ PROTOS = [
     "filer.proto",
     "messaging.proto",
     "volume_info.proto",
+    "etcd.proto",
 ]
 
 

@@ -129,6 +129,17 @@ MESSAGING = Service("messaging_pb.SeaweedMessaging", {
     "FindBroker": _m(UU, _MSG.FindBrokerRequest, _MSG.FindBrokerResponse),
 })
 
+# etcd v3 KV plane (the real service name, so the same stub talks to a
+# stock etcd server or the framework's in-process fake)
+from . import etcd_pb2  # noqa: E402
+
+ETCD_KV = Service("etcdserverpb.KV", {
+    "Range": _m(UU, etcd_pb2.RangeRequest, etcd_pb2.RangeResponse),
+    "Put": _m(UU, etcd_pb2.PutRequest, etcd_pb2.PutResponse),
+    "DeleteRange": _m(UU, etcd_pb2.DeleteRangeRequest, etcd_pb2.DeleteRangeResponse),
+    "Txn": _m(UU, etcd_pb2.TxnRequest, etcd_pb2.TxnResponse),
+})
+
 
 # ---------------------------------------------------------------------------
 # mTLS (security/tls.py loads these from security.toml; set once at startup
@@ -275,3 +286,7 @@ def volume_server_stub(address: str, timeout: float | None = None) -> Stub:
 
 def filer_stub(address: str, timeout: float | None = None) -> Stub:
     return Stub(FILER, address, timeout)
+
+
+def etcd_kv_stub(address: str, timeout: float | None = None) -> Stub:
+    return Stub(ETCD_KV, address, timeout)

@@ -160,6 +160,31 @@ class S3ApiServer:
 # -- XML helpers --------------------------------------------------------------
 
 
+_CT_PREFIX = "ct-"  # marks this gateway's base64 continuation tokens
+
+
+def _encode_ct(key: str) -> str:
+    import base64
+
+    return _CT_PREFIX + base64.urlsafe_b64encode(
+        key.encode()).decode().rstrip("=")
+
+
+def _decode_ct(token: str) -> str:
+    """Inverse of _encode_ct; a foreign/legacy token passes through as a
+    raw start key."""
+    if not token.startswith(_CT_PREFIX):
+        return token
+    import base64
+
+    raw = token[len(_CT_PREFIX):]
+    try:
+        return base64.urlsafe_b64decode(
+            raw + "=" * (-len(raw) % 4)).decode()
+    except Exception:
+        return token
+
+
 _BUCKET_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9.-]{1,61}[a-z0-9]$")
 _IPV4_RE = re.compile(r"^\d+\.\d+\.\d+\.\d+$")
 
@@ -494,7 +519,8 @@ class S3Handler(BaseHTTPRequestHandler):
             return urllib.parse.quote(s, safe="/") if encoding else s
 
         if v2:
-            marker = q.get("continuation-token") or q.get("start-after", "")
+            marker = (_decode_ct(q.get("continuation-token", ""))
+                      or q.get("start-after", ""))
         else:
             marker = q.get("marker", "")
         contents, prefixes, truncated, next_marker = self._list(
@@ -513,11 +539,14 @@ class S3Handler(BaseHTTPRequestHandler):
         # encoding-type exists for (bytes illegal in XML 1.0)
         if v2:
             _el(root, "KeyCount", str(len(contents)))
+            # v2 continuation tokens are OPAQUE: clients echo them back
+            # verbatim without decoding (AWS never applies EncodingType to
+            # them), so they are base64-wrapped — XML-safe for any key
+            # bytes AND immune to double-encoding on the resume path
             if truncated:
-                _el(root, "NextContinuationToken", enc(next_marker))
+                _el(root, "NextContinuationToken", _encode_ct(next_marker))
             if q.get("continuation-token"):
-                _el(root, "ContinuationToken",
-                    enc(q["continuation-token"]))
+                _el(root, "ContinuationToken", q["continuation-token"])
         else:
             _el(root, "Marker", enc(marker))
             if truncated and delimiter:

@@ -521,6 +521,139 @@ def volume_check_disk(env: CommandEnv, args: list[str]) -> str:
     return "\n".join(lines)
 
 
+def collect_volume_ids_for_tier_change(
+    topo, volume_size_limit: int, from_disk_type: str,
+    collection: str = "", full_percent: float = 95.0,
+    quiet_for_seconds: float = 0, now: float | None = None,
+) -> list[int]:
+    """Pure selection (tier-3 testable): quiet, full volumes currently on
+    the source tier (collectVolumeIdsForTierChange,
+    command_volume_tier_move.go:153-180)."""
+    import time as _time
+
+    from ..storage.disk_location import normalize_disk_type
+
+    if now is None:
+        now = _time.time()
+    want = normalize_disk_type(from_disk_type)
+    vids = set()
+    for _dc, _rack, dn in _iter_nodes(topo):
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                if normalize_disk_type(v.disk_type) != want:
+                    continue
+                if collection and v.collection != collection:
+                    continue
+                if v.size < volume_size_limit * full_percent / 100.0:
+                    continue
+                if (quiet_for_seconds > 0 and v.modified_at_second
+                        and now - v.modified_at_second < quiet_for_seconds):
+                    continue
+                vids.add(v.id)
+    return sorted(vids)
+
+
+def pick_tier_move_target(
+    topo, vid: int, to_disk_type: str,
+) -> tuple[str, str] | None:
+    """Pure placement (tier-3 testable): -> (source_node, target_node) or
+    None.  Target = node with the most free slots on the target tier that
+    does not already hold the volume (doVolumeTierMove,
+    command_volume_tier_move.go:93-150)."""
+    from ..storage.disk_location import normalize_disk_type
+
+    want = normalize_disk_type(to_disk_type)
+    holders = []
+    candidates = []
+    for _dc, _rack, dn in _iter_nodes(topo):
+        holds = False
+        free = 0
+        for dt, disk in dn.disk_infos.items():
+            for v in disk.volume_infos:
+                if v.id == vid:
+                    holds = True
+            if normalize_disk_type(dt) == want:
+                free = max(free, disk.max_volume_count - disk.volume_count)
+        if holds:
+            holders.append(dn.id)
+        elif free > 0:
+            candidates.append((free, dn.id))
+    if not holders or not candidates:
+        return None
+    candidates.sort(reverse=True)
+    return holders[0], candidates[0][1]
+
+
+@register("volume.tier.move")
+def volume_tier_move(env: CommandEnv, args: list[str]) -> str:
+    """Move quiet, full volumes from one disk tier to another
+    (command_volume_tier_move.go).  Only one replica moves; the rest are
+    dropped — follow with volume.fix.replication / volume.balance, as the
+    reference documents."""
+    from .fs_commands import _parse_duration
+    from ..storage.disk_location import readable_disk_type
+
+    flags = _parse_flags(args)
+    from_dt = flags.get("fromDiskType", "")
+    to_dt = flags.get("toDiskType", "")
+    if readable_disk_type(from_dt) == readable_disk_type(to_dt):
+        raise RuntimeError(
+            f"source tier {readable_disk_type(from_dt)} is the same as "
+            f"target tier {readable_disk_type(to_dt)}")
+    collection = flags.get("collection", "")
+    full_percent = float(flags.get("fullPercent", "95"))
+    quiet_for = _parse_duration(flags.get("quietFor", "0"))
+    apply_changes = "force" in flags
+    if "volumeId" in flags:
+        vids = [int(flags["volumeId"])]
+    else:
+        topo = env.topology()
+        vids = collect_volume_ids_for_tier_change(
+            topo, env.volume_size_limit(), from_dt, collection,
+            full_percent, quiet_for)
+    lines = [f"tier move volumes: {vids}"]
+    for vid in vids:
+        topo = env.topology()
+        picked = pick_tier_move_target(topo, vid, to_dt)
+        if picked is None:
+            lines.append(
+                f"volume {vid}: no node with free "
+                f"{readable_disk_type(to_dt)} capacity")
+            continue
+        source, target = picked
+        lines.append(
+            f"moving volume {vid} from {source} to {target} with disk "
+            f"type {readable_disk_type(to_dt)}"
+            + ("" if apply_changes else " (dry run, -force to apply)"))
+        if not apply_changes:
+            continue
+        _node, collection_of = _locate_volume(env, vid)
+        # mark every replica readonly, then live-move one replica to the
+        # target tier and drop the others (reference semantics)
+        replicas = [dn.id for _dc, _rack, dn in _iter_nodes(topo)
+                    if any(v.id == vid for d in dn.disk_infos.values()
+                           for v in d.volume_infos)]
+        for node in replicas:
+            env.volume_server(_node_grpc(node)).VolumeMarkReadonly(
+                vs.VolumeMarkReadonlyRequest(volume_id=vid))
+        from ..storage.disk_location import normalize_disk_type
+
+        env.volume_server(_node_grpc(target)).VolumeCopy(
+            vs.VolumeCopyRequest(
+                volume_id=vid, collection=collection_of,
+                source_data_node=_node_grpc(source),
+                disk_type=normalize_disk_type(to_dt) or "hdd",
+            )
+        )
+        for node in replicas:
+            env.volume_server(_node_grpc(node)).VolumeDelete(
+                vs.VolumeDeleteRequest(volume_id=vid))
+        env.volume_server(_node_grpc(target)).VolumeMarkWritable(
+            vs.VolumeMarkWritableRequest(volume_id=vid))
+        lines.append(f"moved volume {vid} -> {target}")
+    return "\n".join(lines)
+
+
 @register("lock")
 def lock_cmd(env: CommandEnv, args: list[str]) -> str:
     return "locked" if env.acquire_lock() else "lock busy"

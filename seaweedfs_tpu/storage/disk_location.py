@@ -26,13 +26,25 @@ def parse_volume_file_name(name: str) -> tuple[str, int]:
     return "", int(name)
 
 
+def normalize_disk_type(s: str) -> str:
+    """'' and 'hdd' are the same (default) type, as in the reference's
+    types.ToDiskType (weed/storage/types/volume_disk_type.go)."""
+    s = (s or "").strip().lower()
+    return "" if s == "hdd" else s
+
+
+def readable_disk_type(s: str) -> str:
+    return normalize_disk_type(s) or "hdd"
+
+
 class DiskLocation:
     def __init__(self, directory: str, max_volume_count: int = 7,
-                 codec_name: str = "cpu"):
+                 codec_name: str = "cpu", disk_type: str = ""):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_volume_count = max_volume_count
         self.codec_name = codec_name
+        self.disk_type = normalize_disk_type(disk_type)
         self.volumes: dict[int, Volume] = {}
         self.ec_volumes: dict[int, EcVolume] = {}
         self._lock = threading.RLock()
@@ -51,9 +63,9 @@ class DiskLocation:
                         continue
                     if vid not in self.volumes:
                         try:
-                            self.volumes[vid] = Volume(
-                                self.directory, collection, vid
-                            )
+                            v = Volume(self.directory, collection, vid)
+                            v.disk_type = self.disk_type
+                            self.volumes[vid] = v
                         except Exception:
                             continue
             self.load_all_ec_shards()
@@ -87,6 +99,7 @@ class DiskLocation:
             if vid in self.volumes:
                 return self.volumes[vid]
             v = Volume(self.directory, collection, vid, super_block=super_block)
+            v.disk_type = self.disk_type
             self.volumes[vid] = v
             return v
 

@@ -44,14 +44,36 @@ class EcVolumeShard:
     def __post_init__(self):
         self._f = open(self.path, "rb")
         self.size = os.path.getsize(self.path)
+        # read-only mmap: shard files never change size while mounted,
+        # and a degraded read fans in 10 sibling interval reads — slicing
+        # the map costs ~1us vs ~6us per pread syscall on this host.
+        # Falls back to pread when the map can't be made (empty file).
+        self._mm = None
+        if self.size > 0:
+            import mmap
+
+            try:
+                self._mm = mmap.mmap(self._f.fileno(), 0,
+                                     prot=mmap.PROT_READ)
+            except (OSError, ValueError):
+                self._mm = None
 
     def read_at(self, offset: int, length: int) -> bytes:
-        # positioned read: concurrent degraded reads share this handle, so
-        # a seek+read pair would interleave (reference: ReadAt pread
-        # discipline, ec_shard.go:93)
+        # positioned read discipline: concurrent degraded reads share this
+        # handle (reference: ReadAt pread, ec_shard.go:93); the mmap slice
+        # is the syscall-free equivalent
+        mm = self._mm
+        if mm is not None:
+            return mm[offset:offset + length]
         return os.pread(self._f.fileno(), length, offset)
 
     def close(self) -> None:
+        if self._mm is not None:
+            try:
+                self._mm.close()
+            except BufferError:
+                pass  # a frombuffer view is still alive; freed at GC
+            self._mm = None
         self._f.close()
 
 
@@ -82,6 +104,7 @@ class EcVolume:
         self.shards: dict[int, EcVolumeShard] = {}
         self._ecx = open(base_name + ".ecx", "r+b")
         self.ecx_size = os.path.getsize(base_name + ".ecx")
+        self._ecx_keys_arr = None  # lazy key cache; False = don't cache
         self._ecj_lock = threading.Lock()
         self._ecx_derived_shard_size: int | None = None
         self.remote_fetch: FetchFn | None = None
@@ -170,10 +193,51 @@ class EcVolume:
         _pos, offset, size = entry
         return offset, size
 
+    # entries above this stay on the pread path (keys cache = 8B/needle;
+    # 4M entries = 32MB — the low-memory property EC volumes exist for)
+    _ECX_KEY_CACHE_MAX = 4 << 20
+
+    def _ecx_keys(self):
+        """Contiguous big-endian u64 key column of the .ecx, cached.
+
+        Turns the ~log2(n) pread+unpack binary search into one numpy
+        searchsorted + one pread — the .ecx search was ~16% of degraded
+        read wall time.  Safe to cache: tombstoning rewrites the SIZE
+        field in place, never the keys, and the .ecx never grows."""
+        arr = self._ecx_keys_arr
+        if arr is not None:
+            return arr if arr is not False else None
+        n = self.ecx_size // t.NEEDLE_MAP_ENTRY_SIZE
+        if n == 0 or n > self._ECX_KEY_CACHE_MAX:
+            self._ecx_keys_arr = False
+            return None
+        try:
+            mm = np.memmap(self.base_name + ".ecx", dtype=np.uint8,
+                           mode="r")
+            esz = t.NEEDLE_MAP_ENTRY_SIZE
+            mat = mm[: n * esz].reshape(n, esz)
+            keys = np.ascontiguousarray(mat[:, :8]).view(">u8").reshape(-1)
+            self._ecx_keys_arr = keys
+            del mm
+        except (OSError, ValueError):
+            self._ecx_keys_arr = False
+            return None
+        return self._ecx_keys_arr
+
     def _search_ecx(self, needle_id: int) -> tuple[int, int, int] | None:
         """-> (entry_file_pos, actual_offset, size) | None."""
-        lo, hi = 0, self.ecx_size // t.NEEDLE_MAP_ENTRY_SIZE
         fd = self._ecx.fileno()
+        keys = self._ecx_keys()
+        if keys is not None:
+            i = int(np.searchsorted(keys, needle_id))
+            if i >= len(keys) or int(keys[i]) != needle_id:
+                return None
+            pos = i * t.NEEDLE_MAP_ENTRY_SIZE
+            # one fresh pread for offset/size: tombstones mutate in place
+            _key, offset, size = t.unpack_index_entry(
+                os.pread(fd, t.NEEDLE_MAP_ENTRY_SIZE, pos))
+            return pos, offset, size
+        lo, hi = 0, self.ecx_size // t.NEEDLE_MAP_ENTRY_SIZE
         while lo < hi:
             mid = (lo + hi) // 2
             buf = os.pread(fd, t.NEEDLE_MAP_ENTRY_SIZE,
@@ -301,5 +365,10 @@ class EcVolume:
             raise IOError(
                 f"shard {shard_id} interval unreadable: only {have} shards available"
             )
+        if hasattr(self.codec, "reconstruct_one"):
+            # latency path: decode only the wanted row, not all lost shards
+            return np.asarray(
+                self.codec.reconstruct_one(shards, shard_id),
+                dtype=np.uint8).tobytes()
         rebuilt = self.codec.reconstruct(shards)
         return np.asarray(rebuilt[shard_id], dtype=np.uint8).tobytes()

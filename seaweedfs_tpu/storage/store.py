@@ -44,6 +44,7 @@ class Store:
         rack: str = "",
         codec_name: str = "cpu",
         max_volume_counts: dict[str, int] | None = None,
+        disk_types: list[str] | None = None,
     ):
         self.ip = ip
         self.port = port
@@ -51,12 +52,21 @@ class Store:
         self.data_center = data_center
         self.rack = rack
         self.codec_name = codec_name
+        disk_types = disk_types or []
         self.locations = [
-            DiskLocation(d, codec_name=codec_name) for d in directories
+            DiskLocation(
+                d, codec_name=codec_name,
+                disk_type=disk_types[i] if i < len(disk_types) else "",
+            )
+            for i, d in enumerate(directories)
         ]
-        self.max_volume_counts = max_volume_counts or {
-            "": sum(loc.max_volume_count for loc in self.locations)
-        }
+        if max_volume_counts is None:
+            max_volume_counts = {}
+            for loc in self.locations:
+                max_volume_counts[loc.disk_type] = (
+                    max_volume_counts.get(loc.disk_type, 0)
+                    + loc.max_volume_count)
+        self.max_volume_counts = max_volume_counts
         self._lock = threading.RLock()
         # delta channels to the master (drained into heartbeats)
         self.new_volumes: list[master_pb2.VolumeShortInformationMessage] = []
@@ -90,9 +100,19 @@ class Store:
                 return loc
         return None
 
-    def has_free_location(self) -> DiskLocation | None:
+    def has_free_location(self, disk_type: str = "") -> DiskLocation | None:
+        """Freest location, optionally restricted to a disk type
+        ('' accepts the default/hdd tier only when requested as such by
+        an explicit allocation; None semantics: any type when no volume
+        of the requested type exists is NOT applied — the reference
+        refuses allocation on a missing tier)."""
+        from .disk_location import normalize_disk_type
+
+        want = normalize_disk_type(disk_type)
         best, free = None, 0
         for loc in self.locations:
+            if loc.disk_type != want:
+                continue
             f = loc.max_volume_count - loc.volume_count()
             if f > free:
                 best, free = loc, f
@@ -101,11 +121,12 @@ class Store:
     # -- volume lifecycle -------------------------------------------------
 
     def add_volume(self, vid: int, collection: str, replication: str = "000",
-                   ttl: str = "", preallocate: int = 0) -> None:
+                   ttl: str = "", preallocate: int = 0,
+                   disk_type: str = "") -> None:
         with self._lock:
             if self.find_volume(vid) is not None:
                 raise ValueError(f"volume {vid} already exists")
-            loc = self.has_free_location()
+            loc = self.has_free_location(disk_type)
             if loc is None:
                 raise IOError("no free disk location")
             sb = SuperBlock(
@@ -377,7 +398,7 @@ class Store:
             replica_placement=v.super_block.replica_placement.to_byte(),
             version=v.version,
             ttl=v.super_block.ttl.to_uint32(),
-            disk_type="",
+            disk_type=getattr(v, "disk_type", ""),
         )
 
     def collect_heartbeat(self) -> master_pb2.Heartbeat:
@@ -405,6 +426,7 @@ class Store:
                     ttl=v.super_block.ttl.to_uint32(),
                     compact_revision=v.super_block.compaction_revision,
                     modified_at_second=v.last_modified_second,
+                    disk_type=loc.disk_type,
                 )
             for vid, ev in loc.ec_volumes.items():
                 hb.ec_shards.add(

@@ -46,6 +46,7 @@ class Volume:
         self.directory = directory
         self.collection = collection
         self.volume_id = volume_id
+        self.disk_type = ""  # normalized; "" == hdd (set by DiskLocation)
         self.read_only = False
         self._lock = threading.RLock()
         base = self.file_name()

@@ -30,6 +30,7 @@ class VolumeInfo:
     ttl: int = 0
     compact_revision: int = 0
     modified_at_second: int = 0
+    disk_type: str = ""  # normalized: "" == hdd
 
     @classmethod
     def from_pb(cls, m: master_pb2.VolumeInformationMessage) -> "VolumeInfo":
@@ -46,6 +47,7 @@ class VolumeInfo:
             version=m.version,
             ttl=m.ttl,
             compact_revision=m.compact_revision,
+            disk_type=m.disk_type,
         )
 
 
@@ -61,9 +63,29 @@ class DataNode:
     ec_shards: dict = field(default_factory=dict)  # vid -> ShardBits
     ec_collections: dict = field(default_factory=dict)  # vid -> collection
     last_seen: float = field(default_factory=time.monotonic)
+    # per-disk-type capacity from the heartbeat's max_volume_counts map
+    # (reference: Disk nodes under DataNode); empty -> one default tier
+    max_volume_counts: dict = field(default_factory=dict)
 
     def free_slots(self) -> int:
         return self.max_volumes - len(self.volumes) - (len(self.ec_shards) + 9) // 10
+
+    def disk_types(self) -> list[str]:
+        return sorted(self.max_volume_counts) if self.max_volume_counts \
+            else [""]
+
+    def free_slots_for(self, disk_type: str) -> int:
+        """Free volume slots on one disk tier (capacityByFreeVolumeCount,
+        command_ec_common.go / command_volume_tier_move.go)."""
+        cap = self.max_volume_counts.get(disk_type)
+        if cap is None:
+            if disk_type == "" and not self.max_volume_counts:
+                cap = self.max_volumes  # legacy node: one default tier
+            else:
+                return 0
+        used = sum(1 for v in self.volumes.values()
+                   if v.disk_type == disk_type)
+        return cap - used
 
     def free_ec_slots(self) -> int:
         used = sum(ShardBits(b).count() for b in self.ec_shards.values())
@@ -96,6 +118,8 @@ class Topology:
                 existing.rack = node.rack
             if node.max_volumes:
                 existing.max_volumes = node.max_volumes
+            if node.max_volume_counts:
+                existing.max_volume_counts = dict(node.max_volume_counts)
             return existing
 
     def unregister_node(self, node_id: str) -> list[int]:
@@ -203,25 +227,38 @@ class Topology:
                     rack = dc.rack_infos.add(id=n.rack)
                     racks[rack_key] = rack
                 dn = rack.data_node_infos.add(id=n.id)
-                disk = dn.disk_infos[""]
-                disk.volume_count = len(n.volumes)
-                disk.max_volume_count = n.max_volumes
-                disk.free_volume_count = n.free_slots()
-                disk.active_volume_count = len(n.volumes)
-                for v in n.volumes.values():
-                    disk.volume_infos.add(
-                        id=v.volume_id,
-                        size=v.size,
-                        collection=v.collection,
-                        file_count=v.file_count,
-                        delete_count=v.delete_count,
-                        deleted_byte_count=v.deleted_byte_count,
-                        read_only=v.read_only,
-                        replica_placement=v.replica_placement,
-                        version=v.version,
-                        ttl=v.ttl,
-                        modified_at_second=v.modified_at_second,
-                    )
+                # one DiskInfo per disk type (reference DataNodeInfo
+                # diskInfos map; "" == hdd default tier); the union with
+                # volume-reported types keeps a volume visible even if the
+                # node's capacity map doesn't advertise its tier
+                types = sorted(set(n.disk_types())
+                               | {v.disk_type for v in n.volumes.values()})
+                for dt in types:
+                    disk = dn.disk_infos[dt]
+                    vols = [v for v in n.volumes.values()
+                            if v.disk_type == dt]
+                    disk.volume_count = len(vols)
+                    disk.max_volume_count = (
+                        n.max_volume_counts.get(dt, n.max_volumes))
+                    disk.free_volume_count = n.free_slots_for(dt)
+                    disk.active_volume_count = len(vols)
+                    for v in vols:
+                        disk.volume_infos.add(
+                            id=v.volume_id,
+                            size=v.size,
+                            collection=v.collection,
+                            file_count=v.file_count,
+                            delete_count=v.delete_count,
+                            deleted_byte_count=v.deleted_byte_count,
+                            read_only=v.read_only,
+                            replica_placement=v.replica_placement,
+                            version=v.version,
+                            ttl=v.ttl,
+                            modified_at_second=v.modified_at_second,
+                            disk_type=v.disk_type,
+                        )
+                # EC shards stay on the default tier's DiskInfo
+                disk = dn.disk_infos[n.disk_types()[0]]
                 for vid, bits in n.ec_shards.items():
                     disk.ec_shard_infos.add(
                         id=vid,

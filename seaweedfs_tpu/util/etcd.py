@@ -1,0 +1,201 @@
+"""Framework-native etcd v3 client (gRPC KV plane) + in-process fake.
+
+The reference gates two components on etcd: the sequencer
+(weed/sequence/etcd_sequencer.go:26) and a filer store
+(weed/filer/etcd/etcd_store.go:23).  This image ships no etcd server or
+client library, so — like the RESP client written for the redis store —
+the framework speaks the wire protocol itself: `EtcdClient` drives the
+real etcdserverpb.KV service (names + field numbers match stock etcd;
+see pb/etcd.proto), and `FakeEtcdServer` implements the same four rpcs
+in-process for tests and offline development.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..pb import etcd_pb2
+from ..pb import rpc as rpclib
+
+
+def prefix_range_end(prefix: bytes) -> bytes:
+    """clientv3.WithPrefix's range_end: prefix with its last byte +1
+    (etcd-io/etcd clientv3/op.go getPrefix)."""
+    end = bytearray(prefix)
+    for i in reversed(range(len(end))):
+        if end[i] < 0xFF:
+            end[i] += 1
+            return bytes(end[: i + 1])
+    return b"\0"  # all 0xff: from-key range
+
+
+class EtcdClient:
+    """Minimal KV surface: get/put/delete/prefix ops + one CAS txn."""
+
+    def __init__(self, address: str = "127.0.0.1:2379",
+                 timeout: float = 10.0):
+        self.address = address
+        self.timeout = timeout
+
+    def _kv(self):
+        return rpclib.etcd_kv_stub(self.address, timeout=self.timeout)
+
+    def get(self, key: bytes) -> bytes | None:
+        resp = self._kv().Range(etcd_pb2.RangeRequest(key=key))
+        return resp.kvs[0].value if resp.kvs else None
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._kv().Put(etcd_pb2.PutRequest(key=key, value=value))
+
+    def delete(self, key: bytes) -> int:
+        return self._kv().DeleteRange(
+            etcd_pb2.DeleteRangeRequest(key=key)).deleted
+
+    def delete_prefix(self, prefix: bytes) -> int:
+        return self._kv().DeleteRange(etcd_pb2.DeleteRangeRequest(
+            key=prefix, range_end=prefix_range_end(prefix))).deleted
+
+    def range_prefix(self, prefix: bytes, start: bytes = b"",
+                     limit: int = 0) -> list[tuple[bytes, bytes]]:
+        """Ascending (key, value) pairs under prefix, optionally starting
+        at `start` (>= start, still bounded by the prefix's range end)."""
+        resp = self._kv().Range(etcd_pb2.RangeRequest(
+            key=start or prefix,
+            range_end=prefix_range_end(prefix),
+            limit=limit,
+            sort_order=1,  # ASCEND
+        ))
+        return [(kv.key, kv.value) for kv in resp.kvs]
+
+    def cas(self, key: bytes, expect: bytes | None,
+            new_value: bytes) -> bool:
+        """Compare-and-swap on VALUE; expect=None means 'key absent'
+        (compared via create_revision == 0, the etcd idiom)."""
+        if expect is None:
+            cmp = etcd_pb2.Compare(
+                result=0, target=1, key=key, create_revision=0)
+        else:
+            cmp = etcd_pb2.Compare(
+                result=0, target=3, key=key, value=expect)
+        resp = self._kv().Txn(etcd_pb2.TxnRequest(
+            compare=[cmp],
+            success=[etcd_pb2.RequestOp(
+                request_put=etcd_pb2.PutRequest(key=key, value=new_value))],
+        ))
+        return resp.succeeded
+
+
+class FakeEtcdServer:
+    """In-process etcdserverpb.KV over a dict — the test double proving
+    the client's wire behavior (same role as util.resp.FakeRedisServer)."""
+
+    def __init__(self, port: int = 0):
+        self._lock = threading.Lock()
+        self._kv: dict[bytes, tuple[bytes, int, int]] = {}  # v, create, mod
+        self._rev = 0
+        self._server = None
+        self.port = port
+
+    # -- rpc impls ---------------------------------------------------------
+
+    def _select(self, key: bytes, range_end: bytes) -> list[bytes]:
+        if not range_end:
+            return [key] if key in self._kv else []
+        if range_end == b"\0":
+            return sorted(k for k in self._kv if k >= key)
+        return sorted(k for k in self._kv if key <= k < range_end)
+
+    def _header(self):
+        return etcd_pb2.ResponseHeader(revision=self._rev)
+
+    def Range(self, request, context=None):
+        with self._lock:
+            keys = self._select(request.key, request.range_end)
+            if request.sort_order == 2:
+                keys.reverse()
+            more = bool(request.limit) and len(keys) > request.limit
+            if request.limit:
+                keys = keys[: request.limit]
+            resp = etcd_pb2.RangeResponse(
+                header=self._header(), more=more, count=len(keys))
+            if not request.count_only:
+                for k in keys:
+                    v, cr, mr = self._kv[k]
+                    resp.kvs.add(key=k, value=b"" if request.keys_only
+                                 else v, create_revision=cr,
+                                 mod_revision=mr, version=1)
+            return resp
+
+    def Put(self, request, context=None):
+        with self._lock:
+            return self._put_locked(request)
+
+    def _put_locked(self, request):
+        self._rev += 1
+        old = self._kv.get(request.key)
+        create = old[1] if old else self._rev
+        self._kv[request.key] = (bytes(request.value), create, self._rev)
+        return etcd_pb2.PutResponse(header=self._header())
+
+    def DeleteRange(self, request, context=None):
+        with self._lock:
+            keys = self._select(request.key, request.range_end)
+            if keys:
+                self._rev += 1
+            for k in keys:
+                del self._kv[k]
+            return etcd_pb2.DeleteRangeResponse(
+                header=self._header(), deleted=len(keys))
+
+    def Txn(self, request, context=None):
+        with self._lock:
+            ok = all(self._compare(c) for c in request.compare)
+            ops = request.success if ok else request.failure
+            resp = etcd_pb2.TxnResponse(header=self._header(), succeeded=ok)
+            for op in ops:
+                kind = op.WhichOneof("request")
+                if kind == "request_put":
+                    r = self._put_locked(op.request_put)
+                    resp.responses.add(response_put=r)
+                elif kind == "request_range":
+                    pass  # not needed by the framework's callers
+            return resp
+
+    def _compare(self, c) -> bool:
+        entry = self._kv.get(c.key)
+        if c.target == 1:  # CREATE revision
+            actual = entry[1] if entry else 0
+            want = c.create_revision
+        elif c.target == 2:  # MOD revision
+            actual = entry[2] if entry else 0
+            want = c.mod_revision
+        elif c.target == 3:  # VALUE (absent compares unequal to any value)
+            actual = entry[0] if entry else None
+            want = bytes(c.value)
+        else:  # VERSION
+            actual = 1 if entry else 0
+            want = c.version
+        if c.result == 0:
+            return actual == want
+        if c.result == 3:
+            return actual != want
+        if c.result == 1:
+            return actual is not None and actual > want
+        return actual is not None and actual < want
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self.port == 0:
+            import socket
+
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                self.port = s.getsockname()[1]
+        self._server = rpclib.serve(
+            [(rpclib.ETCD_KV, self)], self.port, host="127.0.0.1")
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=0.2)
+            self._server = None

@@ -66,8 +66,10 @@ scripts = [
 periodic_seconds = 1020
 
 [master.sequencer]
-# memory | snowflake
+# memory | snowflake | etcd
 type = "memory"
+# etcd kind: comma-separated etcd v3 endpoints (framework-native client)
+sequencer_etcd_urls = "127.0.0.1:2379"
 # Unique per-master worker id stamped into snowflake file ids.
 sequencer_snowflake_id = 0
 
@@ -102,12 +104,23 @@ dir = "./filerldb"
 enabled = false
 dir = "./filerldb2"
 
+[leveldb3]
+# Adaptive per-bucket partitioning: /buckets/<b> objects get their own
+# DB; dropping a bucket is O(1).
+enabled = false
+dir = "./filerldb3"
+
 [redis]
 # Any RESP2 endpoint (framework-native client, no redis library).
 enabled = false
 host = "127.0.0.1"
 port = 6379
 db = 0
+
+[etcd]
+# etcd v3 cluster (framework-native gRPC KV client, no etcd library).
+enabled = false
+servers = "127.0.0.1:2379"
 
 [mysql]
 # Needs the pymysql (or mysqlclient) driver installed.

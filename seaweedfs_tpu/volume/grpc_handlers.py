@@ -36,6 +36,7 @@ class VolumeGrpcService:
             replication=request.replication or "000",
             ttl=request.ttl,
             preallocate=request.preallocate,
+            disk_type=request.disk_type,
         )
         return vs.AllocateVolumeResponse()
 
@@ -237,8 +238,9 @@ class VolumeGrpcService:
                 yield vs.CopyFileResponse(file_content=chunk)
 
     def VolumeCopy(self, request, context):
-        """Pull a whole volume (.dat/.idx/.vif) from another volume server."""
-        loc = self.store.has_free_location()
+        """Pull a whole volume (.dat/.idx/.vif) from another volume server.
+        `disk_type` places the copy on that tier (volume.tier.move)."""
+        loc = self.store.has_free_location(request.disk_type)
         if loc is None:
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "no free slot")
         base = loc.base_name(request.volume_id, request.collection)

@@ -46,6 +46,7 @@ class VolumeServer:
         whitelist: list[str] | None = None,
         tier_backends: dict | None = None,
         tcp_port: int = 0,  # experimental raw-TCP data path; 0 disables
+        disk_types: list[str] | None = None,  # per-dir: hdd (default) / ssd
     ):
         # remote-tier backends: {"s3.default": {"endpoint": ..., ...}}
         # (the [storage.backend] config tier; backend.go:32-46)
@@ -72,11 +73,15 @@ class VolumeServer:
             data_center=data_center,
             rack=rack,
             codec_name=codec_name,
+            disk_types=disk_types,
         )
         if max_volume_count:
+            counts: dict[str, int] = {}
             for loc in self.store.locations:
                 loc.max_volume_count = max_volume_count
-            self.store.max_volume_counts = {"": max_volume_count * len(self.store.locations)}
+                counts[loc.disk_type] = (
+                    counts.get(loc.disk_type, 0) + max_volume_count)
+            self.store.max_volume_counts = counts
         self.current_leader: str | None = None
         self.metrics_port = metrics_port
         self.jwt_signing_key = (

@@ -1,0 +1,185 @@
+"""Disk-type (hdd/ssd) topology modeling and volume.tier.move.
+
+Reference: weed/storage/types/volume_disk_type.go ("" == hdd),
+master.proto disk_type fields, shell/command_volume_tier_move.go.
+Tier-3 pure-placement tests over pb snapshots plus a live ssd->hdd
+scenario across two volume servers (VERDICT r4 item 5).
+"""
+
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.pb import master_pb2
+from seaweedfs_tpu.pb import rpc as rpclib
+from seaweedfs_tpu.pb import volume_server_pb2 as vs_pb
+from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+from seaweedfs_tpu.shell.volume_commands import (
+    collect_volume_ids_for_tier_change,
+    pick_tier_move_target,
+)
+from seaweedfs_tpu.volume.server import VolumeServer
+
+
+def _free_port() -> int:
+    from helpers import free_port
+
+    return free_port()
+
+
+def _http(method, url, data=None):
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+# -- tier-3: pure placement over pb snapshots -------------------------------
+
+
+def _topo(nodes):
+    """nodes: {id: {disk_type: (max, [(vid, size, mtime, dt)...])}}"""
+    info = master_pb2.TopologyInfo(id="topo")
+    dc = info.data_center_infos.add(id="dc1")
+    rack = dc.rack_infos.add(id="r1")
+    for node_id, disks in nodes.items():
+        dn = rack.data_node_infos.add(id=node_id)
+        for dt, (maxv, vols) in disks.items():
+            disk = dn.disk_infos[dt]
+            disk.max_volume_count = maxv
+            disk.volume_count = len(vols)
+            for vid, size, mtime in vols:
+                disk.volume_infos.add(
+                    id=vid, size=size, modified_at_second=mtime,
+                    disk_type=dt)
+    return info
+
+
+def test_collect_tier_change_selects_full_quiet_source_tier():
+    now = 1_000_000
+    limit = 100
+    topo = _topo({
+        "n1:8080": {"ssd": (5, [
+            (1, 96, now - 7200),   # full + quiet on ssd -> selected
+            (2, 50, now - 7200),   # not full
+            (3, 96, now - 10),     # not quiet
+        ])},
+        "n2:8080": {"": (5, [
+            (4, 96, now - 7200),   # hdd, wrong source tier
+        ])},
+    })
+    got = collect_volume_ids_for_tier_change(
+        topo, limit, "ssd", full_percent=95, quiet_for_seconds=3600,
+        now=now)
+    assert got == [1]
+    # hdd source: both spellings select the default tier
+    assert collect_volume_ids_for_tier_change(
+        topo, limit, "hdd", full_percent=95, quiet_for_seconds=3600,
+        now=now) == [4]
+
+
+def test_pick_tier_move_target_prefers_free_capacity():
+    topo = _topo({
+        "src:8080": {"ssd": (5, [(7, 96, 0)])},
+        "small:8080": {"": (2, [(9, 10, 0)])},
+        "big:8080": {"": (10, [])},
+        "ssdonly:8080": {"ssd": (10, [])},
+    })
+    picked = pick_tier_move_target(topo, 7, "hdd")
+    assert picked == ("src:8080", "big:8080")
+    # no capacity on the target tier -> None
+    topo2 = _topo({
+        "src:8080": {"ssd": (5, [(7, 96, 0)])},
+        "ssdonly:8080": {"ssd": (10, [])},
+    })
+    assert pick_tier_move_target(topo2, 7, "hdd") is None
+    # a node already holding the volume is never the target
+    topo3 = _topo({
+        "src:8080": {"ssd": (5, [(7, 96, 0)]), "": (10, [])},
+    })
+    assert pick_tier_move_target(topo3, 7, "hdd") is None
+
+
+# -- tier-4: live ssd -> hdd move across nodes ------------------------------
+
+
+@pytest.fixture(scope="module")
+def tier_cluster(tmp_path_factory):
+    master = MasterServer(ip="127.0.0.1", port=_free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    vs_ssd = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("ssdvol"))],
+        disk_types=["ssd"],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=_free_port(), pulse_seconds=0.5,
+    )
+    vs_ssd.start()
+    vs_hdd = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("hddvol"))],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=_free_port(), pulse_seconds=0.5,
+    )
+    vs_hdd.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and len(master.topo.nodes) < 2:
+        time.sleep(0.1)
+    assert len(master.topo.nodes) == 2
+    yield master, vs_ssd, vs_hdd
+    vs_hdd.stop()
+    vs_ssd.stop()
+    master.stop()
+
+
+def test_volume_tier_move_ssd_to_hdd(tier_cluster):
+    master, vs_ssd, vs_hdd = tier_cluster
+    # allocate a volume on the ssd tier and write a blob into it
+    stub = rpclib.volume_server_stub(f"127.0.0.1:{vs_ssd.grpc_port}")
+    stub.AllocateVolume(vs_pb.AllocateVolumeRequest(
+        volume_id=77, collection="", replication="000", disk_type="ssd"))
+    fid = "77,1deadbeef"
+    code, _ = _http("POST", f"http://127.0.0.1:{vs_ssd.port}/{fid}",
+                    b"tiered!")
+    assert code == 201
+    # the heartbeat must carry the ssd disk type into the topology
+    deadline = time.time() + 10
+    node_ssd = f"127.0.0.1:{vs_ssd.port}"
+    while time.time() < deadline:
+        with master.topo.lock:
+            n = master.topo.nodes.get(node_ssd)
+            v = n.volumes.get(77) if n else None
+        if v is not None and v.disk_type == "ssd":
+            break
+        time.sleep(0.2)
+    assert v is not None and v.disk_type == "ssd"
+    assert n.max_volume_counts.get("ssd")
+    snapshot = master.topo.to_topology_info()
+    dn = [d for dc in snapshot.data_center_infos for r in dc.rack_infos
+          for d in r.data_node_infos if d.id == node_ssd][0]
+    assert 77 in [v.id for v in dn.disk_infos["ssd"].volume_infos]
+
+    env = CommandEnv(master_grpc=f"127.0.0.1:{master.grpc_port}")
+    out = run_command(
+        env,
+        "volume.tier.move -volumeId=77 -fromDiskType=ssd "
+        "-toDiskType=hdd -force")
+    assert "moved volume 77" in out, out
+
+    assert vs_ssd.store.find_volume(77) is None
+    moved = vs_hdd.store.find_volume(77)
+    assert moved is not None and moved.disk_type == ""
+    code, body = _http("GET", f"http://127.0.0.1:{vs_hdd.port}/{fid}")
+    assert (code, body) == (200, b"tiered!")
+
+    # same-tier move refuses loudly
+    try:
+        run_command(env, "volume.tier.move -fromDiskType=hdd "
+                         "-toDiskType=hdd")
+        raise AssertionError("expected same-tier refusal")
+    except RuntimeError as e:
+        assert "same as target" in str(e)

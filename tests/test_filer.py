@@ -73,10 +73,16 @@ def test_minus_chunks():
 
 
 @pytest.fixture(params=["memory", "sqlite", "leveldb", "leveldb2",
-                        "leveldb3", "redis", "abstract_sql"])
+                        "leveldb3", "redis", "abstract_sql", "etcd"])
 def store(request, tmp_path):
     fake = None
-    if request.param == "sqlite":
+    if request.param == "etcd":
+        from seaweedfs_tpu.util.etcd import FakeEtcdServer
+
+        fake = FakeEtcdServer()
+        fake.start()
+        s = make_store("etcd", servers=f"127.0.0.1:{fake.port}")
+    elif request.param == "sqlite":
         s = make_store("sqlite", path=str(tmp_path / "filer.db"))
     elif request.param == "leveldb":
         s = make_store("leveldb", path=str(tmp_path / "filerldb"))
@@ -709,3 +715,27 @@ def test_filer_hardlink_rewrite_reclaims_shadowed_chunks():
     f.drain_deletions()
     assert deleted == ["1,old", "2,new"]
     f.close()
+
+
+def test_sql_store_dirhash_collision_fails_loudly(monkeypatch):
+    """A 64-bit dirhash collision between two directories must never
+    silently replace the other directory's row (the reference's scoped
+    update + loud failure, abstract_sql_store.go InsertEntry)."""
+    import sqlite3
+
+    import seaweedfs_tpu.filer.stores.sql_store as ss
+
+    monkeypatch.setattr(ss, "hash_string_to_long", lambda s: 42)
+    s = ss.AbstractSqlStore(
+        sqlite3.connect(":memory:", check_same_thread=False),
+        ss.SqliteDialect())
+    e = filer_pb2.Entry(name="x")
+    s.insert_entry("/dirA", e)
+    with pytest.raises(ValueError, match="collision"):
+        s.insert_entry("/dirB", filer_pb2.Entry(name="x"))
+    # dirA's row survived and rewrites of it still work
+    assert s.find_entry("/dirA", "x") is not None
+    assert s.find_entry("/dirB", "x") is None
+    s.insert_entry("/dirA", filer_pb2.Entry(name="x", content=b"v2"))
+    assert s.find_entry("/dirA", "x").content == b"v2"
+    s.close()

@@ -50,3 +50,68 @@ def test_snowflake_overflow_advances_monotonically():
     ids = [s.next_file_id(512) for _ in range(20)]
     assert ids == sorted(ids)
     assert len(set(ids)) == 20
+
+
+def test_etcd_sequencer_leases_disjoint_ranges():
+    """Two masters leasing from one etcd never hand out overlapping ids,
+    and set_max pushes the shared counter past volume-reported keys
+    (etcd_sequencer.go:26-110 semantics via the native v3 client)."""
+    from seaweedfs_tpu.master.sequence import make_sequencer
+    from seaweedfs_tpu.util.etcd import FakeEtcdServer
+
+    fake = FakeEtcdServer()
+    fake.start()
+    try:
+        ep = f"127.0.0.1:{fake.port}"
+        a = make_sequencer("etcd", etcd_endpoint=ep)
+        b = make_sequencer("etcd", etcd_endpoint=ep)
+        seen = set()
+        for _ in range(40):
+            for s, count in ((a, 3), (b, 5)):
+                start = s.next_file_id(count)
+                ids = set(range(start, start + count))
+                assert not (ids & seen), "overlapping id ranges"
+                seen |= ids
+        # a volume server reports a higher max key: EVERY id handed out
+        # by the informed master afterwards must clear it (ids below are
+        # live needles), and the other master clears it once its current
+        # lease drains
+        a.set_max(1_000_000)
+        assert a.next_file_id(1) > 1_000_000
+        for _ in range(600):  # drain b's already-leased range
+            b.next_file_id(1)
+        assert b.next_file_id(1) > 1_000_000
+    finally:
+        fake.stop()
+
+
+def test_etcd_sequencer_cas_contention():
+    """Concurrent leases under contention stay disjoint (the CAS loop)."""
+    import threading
+
+    from seaweedfs_tpu.master.sequence import make_sequencer
+    from seaweedfs_tpu.util.etcd import FakeEtcdServer
+
+    fake = FakeEtcdServer()
+    fake.start()
+    try:
+        ep = f"127.0.0.1:{fake.port}"
+        seqs = [make_sequencer("etcd", etcd_endpoint=ep) for _ in range(4)]
+        out: list[int] = []
+        lock = threading.Lock()
+
+        def worker(s):
+            got = [s.next_file_id(7) for _ in range(50)]
+            with lock:
+                out.extend(got)
+
+        ts = [threading.Thread(target=worker, args=(s,)) for s in seqs]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        starts = sorted(out)
+        for i in range(1, len(starts)):
+            assert starts[i] - starts[i - 1] >= 7, "ranges overlap"
+    finally:
+        fake.stop()
