@@ -151,6 +151,8 @@ def _filer_store_selection(flag_store: str) -> tuple[str, str, dict]:
         for kind, path_key in (("sqlite", "dbFile"), ("leveldb", "dir"),
                                ("leveldb2", "dir"), ("leveldb3", "dir"),
                                ("redis", ""), ("etcd", ""),
+                               ("elastic7", ""), ("mongodb", ""),
+                               ("cassandra", ""),
                                ("mysql", ""), ("postgres", ""),
                                ("memory", "")):
             if fconf.get_bool(f"{kind}.enabled"):
@@ -169,6 +171,27 @@ def _filer_store_selection(flag_store: str) -> tuple[str, str, dict]:
             store_options = {
                 "servers": fconf.get_string("etcd.servers",
                                             "127.0.0.1:2379"),
+            }
+        elif store == "elastic7":
+            store_options = {
+                "servers": fconf.get_string("elastic7.servers",
+                                            "http://127.0.0.1:9200"),
+                "username": fconf.get_string("elastic7.username", ""),
+                "password": fconf.get_string("elastic7.password", ""),
+            }
+        elif store == "mongodb":
+            store_options = {
+                "host": fconf.get_string("mongodb.host", "127.0.0.1"),
+                "port": fconf.get_int("mongodb.port", 27017),
+                "database": fconf.get_string("mongodb.database",
+                                             "seaweedfs"),
+            }
+        elif store == "cassandra":
+            store_options = {
+                "host": fconf.get_string("cassandra.host", "127.0.0.1"),
+                "port": fconf.get_int("cassandra.port", 9042),
+                "keyspace": fconf.get_string("cassandra.keyspace",
+                                             "seaweedfs"),
             }
         elif store in ("mysql", "postgres"):
             port_default = {"mysql": 3306, "postgres": 5432}[store]

@@ -1,22 +1,28 @@
-"""Embedded FilerStore backends; importing registers them.
+"""FilerStore backends; importing registers them.
 
 Reference analogue: weed/filer/<backend>/ dirs registered via blank-import
-init() (weed/server/filer_server.go:23-36).  This build ships: in-memory
-(tests), sqlite (single-file, transactional, ordered listing), leveldb
-(bitcask-style log+snapshot store covering the reference's
+init() (weed/server/filer_server.go:23-36).  This build ships 11 kinds:
+in-memory (tests), sqlite (single-file, transactional, ordered listing),
+leveldb (bitcask-style log+snapshot store covering the reference's
 embedded-leveldb default), leveldb2 (the same, md5-partitioned 8 ways),
 leveldb3 (adaptive per-bucket partitioning with O(1) bucket drops),
-redis (any RESP2 endpoint via the framework's own client), and the
-abstract_sql class with mysql / postgres kinds (DB-API drivers load
-lazily; absent drivers raise a loud ConfigurationError).
+redis (RESP2), etcd (etcd v3 gRPC KV), elastic7 (ES REST), mongodb
+(OP_MSG wire), cassandra (CQL v4 native protocol) — each external kind
+speaks its wire protocol through a framework-native client with an
+in-process fake server as its test double — plus the abstract_sql class
+with mysql / postgres kinds (DB-API drivers load lazily; absent drivers
+raise a loud ConfigurationError).
 """
 
 from . import (  # noqa: F401
+    cassandra_store,
+    elastic_store,
     etcd_store,
     leveldb2_store,
     leveldb3_store,
     leveldb_store,
     memory_store,
+    mongodb_store,
     redis_store,
     sql_store,
     sqlite_store,

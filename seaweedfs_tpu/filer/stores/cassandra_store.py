@@ -1,0 +1,124 @@
+"""cassandra-class FilerStore over the framework-native CQL v4 client.
+
+Reference: weed/filer/cassandra/cassandra_store.go:23-180 — a
+``filemeta (directory, name, meta)`` table with PRIMARY KEY
+(directory, name): the directory is the partition key, names cluster
+sorted within it.  Statements mirror the reference's:
+INSERT/SELECT/DELETE by (directory, name), listings by
+``directory = ? AND name > ?``.  KV pairs live under a reserved NUL
+directory (the reference keeps a second table; one partition is
+equivalent under this store's model).
+
+Like the reference, DeleteFolderChildren drops one PARTITION
+(``DELETE ... WHERE directory = ?``); subtree semantics come from the
+caller issuing it per descendant directory — matching the Filer's
+_delete_tree walk, which visits every subdirectory.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ...pb import filer_pb2
+from ...util.cql import CqlClient
+from ..filerstore import FilerStore, register_store
+
+_KV_DIR = b"\x00kv"
+
+
+@register_store("cassandra")
+class CassandraStore(FilerStore):
+    name = "cassandra"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9042,
+                 keyspace: str = "seaweedfs", **_):
+        self.keyspace = keyspace  # schema setup is an operator concern
+        self._client = CqlClient(host, port)
+
+    # -- entries -----------------------------------------------------------
+
+    def insert_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
+        self._client.query(
+            "INSERT INTO filemeta (directory, name, meta) "
+            "VALUES (?, ?, ?)",
+            [directory.encode(), entry.name.encode(),
+             entry.SerializeToString()])
+
+    update_entry = insert_entry
+
+    def find_entry(self, directory: str, name: str) -> filer_pb2.Entry | None:
+        rows = self._client.query(
+            "SELECT meta FROM filemeta WHERE directory = ? AND name = ?",
+            [directory.encode(), name.encode()])
+        if not rows or rows[0][0] is None:
+            return None
+        return filer_pb2.Entry.FromString(rows[0][0])
+
+    def delete_entry(self, directory: str, name: str) -> None:
+        self._client.query(
+            "DELETE FROM filemeta WHERE directory = ? AND name = ?",
+            [directory.encode(), name.encode()])
+
+    def delete_folder_children(self, directory: str) -> None:
+        # one partition per directory; the subtree contract = dropping
+        # every descendant partition.  SELECT DISTINCT over partition
+        # keys is valid CQL (a token-range scan on a real cluster), so
+        # descendants are discoverable even when intermediate directory
+        # ENTRIES don't exist.
+        rows = self._client.query(
+            "SELECT DISTINCT directory FROM filemeta")
+        want = directory.encode()
+        prefix = (directory.rstrip("/") or "").encode() + b"/"
+        for (d,) in rows:
+            if d == want or (d or b"").startswith(prefix):
+                self._client.query(
+                    "DELETE FROM filemeta WHERE directory = ?", [d])
+
+    def list_entries(
+        self,
+        directory: str,
+        start_from: str = "",
+        inclusive: bool = False,
+        prefix: str = "",
+        limit: int = 1024,
+    ) -> Iterator[filer_pb2.Entry]:
+        if start_from:
+            op = ">=" if inclusive else ">"
+            rows = self._client.query(
+                "SELECT name, meta FROM filemeta WHERE directory = ? "
+                f"AND name {op} ?",
+                [directory.encode(), start_from.encode()])
+        else:
+            rows = self._client.query(
+                "SELECT name, meta FROM filemeta WHERE directory = ?",
+                [directory.encode()])
+        emitted = 0
+        for name_b, meta in rows:
+            name = (name_b or b"").decode()
+            if prefix and not name.startswith(prefix):
+                continue
+            if emitted >= limit:
+                return
+            emitted += 1
+            yield filer_pb2.Entry.FromString(meta or b"")
+
+    # -- kv ----------------------------------------------------------------
+
+    def kv_get(self, key: bytes) -> bytes | None:
+        rows = self._client.query(
+            "SELECT meta FROM filemeta WHERE directory = ? AND name = ?",
+            [_KV_DIR, key])
+        return rows[0][0] if rows else None
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        if value:
+            self._client.query(
+                "INSERT INTO filemeta (directory, name, meta) "
+                "VALUES (?, ?, ?)", [_KV_DIR, key, value])
+        else:
+            self._client.query(
+                "DELETE FROM filemeta WHERE directory = ? AND name = ?",
+                [_KV_DIR, key])
+
+    def close(self) -> None:
+        self._client.close()

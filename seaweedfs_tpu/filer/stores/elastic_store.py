@@ -1,0 +1,171 @@
+"""elastic7-class FilerStore over Elasticsearch's REST API.
+
+Reference: weed/filer/elastic/v7/elastic_store.go:37-295 — entries are
+JSON documents keyed by md5(fullpath) carrying a ParentId = md5(dir) for
+directory listings; KV pairs live in a dedicated ``.seaweedfs_kv_entries``
+index.  The reference shards entries into one index per top-level
+directory; this build keeps a single ``.seaweedfs_entries`` index (the
+FilerStore contract is identical — the sharding is an ES capacity knob).
+
+No elasticsearch client library ships in this image, so the store speaks
+the REST API directly (PUT/GET/DELETE ``/{index}/_doc/{id}``,
+``_search`` with a ParentId term query + ``search_after`` paging sorted
+on ``name.keyword``, ``_delete_by_query``) — the same requests work
+against a live ES 7 cluster; tests run them against the in-process
+FakeElasticServer (util.fake_elastic).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import urllib.error
+import urllib.request
+from typing import Iterator
+
+from ...pb import filer_pb2
+from ..filerstore import FilerStore, register_store
+
+INDEX_ENTRIES = ".seaweedfs_entries"
+INDEX_KV = ".seaweedfs_kv_entries"
+
+
+def _md5(s: str) -> str:
+    return hashlib.md5(s.encode()).hexdigest()
+
+
+def _join(directory: str, name: str) -> str:
+    return (directory.rstrip("/") or "") + "/" + name
+
+
+@register_store("elastic7")
+class ElasticStore(FilerStore):
+    name = "elastic7"
+
+    def __init__(self, servers: str = "http://127.0.0.1:9200",
+                 username: str = "", password: str = "",
+                 max_page_size: int = 10000, **_):
+        self.base = servers.split(",")[0].rstrip("/")
+        if not self.base.startswith("http"):
+            self.base = "http://" + self.base
+        self.max_page_size = max_page_size
+        self._auth = None
+        if username and password:
+            self._auth = "Basic " + base64.b64encode(
+                f"{username}:{password}".encode()).decode()
+
+    # -- REST plumbing -----------------------------------------------------
+
+    def _req(self, method: str, path: str, body: dict | None = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            self.base + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        if self._auth:
+            req.add_header("Authorization", self._auth)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                return json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            if e.code == 404:
+                try:
+                    return json.loads(payload or b"{}") | {"found": False}
+                except ValueError:
+                    return {"found": False}
+            raise IOError(
+                f"elastic {method} {path}: {e.code} {payload[:200]!r}"
+            ) from None
+
+    # -- entries -----------------------------------------------------------
+
+    def insert_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
+        full = _join(directory, entry.name)
+        self._req("PUT", f"/{INDEX_ENTRIES}/_doc/{_md5(full)}", {
+            "ParentId": _md5(directory),
+            "dir": directory,
+            "name": entry.name,
+            "meta": base64.b64encode(entry.SerializeToString()).decode(),
+        })
+
+    update_entry = insert_entry
+
+    def find_entry(self, directory: str, name: str) -> filer_pb2.Entry | None:
+        doc = self._req(
+            "GET",
+            f"/{INDEX_ENTRIES}/_doc/{_md5(_join(directory, name))}")
+        if not doc.get("found"):
+            return None
+        return filer_pb2.Entry.FromString(
+            base64.b64decode(doc["_source"]["meta"]))
+
+    def delete_entry(self, directory: str, name: str) -> None:
+        self._req("DELETE",
+                  f"/{INDEX_ENTRIES}/_doc/{_md5(_join(directory, name))}")
+
+    def delete_folder_children(self, directory: str) -> None:
+        # exact children + every descendant's children in one query
+        # (the reference iterates-and-deletes; _delete_by_query is the
+        # REST-native form of the same contract)
+        prefix = directory.rstrip("/") + "/"
+        self._req("POST", f"/{INDEX_ENTRIES}/_delete_by_query", {
+            "query": {"bool": {"should": [
+                {"term": {"dir": directory}},
+                {"prefix": {"dir": prefix}},
+            ]}},
+        })
+
+    def list_entries(
+        self,
+        directory: str,
+        start_from: str = "",
+        inclusive: bool = False,
+        prefix: str = "",
+        limit: int = 1024,
+    ) -> Iterator[filer_pb2.Entry]:
+        parent = _md5(directory)
+        cursor, op = start_from, ("gte" if inclusive else "gt")
+        emitted = 0
+        while emitted < limit:
+            query: dict = {"bool": {
+                "must": [{"term": {"ParentId": parent}}]}}
+            if cursor:
+                query["bool"]["filter"] = [
+                    {"range": {"name.keyword": {op: cursor}}}]
+            size = min(limit - emitted, self.max_page_size)
+            hits = self._req("POST", f"/{INDEX_ENTRIES}/_search", {
+                "query": query,
+                "sort": [{"name.keyword": "asc"}],
+                "size": size,
+            }).get("hits", {}).get("hits", [])
+            if not hits:
+                return
+            for h in hits:
+                src = h["_source"]
+                cursor, op = src["name"], "gt"
+                if prefix and not src["name"].startswith(prefix):
+                    continue
+                emitted += 1
+                yield filer_pb2.Entry.FromString(
+                    base64.b64decode(src["meta"]))
+                if emitted >= limit:
+                    return
+            if len(hits) < size:
+                return
+
+    # -- kv ----------------------------------------------------------------
+
+    def kv_get(self, key: bytes) -> bytes | None:
+        doc = self._req("GET", f"/{INDEX_KV}/_doc/{_md5(key.decode('latin-1'))}")
+        if not doc.get("found"):
+            return None
+        return base64.b64decode(doc["_source"]["Value"])
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        kid = _md5(key.decode("latin-1"))
+        if value:
+            self._req("PUT", f"/{INDEX_KV}/_doc/{kid}", {
+                "Value": base64.b64encode(value).decode()})
+        else:
+            self._req("DELETE", f"/{INDEX_KV}/_doc/{kid}")

@@ -71,9 +71,14 @@ class EtcdStore(FilerStore):
         prefix: str = "",
         limit: int = 1024,
     ) -> Iterator[filer_pb2.Entry]:
+        prefix_key = _dir_prefix(directory, prefix)
         start = _dir_prefix(directory, start_from) if start_from else b""
+        # clamp: a marker sorting BEFORE the prefix must not let
+        # pre-prefix keys consume the server-side limit (S3 listings
+        # pass marker+prefix combinations shaped exactly like this)
+        start = max(start, prefix_key)
         fetched = self._client.range_prefix(
-            _dir_prefix(directory, prefix), start=start,
+            prefix_key, start=start,
             limit=limit + 1 if start_from else limit)
         count = 0
         for k, v in fetched:

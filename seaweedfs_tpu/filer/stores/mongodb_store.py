@@ -1,0 +1,112 @@
+"""mongodb-class FilerStore over the framework-native OP_MSG client.
+
+Reference: weed/filer/mongodb/mongodb_store.go:29-200 — documents
+``{directory, name, meta}`` in the ``filemeta`` collection with a unique
+(directory, name) index; find/upsert/delete by exact (directory, name),
+listings by ``{directory, name: {$gt: start}}`` sorted on name.  KV
+pairs reuse the same collection under a reserved directory (the
+reference stores them as ``{directory: "", name: hex(key)}``-shaped
+rows via the same model).
+
+The reference's DeleteFolderChildren removes only DIRECT children; this
+framework's Filer contract expects the whole subtree, so the store adds
+a ranged ``$or`` over the descendant prefix — same observable behavior
+as the other nine backends.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from ...pb import filer_pb2
+from ...util.mongo import MongoClient
+from ..filerstore import FilerStore, register_store
+
+COLLECTION = "filemeta"
+_KV_DIR = "\x00kv"  # reserved namespace: no real path starts with NUL
+
+
+def _subtree_filter(directory: str) -> dict:
+    prefix = directory.rstrip("/") + "/"
+    end = prefix[:-1] + chr(ord(prefix[-1]) + 1)
+    return {"$or": [
+        {"directory": directory},
+        {"directory": {"$gte": prefix, "$lt": end}},
+    ]}
+
+
+@register_store("mongodb")
+class MongodbStore(FilerStore):
+    name = "mongodb"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 27017,
+                 database: str = "seaweedfs", **_):
+        self._client = MongoClient(host, port, database=database)
+
+    # -- entries -----------------------------------------------------------
+
+    def insert_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
+        self._client.upsert(
+            COLLECTION,
+            {"directory": directory, "name": entry.name},
+            {"meta": entry.SerializeToString()},
+        )
+
+    update_entry = insert_entry
+
+    def find_entry(self, directory: str, name: str) -> filer_pb2.Entry | None:
+        rows = self._client.find(
+            COLLECTION, {"directory": directory, "name": name}, limit=1)
+        if not rows:
+            return None
+        return filer_pb2.Entry.FromString(rows[0]["meta"])
+
+    def delete_entry(self, directory: str, name: str) -> None:
+        self._client.delete(
+            COLLECTION, {"directory": directory, "name": name})
+
+    def delete_folder_children(self, directory: str) -> None:
+        self._client.delete(COLLECTION, _subtree_filter(directory),
+                            many=True)
+
+    def list_entries(
+        self,
+        directory: str,
+        start_from: str = "",
+        inclusive: bool = False,
+        prefix: str = "",
+        limit: int = 1024,
+    ) -> Iterator[filer_pb2.Entry]:
+        flt: dict = {"directory": directory}
+        if start_from:
+            flt["name"] = {"$gte" if inclusive else "$gt": start_from}
+        emitted = 0
+        rows = self._client.find(COLLECTION, flt, sort={"name": 1},
+                                 limit=0)
+        for row in rows:
+            if prefix and not row["name"].startswith(prefix):
+                continue
+            if emitted >= limit:
+                return
+            emitted += 1
+            yield filer_pb2.Entry.FromString(row["meta"])
+
+    # -- kv ----------------------------------------------------------------
+
+    def kv_get(self, key: bytes) -> bytes | None:
+        rows = self._client.find(
+            COLLECTION,
+            {"directory": _KV_DIR, "name": key.hex()}, limit=1)
+        return bytes(rows[0]["meta"]) if rows else None
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        if value:
+            self._client.upsert(
+                COLLECTION, {"directory": _KV_DIR, "name": key.hex()},
+                {"meta": value})
+        else:
+            self._client.delete(
+                COLLECTION, {"directory": _KV_DIR, "name": key.hex()})
+
+    def close(self) -> None:
+        self._client.close()

@@ -156,24 +156,40 @@ class AbstractSqlStore(FilerStore):
         dirhash = hash_string_to_long(directory)
         meta = entry.SerializeToString()
         with self._lock:
-            cur = self._conn.cursor()
-            # existence check instead of insert-then-catch: a failed
-            # INSERT aborts the surrounding transaction on postgres, and
-            # the check also distinguishes a legitimate rewrite from a
-            # cross-directory dirhash collision (which must fail loudly,
-            # not replace the other directory's row)
-            cur.execute(self._sql_find_dir, (dirhash, entry.name))
-            row = cur.fetchone()
-            if row is None:
-                cur.execute(self._sql_insert,
-                            (dirhash, entry.name, directory, meta))
-            elif str(row[0]) == directory:
-                cur.execute(self._sql_update,
-                            (meta, dirhash, entry.name, directory))
-            else:
-                raise ValueError(
-                    f"dirhash collision: ({directory!r}, {entry.name!r}) "
-                    f"conflicts with {str(row[0])!r}")
+            # check-then-act, retried once: the existence check (not
+            # insert-then-catch) distinguishes a legitimate rewrite from
+            # a cross-directory dirhash collision without relying on
+            # driver-specific duplicate-key errors; the retry absorbs a
+            # concurrent writer from ANOTHER process (two filers on one
+            # DB) whose insert lands between our check and insert
+            for attempt in range(2):
+                cur = self._conn.cursor()
+                cur.execute(self._sql_find_dir, (dirhash, entry.name))
+                row = cur.fetchone()
+                if row is None:
+                    try:
+                        cur.execute(self._sql_insert,
+                                    (dirhash, entry.name, directory, meta))
+                    except Exception:
+                        # likely a cross-process duplicate-key race:
+                        # clear any poisoned implicit transaction and
+                        # re-run the check, which now sees the row
+                        if not self._in_tx:
+                            try:
+                                self._conn.rollback()
+                            except Exception:
+                                pass
+                        if attempt == 0:
+                            continue
+                        raise
+                elif str(row[0]) == directory:
+                    cur.execute(self._sql_update,
+                                (meta, dirhash, entry.name, directory))
+                else:
+                    raise ValueError(
+                        f"dirhash collision: ({directory!r}, "
+                        f"{entry.name!r}) conflicts with {str(row[0])!r}")
+                break
             self._commit()
 
     update_entry = insert_entry

@@ -118,7 +118,11 @@ class EtcdSequencer:
         seen_value are live needle ids, so handing out the rest of the
         current range would alias existing needles."""
         with self._lock:
-            if seen_value < self._max:
+            # compare against the NEXT id to hand out, not the lease end:
+            # any id <= seen_value may be a live needle, so a lease whose
+            # cursor sits at or below it must be dropped even if the
+            # lease's end extends past it
+            if seen_value < self._current:
                 return
             self._current = self._max = 0  # force a fresh lease
             while True:

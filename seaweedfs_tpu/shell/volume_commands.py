@@ -627,12 +627,17 @@ def volume_tier_move(env: CommandEnv, args: list[str]) -> str:
             + ("" if apply_changes else " (dry run, -force to apply)"))
         if not apply_changes:
             continue
-        _node, collection_of = _locate_volume(env, vid)
-        # mark every replica readonly, then live-move one replica to the
-        # target tier and drop the others (reference semantics)
-        replicas = [dn.id for _dc, _rack, dn in _iter_nodes(topo)
-                    if any(v.id == vid for d in dn.disk_infos.values()
-                           for v in d.volume_infos)]
+        # reuse the in-hand snapshot for the replica scan AND the
+        # collection lookup — no extra VolumeList round trips per volume
+        replicas = []
+        collection_of = ""
+        for _dc, _rack, dn in _iter_nodes(topo):
+            for d in dn.disk_infos.values():
+                for v in d.volume_infos:
+                    if v.id == vid:
+                        collection_of = v.collection
+                        if dn.id not in replicas:
+                            replicas.append(dn.id)
         for node in replicas:
             env.volume_server(_node_grpc(node)).VolumeMarkReadonly(
                 vs.VolumeMarkReadonlyRequest(volume_id=vid))

@@ -1,0 +1,100 @@
+"""Minimal BSON encoder/decoder (the subset MongoDB's OP_MSG needs).
+
+No bson/pymongo library ships in this image; the mongodb filer store
+speaks the wire format directly (util.mongo).  Supported types: double,
+string, embedded document, array, binary (subtype 0), bool, null,
+int32, int64 — everything the filemeta document model and the command
+envelopes use.  Dicts preserve insertion order, as BSON requires.
+"""
+
+from __future__ import annotations
+
+import struct
+
+
+class Int64(int):
+    """Marker for values that must encode as BSON int64."""
+
+
+def _enc_cstring(s: str) -> bytes:
+    b = s.encode()
+    if b"\x00" in b:
+        raise ValueError("BSON cstring cannot contain NUL")
+    return b + b"\x00"
+
+
+def _enc_value(name: str, v) -> bytes:
+    n = _enc_cstring(name)
+    if isinstance(v, bool):  # before int — bool is an int subclass
+        return b"\x08" + n + (b"\x01" if v else b"\x00")
+    if isinstance(v, Int64):
+        return b"\x12" + n + struct.pack("<q", int(v))
+    if isinstance(v, int):
+        if -(1 << 31) <= v < (1 << 31):
+            return b"\x10" + n + struct.pack("<i", v)
+        return b"\x12" + n + struct.pack("<q", v)
+    if isinstance(v, float):
+        return b"\x01" + n + struct.pack("<d", v)
+    if isinstance(v, str):
+        b = v.encode()
+        return b"\x02" + n + struct.pack("<i", len(b) + 1) + b + b"\x00"
+    if isinstance(v, (bytes, bytearray, memoryview)):
+        b = bytes(v)
+        return b"\x05" + n + struct.pack("<i", len(b)) + b"\x00" + b
+    if v is None:
+        return b"\x0a" + n
+    if isinstance(v, dict):
+        return b"\x03" + n + encode(v)
+    if isinstance(v, (list, tuple)):
+        inner = {str(i): x for i, x in enumerate(v)}
+        return b"\x04" + n + encode(inner)
+    raise TypeError(f"unsupported BSON type: {type(v)!r}")
+
+
+def encode(doc: dict) -> bytes:
+    body = b"".join(_enc_value(k, v) for k, v in doc.items())
+    return struct.pack("<i", len(body) + 5) + body + b"\x00"
+
+
+def _dec_cstring(buf: bytes, at: int) -> tuple[str, int]:
+    end = buf.index(b"\x00", at)
+    return buf[at:end].decode(), end + 1
+
+
+def _dec_value(tag: int, buf: bytes, at: int):
+    if tag == 0x01:
+        return struct.unpack_from("<d", buf, at)[0], at + 8
+    if tag == 0x02:
+        n = struct.unpack_from("<i", buf, at)[0]
+        return buf[at + 4:at + 4 + n - 1].decode(), at + 4 + n
+    if tag in (0x03, 0x04):
+        n = struct.unpack_from("<i", buf, at)[0]
+        sub = decode(buf[at:at + n])
+        if tag == 0x04:
+            return [sub[str(i)] for i in range(len(sub))], at + n
+        return sub, at + n
+    if tag == 0x05:
+        n = struct.unpack_from("<i", buf, at)[0]
+        return bytes(buf[at + 5:at + 5 + n]), at + 5 + n
+    if tag == 0x08:
+        return buf[at] != 0, at + 1
+    if tag == 0x0A:
+        return None, at
+    if tag == 0x10:
+        return struct.unpack_from("<i", buf, at)[0], at + 4
+    if tag == 0x12:
+        return struct.unpack_from("<q", buf, at)[0], at + 8
+    raise ValueError(f"unsupported BSON tag 0x{tag:02x}")
+
+
+def decode(buf: bytes) -> dict:
+    total = struct.unpack_from("<i", buf, 0)[0]
+    if total > len(buf):
+        raise ValueError("truncated BSON document")
+    out: dict = {}
+    at = 4
+    while buf[at] != 0:
+        tag = buf[at]
+        name, at = _dec_cstring(buf, at + 1)
+        out[name], at = _dec_value(tag, buf, at)
+    return out

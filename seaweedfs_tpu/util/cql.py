@@ -1,0 +1,336 @@
+"""Framework-native CQL v4 client (Cassandra native protocol) + fake.
+
+No gocql/cassandra-driver equivalent ships in this image, so — like the
+RESP/etcd/ES/Mongo clients — the cassandra filer store frames the
+native protocol itself: v4 request frames (STARTUP, QUERY with bound
+values) and RESULT parsing (Rows / Void).  `FakeCassandraServer`
+implements the same frames over an in-memory table and dispatches on
+the store's exact prepared-statement shapes, proving the client's
+framing without the external service.
+
+Frame layout (native_protocol_v4.spec):
+  version u8 (0x04 req / 0x84 resp), flags u8, stream i16, opcode u8,
+  length i32, body.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+OP_STARTUP = 0x01
+OP_READY = 0x02
+OP_ERROR = 0x00
+OP_QUERY = 0x07
+OP_RESULT = 0x08
+
+_CONSISTENCY_LOCAL_QUORUM = 0x0006
+_FLAG_VALUES = 0x01
+
+RESULT_VOID = 0x0001
+RESULT_ROWS = 0x0002
+
+
+def _string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+def _long_string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">i", len(b)) + b
+
+
+def _string_map(m: dict[str, str]) -> bytes:
+    out = struct.pack(">H", len(m))
+    for k, v in m.items():
+        out += _string(k) + _string(v)
+    return out
+
+
+def _bytes_value(v: bytes | None) -> bytes:
+    if v is None:
+        return struct.pack(">i", -1)
+    return struct.pack(">i", len(v)) + v
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("cql connection closed")
+        out += chunk
+    return out
+
+
+def _frame(opcode: int, body: bytes, stream: int = 0,
+           response: bool = False) -> bytes:
+    version = 0x84 if response else 0x04
+    return struct.pack(">BBhBi", version, 0, stream, opcode,
+                       len(body)) + body
+
+
+def _read_frame(sock: socket.socket) -> tuple[int, int, bytes]:
+    hdr = _read_exact(sock, 9)
+    _ver, _flags, stream, opcode, length = struct.unpack(">BBhBi", hdr)
+    return stream, opcode, _read_exact(sock, length) if length else b""
+
+
+class CqlClient:
+    """One QUERY round trip per call; reconnects a stale pooled socket."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 9042,
+                 timeout: float = 10.0):
+        self.host, self.port, self.timeout = host, port, timeout
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection((self.host, self.port),
+                                         timeout=self.timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.sendall(_frame(OP_STARTUP,
+                             _string_map({"CQL_VERSION": "3.0.0"})))
+            _stream, opcode, body = _read_frame(s)
+            if opcode != OP_READY:
+                s.close()
+                raise IOError(f"cql startup failed: opcode {opcode}")
+            self._sock = s
+        return self._sock
+
+    def query(self, cql: str,
+              values: list[bytes | None] | None = None) -> list[list[bytes | None]]:
+        """Execute one statement with blob-typed bound values; returns
+        rows of cell blobs (RESULT Rows) or [] (Void)."""
+        body = _long_string(cql)
+        body += struct.pack(">H", _CONSISTENCY_LOCAL_QUORUM)
+        if values:
+            body += struct.pack(">BH", _FLAG_VALUES, len(values))
+            for v in values:
+                body += _bytes_value(v)
+        else:
+            body += struct.pack(">B", 0)
+        with self._lock:
+            try:
+                sock = self._conn()
+                sock.sendall(_frame(OP_QUERY, body))
+                _stream, opcode, payload = _read_frame(sock)
+            except (OSError, ConnectionError):
+                self.close()
+                sock = self._conn()
+                sock.sendall(_frame(OP_QUERY, body))
+                _stream, opcode, payload = _read_frame(sock)
+        if opcode == OP_ERROR:
+            code = struct.unpack_from(">i", payload, 0)[0]
+            n = struct.unpack_from(">H", payload, 4)[0]
+            msg = payload[6:6 + n].decode()
+            raise IOError(f"cql error 0x{code:04x}: {msg}")
+        if opcode != OP_RESULT:
+            raise IOError(f"unexpected cql opcode {opcode}")
+        kind = struct.unpack_from(">i", payload, 0)[0]
+        if kind != RESULT_ROWS:
+            return []
+        return self._parse_rows(payload)
+
+    @staticmethod
+    def _parse_rows(payload: bytes) -> list[list[bytes | None]]:
+        at = 4
+        flags, col_count = struct.unpack_from(">ii", payload, at)
+        at += 8
+        if flags & 0x0002:  # has_more_pages: paging state
+            n = struct.unpack_from(">i", payload, at)[0]
+            at += 4 + max(n, 0)
+        if not flags & 0x0001:  # no global_tables_spec
+            pass
+        else:
+            for _ in range(2):  # keyspace + table
+                n = struct.unpack_from(">H", payload, at)[0]
+                at += 2 + n
+        for _ in range(col_count):  # column specs
+            if not flags & 0x0001:
+                for _ in range(2):
+                    n = struct.unpack_from(">H", payload, at)[0]
+                    at += 2 + n
+            n = struct.unpack_from(">H", payload, at)[0]  # name
+            at += 2 + n
+            opt = struct.unpack_from(">H", payload, at)[0]  # type id
+            at += 2
+            if opt in (0x0000, 0x0020, 0x0021, 0x0022, 0x0030):
+                raise IOError("complex CQL column types unsupported")
+        row_count = struct.unpack_from(">i", payload, at)[0]
+        at += 4
+        rows = []
+        for _ in range(row_count):
+            row: list[bytes | None] = []
+            for _ in range(col_count):
+                n = struct.unpack_from(">i", payload, at)[0]
+                at += 4
+                if n < 0:
+                    row.append(None)
+                else:
+                    row.append(payload[at:at + n])
+                    at += n
+            rows.append(row)
+        return rows
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+# ---------------------------------------------------------------------------
+# Fake server: the filemeta statement shapes over an in-memory table
+# ---------------------------------------------------------------------------
+
+
+class FakeCassandraServer:
+    """CQL v4 framing + the cassandra store's statements.
+
+    Table model: {(directory, name) -> meta blob}, sorted by name within
+    a directory (the clustering order a (directory, name) primary key
+    gives the real store).
+    """
+
+    def __init__(self, port: int = 0):
+        self.port = port
+        self._rows: dict[tuple[bytes, bytes], bytes] = {}
+        self._lock = threading.Lock()
+        self._srv: socket.socket | None = None
+        self._stop = threading.Event()
+
+    def _execute(self, cql: str, vals: list[bytes | None]) -> list:
+        q = " ".join(cql.split()).lower()
+        with self._lock:
+            if q.startswith("insert into filemeta"):
+                d, n, m = vals[0] or b"", vals[1] or b"", vals[2] or b""
+                self._rows[(d, n)] = m
+                return []
+            if q.startswith("select distinct directory from filemeta"):
+                return [[d] for d in sorted({k[0] for k in self._rows})]
+            if q.startswith("select meta from filemeta where directory = ? and name = ?"):
+                m = self._rows.get((vals[0] or b"", vals[1] or b""))
+                return [] if m is None else [[m]]
+            if q.startswith("select name, meta from filemeta where directory = ? and name >= ?"):
+                return self._list(vals[0] or b"", vals[1] or b"", ge=True)
+            if q.startswith("select name, meta from filemeta where directory = ? and name > ?"):
+                return self._list(vals[0] or b"", vals[1] or b"", ge=False)
+            if q.startswith("select name, meta from filemeta where directory = ?"):
+                return self._list(vals[0] or b"", b"", ge=True)
+            if q.startswith("delete from filemeta where directory = ? and name = ?"):
+                self._rows.pop((vals[0] or b"", vals[1] or b""), None)
+                return []
+            if q.startswith("delete from filemeta where directory = ?"):
+                d = vals[0] or b""
+                for k in [k for k in self._rows if k[0] == d]:
+                    del self._rows[k]
+                return []
+            raise ValueError(f"fake cassandra: unsupported statement {cql!r}")
+
+    def _list(self, d: bytes, start: bytes, ge: bool) -> list:
+        out = []
+        for (rd, rn), m in sorted(self._rows.items()):
+            if rd != d:
+                continue
+            if start and (rn < start if ge else rn <= start):
+                continue
+            out.append([rn, m])
+        return out
+
+    def _rows_result(self, rows: list) -> bytes:
+        cols = 2 if rows and len(rows[0]) == 2 else 1
+        body = struct.pack(">iii", RESULT_ROWS, 0x0001, cols)
+        body += _string("ks") + _string("filemeta")
+        for i in range(cols):
+            body += _string(f"c{i}") + struct.pack(">H", 0x0003)  # blob
+        body += struct.pack(">i", len(rows))
+        for row in rows:
+            for cell in row:
+                body += _bytes_value(cell)
+        return body
+
+    def _client_loop(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    stream, opcode, payload = _read_frame(conn)
+                except (ConnectionError, OSError, struct.error):
+                    return
+                if opcode == OP_STARTUP:
+                    conn.sendall(_frame(OP_READY, b"", stream, True))
+                    continue
+                if opcode != OP_QUERY:
+                    conn.sendall(_frame(
+                        OP_ERROR,
+                        struct.pack(">i", 0x000A) + _string("bad opcode"),
+                        stream, True))
+                    continue
+                n = struct.unpack_from(">i", payload, 0)[0]
+                cql = payload[4:4 + n].decode()
+                at = 4 + n + 2  # consistency
+                flags = payload[at]
+                at += 1
+                vals: list[bytes | None] = []
+                if flags & _FLAG_VALUES:
+                    count = struct.unpack_from(">H", payload, at)[0]
+                    at += 2
+                    for _ in range(count):
+                        ln = struct.unpack_from(">i", payload, at)[0]
+                        at += 4
+                        if ln < 0:
+                            vals.append(None)
+                        else:
+                            vals.append(payload[at:at + ln])
+                            at += ln
+                try:
+                    rows = self._execute(cql, vals)
+                except ValueError as e:
+                    conn.sendall(_frame(
+                        OP_ERROR,
+                        struct.pack(">i", 0x2200) + _string(str(e)),
+                        stream, True))
+                    continue
+                if rows:
+                    body = self._rows_result(rows)
+                else:
+                    # Void for writes; empty Rows for selects
+                    if cql.lstrip().lower().startswith("select"):
+                        body = self._rows_result([])
+                    else:
+                        body = struct.pack(">i", RESULT_VOID)
+                conn.sendall(_frame(OP_RESULT, body, stream, True))
+        finally:
+            conn.close()
+
+    def start(self) -> None:
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", self.port))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+
+        def accept_loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._srv.accept()
+                except OSError:
+                    return
+                threading.Thread(target=self._client_loop, args=(conn,),
+                                 daemon=True).start()
+
+        threading.Thread(target=accept_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+            self._srv = None

@@ -1,0 +1,187 @@
+"""In-process Elasticsearch 7 REST subset — the elastic7 store's test
+double (same role as FakeRedisServer / FakeEtcdServer: it proves the
+client's wire behavior without the external service).
+
+Implements exactly what filer/stores/elastic_store.py sends:
+  PUT/GET/DELETE /{index}/_doc/{id}
+  POST /{index}/_search   (ParentId term + optional name range, sorted)
+  POST /{index}/_delete_by_query  (bool should of term/prefix on dir)
+  DELETE /{index}
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_DOC_RE = re.compile(r"^/([^/]+)/_doc/([^/?]+)$")
+_SEARCH_RE = re.compile(r"^/([^/]+)/_search$")
+_DBQ_RE = re.compile(r"^/([^/]+)/_delete_by_query$")
+_INDEX_RE = re.compile(r"^/([^/]+)$")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):  # noqa: D102 — quiet
+        pass
+
+    @property
+    def db(self):
+        return self.server.indices  # type: ignore[attr-defined]
+
+    @property
+    def lock(self):
+        return self.server.lock  # type: ignore[attr-defined]
+
+    def _json(self, code: int, obj: dict) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b""
+        return json.loads(raw) if raw else {}
+
+    def do_PUT(self):
+        m = _DOC_RE.match(self.path)
+        if not m:
+            return self._json(400, {"error": "bad path"})
+        index, doc_id = m.groups()
+        doc = self._body()
+        with self.lock:
+            created = doc_id not in self.db.setdefault(index, {})
+            self.db[index][doc_id] = doc
+        self._json(201 if created else 200,
+                   {"result": "created" if created else "updated"})
+
+    def do_GET(self):
+        m = _DOC_RE.match(self.path)
+        if not m:
+            return self._json(400, {"error": "bad path"})
+        index, doc_id = m.groups()
+        with self.lock:
+            doc = self.db.get(index, {}).get(doc_id)
+        if doc is None:
+            return self._json(404, {"found": False})
+        self._json(200, {"found": True, "_id": doc_id, "_source": doc})
+
+    def do_DELETE(self):
+        m = _DOC_RE.match(self.path)
+        with self.lock:
+            if m:
+                index, doc_id = m.groups()
+                existed = self.db.get(index, {}).pop(doc_id, None)
+                return self._json(
+                    200 if existed else 404,
+                    {"result": "deleted" if existed else "not_found"})
+            m = _INDEX_RE.match(self.path)
+            if m:
+                self.db.pop(m.group(1), None)
+                return self._json(200, {"acknowledged": True})
+        self._json(400, {"error": "bad path"})
+
+    def do_POST(self):
+        m = _SEARCH_RE.match(self.path)
+        if m:
+            return self._search(m.group(1), self._body())
+        m = _DBQ_RE.match(self.path)
+        if m:
+            return self._delete_by_query(m.group(1), self._body())
+        self._json(400, {"error": "bad path"})
+
+    # -- query evaluation --------------------------------------------------
+
+    @staticmethod
+    def _matches(doc: dict, query: dict) -> bool:
+        if "term" in query:
+            ((field, want),) = query["term"].items()
+            return doc.get(field.replace(".keyword", "")) == want
+        if "prefix" in query:
+            ((field, want),) = query["prefix"].items()
+            return str(doc.get(field.replace(".keyword", ""), "")
+                       ).startswith(want)
+        if "range" in query:
+            ((field, conds),) = query["range"].items()
+            val = doc.get(field.replace(".keyword", ""))
+            if val is None:
+                return False
+            for op, bound in conds.items():
+                if op == "gt" and not val > bound:
+                    return False
+                if op == "gte" and not val >= bound:
+                    return False
+                if op == "lt" and not val < bound:
+                    return False
+                if op == "lte" and not val <= bound:
+                    return False
+            return True
+        if "bool" in query:
+            b = query["bool"]
+            if not all(_Handler._matches(doc, q)
+                       for q in b.get("must", [])):
+                return False
+            if not all(_Handler._matches(doc, q)
+                       for q in b.get("filter", [])):
+                return False
+            should = b.get("should", [])
+            if should and not any(_Handler._matches(doc, q)
+                                  for q in should):
+                return False
+            return True
+        return True  # match_all
+
+    def _search(self, index: str, body: dict) -> None:
+        query = body.get("query", {})
+        size = int(body.get("size", 10))
+        with self.lock:
+            docs = list(self.db.get(index, {}).items())
+        hits = [{"_id": i, "_source": d} for i, d in docs
+                if self._matches(d, query)]
+        for sort in reversed(body.get("sort", [])):
+            ((field, order),) = sort.items() if isinstance(sort, dict) \
+                else ((sort, "asc"),)
+            if isinstance(order, dict):
+                order = order.get("order", "asc")
+            hits.sort(key=lambda h: h["_source"].get(
+                field.replace(".keyword", ""), ""),
+                reverse=(order == "desc"))
+        hits = hits[:size]
+        self._json(200, {"hits": {"total": {"value": len(hits)},
+                                  "hits": hits}})
+
+    def _delete_by_query(self, index: str, body: dict) -> None:
+        query = body.get("query", {})
+        with self.lock:
+            idx = self.db.get(index, {})
+            victims = [i for i, d in idx.items()
+                       if self._matches(d, query)]
+            for i in victims:
+                del idx[i]
+        self._json(200, {"deleted": len(victims)})
+
+
+class FakeElasticServer:
+    def __init__(self, port: int = 0):
+        self.port = port
+        self._srv: ThreadingHTTPServer | None = None
+
+    def start(self) -> None:
+        self._srv = ThreadingHTTPServer(("127.0.0.1", self.port), _Handler)
+        self._srv.indices = {}  # type: ignore[attr-defined]
+        self._srv.lock = threading.Lock()  # type: ignore[attr-defined]
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+
+    def stop(self) -> None:
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None

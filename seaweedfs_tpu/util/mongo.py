@@ -1,0 +1,245 @@
+"""Framework-native MongoDB wire client (OP_MSG) + in-process fake.
+
+No pymongo ships in this image, so — like the RESP, etcd-v3 and ES REST
+clients before it — the mongodb filer store speaks the wire protocol
+itself: OP_MSG (opcode 2013, MongoDB 3.6+) request/reply framing around
+BSON command documents (util.bsonlite).  `FakeMongoServer` implements
+the same command subset (find / update-upsert / delete, with $or /
+$gte / $lt / $gt filters, sort + limit) over a dict, proving the
+client's framing and command shapes without the external service.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from . import bsonlite
+
+OP_MSG = 2013
+
+
+def _frame(request_id: int, doc: dict) -> bytes:
+    body = b"\x00\x00\x00\x00" + b"\x00" + bsonlite.encode(doc)
+    header = struct.pack("<iiii", 16 + len(body), request_id, 0, OP_MSG)
+    return header + body
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("mongo connection closed")
+        out += chunk
+    return out
+
+
+def _read_msg(sock: socket.socket) -> dict:
+    length, _rid, _to, opcode = struct.unpack("<iiii", _read_exact(sock, 16))
+    payload = _read_exact(sock, length - 16)
+    if opcode != OP_MSG:
+        raise IOError(f"unexpected mongo opcode {opcode}")
+    # flagBits(4) + section kind byte(1) + body document
+    return bsonlite.decode(payload[5:])
+
+
+class MongoClient:
+    """One command round trip per call over a pooled connection."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 27017,
+                 database: str = "seaweedfs", timeout: float = 10.0):
+        self.host, self.port = host, port
+        self.database = database
+        self.timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._rid = 0
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection((self.host, self.port),
+                                         timeout=self.timeout)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def command(self, doc: dict) -> dict:
+        doc = dict(doc)
+        doc["$db"] = self.database
+        with self._lock:
+            self._rid += 1
+            try:
+                sock = self._conn()
+                sock.sendall(_frame(self._rid, doc))
+                resp = _read_msg(sock)
+            except (OSError, ConnectionError):
+                self.close()  # reconnect once on a stale pooled socket
+                sock = self._conn()
+                sock.sendall(_frame(self._rid, doc))
+                resp = _read_msg(sock)
+        if resp.get("ok") != 1 and resp.get("ok") != 1.0:
+            raise IOError(f"mongo command failed: {resp}")
+        return resp
+
+    def find(self, collection: str, flt: dict, sort: dict | None = None,
+             limit: int = 0) -> list[dict]:
+        cmd: dict = {"find": collection, "filter": flt,
+                     "singleBatch": True, "batchSize": max(limit, 101)}
+        if sort:
+            cmd["sort"] = sort
+        if limit:
+            cmd["limit"] = limit
+        resp = self.command(cmd)
+        return resp.get("cursor", {}).get("firstBatch", [])
+
+    def upsert(self, collection: str, flt: dict, update_set: dict) -> None:
+        self.command({"update": collection, "updates": [
+            {"q": flt, "u": {"$set": update_set}, "upsert": True},
+        ]})
+
+    def delete(self, collection: str, flt: dict, many: bool = False) -> int:
+        resp = self.command({"delete": collection, "deletes": [
+            {"q": flt, "limit": 0 if many else 1},
+        ]})
+        return int(resp.get("n", 0))
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+
+# ---------------------------------------------------------------------------
+# Fake server
+# ---------------------------------------------------------------------------
+
+
+def _match(doc: dict, flt: dict) -> bool:
+    for k, cond in flt.items():
+        if k == "$or":
+            if not any(_match(doc, sub) for sub in cond):
+                return False
+            continue
+        val = doc.get(k)
+        if isinstance(cond, dict) and any(op.startswith("$")
+                                          for op in cond):
+            for op, bound in cond.items():
+                if op == "$gt" and not (val is not None and val > bound):
+                    return False
+                if op == "$gte" and not (val is not None and val >= bound):
+                    return False
+                if op == "$lt" and not (val is not None and val < bound):
+                    return False
+                if op == "$lte" and not (val is not None and val <= bound):
+                    return False
+                if op == "$eq" and val != bound:
+                    return False
+        elif val != cond:
+            return False
+    return True
+
+
+class FakeMongoServer:
+    """OP_MSG find/update/delete over in-memory collections."""
+
+    def __init__(self, port: int = 0):
+        self.port = port
+        self._collections: dict[str, list[dict]] = {}
+        self._lock = threading.Lock()
+        self._srv: socket.socket | None = None
+        self._stop = threading.Event()
+
+    def _handle_cmd(self, cmd: dict) -> dict:
+        with self._lock:
+            if "find" in cmd:
+                rows = [d for d in self._collections.get(cmd["find"], [])
+                        if _match(d, cmd.get("filter", {}))]
+                for field, order in reversed(
+                        list(cmd.get("sort", {}).items())):
+                    rows.sort(key=lambda d: d.get(field, ""),
+                              reverse=(order == -1))
+                limit = int(cmd.get("limit", 0))
+                if limit:
+                    rows = rows[:limit]
+                return {"cursor": {"firstBatch": rows, "id": bsonlite.Int64(0),
+                                   "ns": f"x.{cmd['find']}"}, "ok": 1.0}
+            if "update" in cmd:
+                col = self._collections.setdefault(cmd["update"], [])
+                n = 0
+                for u in cmd.get("updates", []):
+                    hit = [d for d in col if _match(d, u.get("q", {}))]
+                    if hit:
+                        for d in hit:
+                            d.update(u["u"].get("$set", {}))
+                            n += 1
+                    elif u.get("upsert"):
+                        doc = dict(u.get("q", {}))
+                        doc = {k: v for k, v in doc.items()
+                               if not isinstance(v, dict)}
+                        doc.update(u["u"].get("$set", {}))
+                        col.append(doc)
+                        n += 1
+                return {"n": n, "ok": 1.0}
+            if "delete" in cmd:
+                col = self._collections.get(cmd["delete"], [])
+                n = 0
+                for spec in cmd.get("deletes", []):
+                    flt, lim = spec.get("q", {}), spec.get("limit", 0)
+                    keep = []
+                    for d in col:
+                        if _match(d, flt) and (lim == 0 or n < lim):
+                            n += 1
+                        else:
+                            keep.append(d)
+                    col[:] = keep
+                return {"n": n, "ok": 1.0}
+            return {"ok": 1.0}  # ping/ismaster/etc.
+
+    def _client_loop(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    length, rid, _to, _op = struct.unpack(
+                        "<iiii", _read_exact(conn, 16))
+                    payload = _read_exact(conn, length - 16)
+                except (ConnectionError, struct.error, OSError):
+                    return
+                cmd = bsonlite.decode(payload[5:])
+                reply = self._handle_cmd(cmd)
+                body = b"\x00\x00\x00\x00\x00" + bsonlite.encode(reply)
+                conn.sendall(struct.pack(
+                    "<iiii", 16 + len(body), 0, rid, OP_MSG) + body)
+        finally:
+            conn.close()
+
+    def start(self) -> None:
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", self.port))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+
+        def accept_loop():
+            while not self._stop.is_set():
+                try:
+                    conn, _ = self._srv.accept()
+                except OSError:
+                    return
+                threading.Thread(target=self._client_loop, args=(conn,),
+                                 daemon=True).start()
+
+        threading.Thread(target=accept_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._srv is not None:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+            self._srv = None

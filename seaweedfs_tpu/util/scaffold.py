@@ -122,6 +122,29 @@ db = 0
 enabled = false
 servers = "127.0.0.1:2379"
 
+[elastic7]
+# Elasticsearch 7 (framework-native REST client, no ES library).
+enabled = false
+servers = "http://127.0.0.1:9200"
+username = ""
+password = ""
+
+[mongodb]
+# MongoDB 3.6+ (framework-native OP_MSG wire client, no pymongo).
+enabled = false
+host = "127.0.0.1"
+port = 27017
+database = "seaweedfs"
+
+[cassandra]
+# Cassandra (framework-native CQL v4 client, no driver library).
+# Expects: CREATE TABLE seaweedfs.filemeta (directory blob, name blob,
+#   meta blob, PRIMARY KEY (directory, name));
+enabled = false
+host = "127.0.0.1"
+port = 9042
+keyspace = "seaweedfs"
+
 [mysql]
 # Needs the pymysql (or mysqlclient) driver installed.
 enabled = false

@@ -73,15 +73,35 @@ def test_minus_chunks():
 
 
 @pytest.fixture(params=["memory", "sqlite", "leveldb", "leveldb2",
-                        "leveldb3", "redis", "abstract_sql", "etcd"])
+                        "leveldb3", "redis", "abstract_sql", "etcd",
+                        "elastic7", "mongodb", "cassandra"])
 def store(request, tmp_path):
     fake = None
-    if request.param == "etcd":
+    if request.param == "cassandra":
+        from seaweedfs_tpu.util.cql import FakeCassandraServer
+
+        fake = FakeCassandraServer()
+        fake.start()
+        s = make_store("cassandra", host="127.0.0.1", port=fake.port)
+    elif request.param == "mongodb":
+        from seaweedfs_tpu.util.mongo import FakeMongoServer
+
+        fake = FakeMongoServer()
+        fake.start()
+        s = make_store("mongodb", host="127.0.0.1", port=fake.port)
+    elif request.param == "etcd":
         from seaweedfs_tpu.util.etcd import FakeEtcdServer
 
         fake = FakeEtcdServer()
         fake.start()
         s = make_store("etcd", servers=f"127.0.0.1:{fake.port}")
+    elif request.param == "elastic7":
+        from seaweedfs_tpu.util.fake_elastic import FakeElasticServer
+
+        fake = FakeElasticServer()
+        fake.start()
+        s = make_store("elastic7",
+                       servers=f"http://127.0.0.1:{fake.port}")
     elif request.param == "sqlite":
         s = make_store("sqlite", path=str(tmp_path / "filer.db"))
     elif request.param == "leveldb":
