@@ -9,10 +9,15 @@ INSERT/SELECT/DELETE by (directory, name), listings by
 directory (the reference keeps a second table; one partition is
 equivalent under this store's model).
 
-Like the reference, DeleteFolderChildren drops one PARTITION
-(``DELETE ... WHERE directory = ?``); subtree semantics come from the
-caller issuing it per descendant directory — matching the Filer's
-_delete_tree walk, which visits every subdirectory.
+DeleteFolderChildren must remove the WHOLE subtree (the Filer calls it
+once per delete, after its chunk-collection walk): descendant
+partitions are discovered with ``SELECT DISTINCT directory`` — a
+token-range partition-key scan on a real cluster, arriving in bounded
+frames via result paging.  That scan is the cost of subtree deletes on
+a partition-per-directory schema without a secondary index; the
+reference's cassandra store simply leaves descendants orphaned
+(cassandra_store.go DeleteFolderChildren deletes one partition), which
+this framework's store contract does not allow.
 """
 
 from __future__ import annotations

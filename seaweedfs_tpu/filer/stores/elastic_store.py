@@ -109,10 +109,14 @@ class ElasticStore(FilerStore):
         # (the reference iterates-and-deletes; _delete_by_query is the
         # REST-native form of the same contract)
         prefix = directory.rstrip("/") + "/"
+        # dir.keyword: with ES dynamic mapping the bare `dir` field is
+        # analyzed text (tokenized on '/'), so un-analyzed term/prefix
+        # queries against it match NOTHING on a live cluster — only the
+        # .keyword sub-field compares whole values
         self._req("POST", f"/{INDEX_ENTRIES}/_delete_by_query", {
             "query": {"bool": {"should": [
-                {"term": {"dir": directory}},
-                {"prefix": {"dir": prefix}},
+                {"term": {"dir.keyword": directory}},
+                {"prefix": {"dir.keyword": prefix}},
             ]}},
         })
 
@@ -129,7 +133,7 @@ class ElasticStore(FilerStore):
         emitted = 0
         while emitted < limit:
             query: dict = {"bool": {
-                "must": [{"term": {"ParentId": parent}}]}}
+                "must": [{"term": {"ParentId.keyword": parent}}]}}
             if cursor:
                 query["bool"]["filter"] = [
                     {"range": {"name.keyword": {op: cursor}}}]

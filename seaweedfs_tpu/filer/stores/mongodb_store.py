@@ -77,15 +77,27 @@ class MongodbStore(FilerStore):
         prefix: str = "",
         limit: int = 1024,
     ) -> Iterator[filer_pb2.Entry]:
-        flt: dict = {"directory": directory}
+        # push BOTH bounds to the server: the name conditions combine the
+        # start cursor with a [prefix, prefix-end) range, and the limit
+        # rides the find command — no whole-directory transfers
+        conds: dict = {}
+        if prefix:
+            conds["$gte"] = prefix
+            conds["$lt"] = prefix[:-1] + chr(ord(prefix[-1]) + 1)
         if start_from:
-            flt["name"] = {"$gte" if inclusive else "$gt": start_from}
+            if inclusive:
+                conds["$gte"] = max(conds.get("$gte", ""), start_from)
+            else:
+                conds["$gt"] = start_from
+        flt: dict = {"directory": directory}
+        if conds:
+            flt["name"] = conds
         emitted = 0
         rows = self._client.find(COLLECTION, flt, sort={"name": 1},
-                                 limit=0)
+                                 limit=limit)
         for row in rows:
             if prefix and not row["name"].startswith(prefix):
-                continue
+                continue  # belt: e.g. multi-byte prefix-end edge
             if emitted >= limit:
                 return
             emitted += 1

@@ -56,13 +56,9 @@ def _bytes_value(v: bytes | None) -> bytes:
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
-    out = b""
-    while len(out) < n:
-        chunk = sock.recv(n - len(out))
-        if not chunk:
-            raise ConnectionError("cql connection closed")
-        out += chunk
-    return out
+    from .netio import read_exact
+
+    return read_exact(sock, n, "cql")
 
 
 def _frame(opcode: int, body: bytes, stream: int = 0,
@@ -101,48 +97,71 @@ class CqlClient:
             self._sock = s
         return self._sock
 
+    PAGE_SIZE = 5000  # result paging keeps any single frame bounded
+
     def query(self, cql: str,
               values: list[bytes | None] | None = None) -> list[list[bytes | None]]:
         """Execute one statement with blob-typed bound values; returns
-        rows of cell blobs (RESULT Rows) or [] (Void)."""
-        body = _long_string(cql)
-        body += struct.pack(">H", _CONSISTENCY_LOCAL_QUORUM)
-        if values:
-            body += struct.pack(">BH", _FLAG_VALUES, len(values))
-            for v in values:
-                body += _bytes_value(v)
-        else:
-            body += struct.pack(">B", 0)
-        with self._lock:
-            try:
-                sock = self._conn()
-                sock.sendall(_frame(OP_QUERY, body))
-                _stream, opcode, payload = _read_frame(sock)
-            except (OSError, ConnectionError):
-                self.close()
-                sock = self._conn()
-                sock.sendall(_frame(OP_QUERY, body))
-                _stream, opcode, payload = _read_frame(sock)
-        if opcode == OP_ERROR:
-            code = struct.unpack_from(">i", payload, 0)[0]
-            n = struct.unpack_from(">H", payload, 4)[0]
-            msg = payload[6:6 + n].decode()
-            raise IOError(f"cql error 0x{code:04x}: {msg}")
-        if opcode != OP_RESULT:
-            raise IOError(f"unexpected cql opcode {opcode}")
-        kind = struct.unpack_from(">i", payload, 0)[0]
-        if kind != RESULT_ROWS:
-            return []
-        return self._parse_rows(payload)
+        rows of cell blobs (RESULT Rows) or [] (Void).  Follows result
+        paging (has_more_pages + paging_state) so cluster-wide scans
+        arrive in bounded frames."""
+        rows: list[list[bytes | None]] = []
+        paging_state: bytes | None = None
+        while True:
+            flags = 0x04  # page_size always present
+            tail = struct.pack(">i", self.PAGE_SIZE)
+            if values:
+                flags |= _FLAG_VALUES
+            if paging_state is not None:
+                flags |= 0x08
+                tail += _bytes_value(paging_state)
+            body = _long_string(cql)
+            body += struct.pack(">H", _CONSISTENCY_LOCAL_QUORUM)
+            body += struct.pack(">B", flags)
+            if values:
+                body += struct.pack(">H", len(values))
+                for v in values:
+                    body += _bytes_value(v)
+            body += tail
+            with self._lock:
+                try:
+                    sock = self._conn()
+                    sock.sendall(_frame(OP_QUERY, body))
+                    _stream, opcode, payload = _read_frame(sock)
+                except (OSError, ConnectionError):
+                    self.close()
+                    sock = self._conn()
+                    sock.sendall(_frame(OP_QUERY, body))
+                    _stream, opcode, payload = _read_frame(sock)
+            if opcode == OP_ERROR:
+                code = struct.unpack_from(">i", payload, 0)[0]
+                n = struct.unpack_from(">H", payload, 4)[0]
+                msg = payload[6:6 + n].decode()
+                raise IOError(f"cql error 0x{code:04x}: {msg}")
+            if opcode != OP_RESULT:
+                raise IOError(f"unexpected cql opcode {opcode}")
+            kind = struct.unpack_from(">i", payload, 0)[0]
+            if kind != RESULT_ROWS:
+                return rows
+            page, paging_state = self._parse_rows(payload)
+            rows.extend(page)
+            if paging_state is None:
+                return rows
 
     @staticmethod
-    def _parse_rows(payload: bytes) -> list[list[bytes | None]]:
+    def _parse_rows(
+        payload: bytes,
+    ) -> tuple[list[list[bytes | None]], bytes | None]:
         at = 4
         flags, col_count = struct.unpack_from(">ii", payload, at)
         at += 8
+        paging_state = None
         if flags & 0x0002:  # has_more_pages: paging state
             n = struct.unpack_from(">i", payload, at)[0]
-            at += 4 + max(n, 0)
+            at += 4
+            if n > 0:
+                paging_state = payload[at:at + n]
+                at += n
         if not flags & 0x0001:  # no global_tables_spec
             pass
         else:
@@ -174,7 +193,7 @@ class CqlClient:
                     row.append(payload[at:at + n])
                     at += n
             rows.append(row)
-        return rows
+        return rows, paging_state
 
     def close(self) -> None:
         if self._sock is not None:

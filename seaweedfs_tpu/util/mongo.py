@@ -27,13 +27,9 @@ def _frame(request_id: int, doc: dict) -> bytes:
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
-    out = b""
-    while len(out) < n:
-        chunk = sock.recv(n - len(out))
-        if not chunk:
-            raise ConnectionError("mongo connection closed")
-        out += chunk
-    return out
+    from .netio import read_exact
+
+    return read_exact(sock, n, "mongo")
 
 
 def _read_msg(sock: socket.socket) -> dict:
@@ -84,13 +80,18 @@ class MongoClient:
         return resp
 
     def find(self, collection: str, flt: dict, sort: dict | None = None,
-             limit: int = 0) -> list[dict]:
+             limit: int = 101) -> list[dict]:
+        """Bounded find: singleBatch with batchSize == limit, so a real
+        mongod returns everything the caller asked for in one reply.
+        Callers must always bound their queries (unbounded iteration
+        would need getMore cursor paging, which nothing here requires)."""
+        if limit <= 0:
+            raise ValueError("find() requires a positive limit")
         cmd: dict = {"find": collection, "filter": flt,
-                     "singleBatch": True, "batchSize": max(limit, 101)}
+                     "singleBatch": True, "batchSize": limit,
+                     "limit": limit}
         if sort:
             cmd["sort"] = sort
-        if limit:
-            cmd["limit"] = limit
         resp = self.command(cmd)
         return resp.get("cursor", {}).get("firstBatch", [])
 

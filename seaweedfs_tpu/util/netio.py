@@ -1,0 +1,16 @@
+"""Shared socket helpers for the framework-native wire clients."""
+
+from __future__ import annotations
+
+import socket
+
+
+def read_exact(sock: socket.socket, n: int, what: str = "peer") -> bytes:
+    """Read exactly n bytes or raise ConnectionError on EOF."""
+    out = b""
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError(f"{what} connection closed")
+        out += chunk
+    return out

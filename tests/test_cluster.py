@@ -467,6 +467,20 @@ def test_master_admin_http_endpoints(cluster):
     code, got = _http("GET", f"http://{out['fileUrl']}")
     assert code == 200 and got == payload
 
+    # oversized /submit bodies bounce with 413 before being read into
+    # memory (the master never handles object payloads elsewhere)
+    req = urllib.request.Request(
+        f"{base}/submit", data=b"x", method="POST",
+        headers={"Content-Length":
+                 str(master.topo.volume_size_limit + 1)})
+    try:
+        urllib.request.urlopen(req, timeout=15)
+        raise AssertionError("oversized submit accepted")
+    except urllib.error.HTTPError as e:
+        assert e.code == 413
+    except urllib.error.URLError:
+        pass  # connection closed mid-send is also acceptable
+
     # status + grow + col delete (wait out the heartbeat delta lag)
     deadline = time.time() + 15
     vols = {}

@@ -134,3 +134,61 @@ def test_ftp_large_transfer_spools(ftp_cluster):
     c.cwd("/")
     c.rmd("/ftp-big")
     c.quit()
+
+
+def test_ftp_pasv_hijack_rejected(ftp_cluster):
+    """A stranger racing to the advertised PASV port must not receive the
+    data (classic PASV hijack): only the control-connection peer's IP may
+    claim the data socket.  The hijacker connects from 127.0.0.2 while
+    the control session runs on 127.0.0.1."""
+    import socket
+
+    c = _client(ftp_cluster)
+    c.storbinary("STOR hijack.bin", io.BytesIO(b"secret-payload"))
+
+    # open a passive data port, then race a foreign-IP connection to it
+    c.putcmd("PASV")
+    resp = c.getresp()
+    assert resp.startswith("227")
+    nums = resp[resp.index("(") + 1:resp.index(")")].split(",")
+    data_port = int(nums[4]) * 256 + int(nums[5])
+
+    hijacker = socket.socket()
+    try:
+        hijacker.bind(("127.0.0.2", 0))  # different loopback source IP
+        hijacker.connect(("127.0.0.1", data_port))
+    except OSError:
+        hijacker = None  # host without 127/8 loopback range: skip race
+    c.putcmd("RETR hijack.bin")
+
+    if hijacker is not None:
+        # the server must close the foreign connection without payload
+        hijacker.settimeout(10)
+        leaked = b""
+        try:
+            while True:
+                chunk = hijacker.recv(4096)
+                if not chunk:
+                    break
+                leaked += chunk
+        except OSError:
+            pass
+        assert leaked == b"", "PASV hijacker received data"
+        hijacker.close()
+
+    # the legitimate client still completes the transfer on its own
+    # connection from 127.0.0.1
+    legit = socket.create_connection(("127.0.0.1", data_port), timeout=10)
+    resp = c.getresp()
+    assert resp.startswith("150")
+    got = b""
+    while True:
+        chunk = legit.recv(4096)
+        if not chunk:
+            break
+        got += chunk
+    legit.close()
+    assert got == b"secret-payload"
+    assert c.getresp().startswith("226")
+    c.delete("hijack.bin")
+    c.quit()
