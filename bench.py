@@ -76,13 +76,17 @@ def _tpu_pallas_rate(tile: int = 256) -> dict:
     # sweeps over g blocks — later stages amortise upload over more compute
     stages = [(4, 8), (16, 32), (64, 16), (256, 8)]
     put_rate = None  # bytes/s observed for device_put, drives stage gating
+    compile_dt = 20.0  # refined from each stage's observed compile time
     for mb, k in stages:
         if mb > max_mb and mb != stages[0][0]:
             continue
         g = (mb << 20) // (tile * LANES * 4)
         upload_bytes = 10 * (g + k) * tile * LANES * 4
         remaining = budget - (time.perf_counter() - t_start)
-        if put_rate and upload_bytes / put_rate * 1.3 + 20 > remaining:
+        # each stage is a fresh XLA/Mosaic program (the grid changes), so
+        # the projection budgets a compile alongside the upload
+        if put_rate and (upload_bytes / put_rate * 1.3
+                         + compile_dt * 1.5 + 10 > remaining):
             emit(skipped_stage_mb=mb, skip_reason="projected over budget")
             break
         rng = np.random.default_rng(0)
@@ -117,7 +121,8 @@ def _tpu_pallas_rate(tile: int = 256) -> dict:
         t0 = time.perf_counter()
         out = fn(buf)
         np.asarray(out[0, 0, :2])  # compile + warm
-        emit(compile_seconds=round(time.perf_counter() - t0, 2))
+        compile_dt = time.perf_counter() - t0
+        emit(compile_seconds=round(compile_dt, 2))
         bytes_encoded = 10 * g * tile * LANES * 4 * k
         for rep in range(3):
             t0 = time.perf_counter()

@@ -87,16 +87,19 @@ class CassandraStore(FilerStore):
         prefix: str = "",
         limit: int = 1024,
     ) -> Iterator[filer_pb2.Entry]:
+        # page-bounded unless a prefix filter may drop rows client-side
+        max_rows = None if prefix else limit
         if start_from:
             op = ">=" if inclusive else ">"
             rows = self._client.query(
                 "SELECT name, meta FROM filemeta WHERE directory = ? "
                 f"AND name {op} ?",
-                [directory.encode(), start_from.encode()])
+                [directory.encode(), start_from.encode()],
+                max_rows=max_rows)
         else:
             rows = self._client.query(
                 "SELECT name, meta FROM filemeta WHERE directory = ?",
-                [directory.encode()])
+                [directory.encode()], max_rows=max_rows)
         emitted = 0
         for name_b, meta in rows:
             name = (name_b or b"").decode()

@@ -83,7 +83,12 @@ class MongodbStore(FilerStore):
         conds: dict = {}
         if prefix:
             conds["$gte"] = prefix
-            conds["$lt"] = prefix[:-1] + chr(ord(prefix[-1]) + 1)
+            try:
+                end = prefix[:-1] + chr(ord(prefix[-1]) + 1)
+                end.encode()  # reject lone surrogates before BSON does
+                conds["$lt"] = end
+            except (ValueError, UnicodeEncodeError):
+                pass  # boundary codepoint: $gte + startswith belt suffice
         if start_from:
             if inclusive:
                 conds["$gte"] = max(conds.get("$gte", ""), start_from)

@@ -660,7 +660,11 @@ class S3Handler(BaseHTTPRequestHandler):
     # -- objects -------------------------------------------------------------
 
     def _save_meta(self, directory: str, name: str, etag: str,
-                   extra: dict[str, str] | None = None):
+                   extra: dict[str, str] | None = None,
+                   request_meta: bool = True):
+        """`request_meta=False` skips harvesting x-amz-meta-* request
+        headers — a COPY-directive copy takes metadata from the SOURCE
+        only (AWS ignores request metadata unless REPLACE)."""
         client = self.s3.client
         entry = client.find_entry(directory, name)
         if entry is None:
@@ -669,9 +673,10 @@ class S3Handler(BaseHTTPRequestHandler):
             raise S3Error(500, "InternalError",
                           f"{directory}/{name} vanished after write")
         entry.extended[ETAG_KEY] = etag.encode()
-        for hk, hv in self.headers.items():
-            if hk.lower().startswith("x-amz-meta-"):
-                entry.extended[META_PREFIX + hk[len("x-amz-meta-"):].lower()] = hv.encode()
+        if request_meta:
+            for hk, hv in self.headers.items():
+                if hk.lower().startswith("x-amz-meta-"):
+                    entry.extended[META_PREFIX + hk[len("x-amz-meta-"):].lower()] = hv.encode()
         for k, v in (extra or {}).items():
             entry.extended[k] = v.encode()
         client.update_entry(directory, entry)
@@ -679,6 +684,25 @@ class S3Handler(BaseHTTPRequestHandler):
     def put_object(self, bucket: str, key: str):
         self._authz(ACTION_WRITE, bucket)
         self._require_bucket(bucket)
+        if key.endswith("/"):
+            # directory-marker object: the reference mkdirs instead of
+            # storing a needle (filer_server_handlers_write.go mkdir
+            # branch).  The ETag is the REAL body md5 so client-side
+            # integrity checks hold, and a non-empty body rides the
+            # directory entry's inline content (served back by GET/HEAD
+            # of the marker key)
+            body = self._read_body()
+            path = self.s3.object_path(bucket, key.rstrip("/"))
+            directory, name = path.rsplit("/", 1)
+            entry = self.s3.client.find_entry(directory, name)
+            if entry is None:
+                self.s3.client.mkdir(directory, name)
+                entry = self.s3.client.find_entry(directory, name)
+            if entry is not None and body:
+                entry.content = body
+                self.s3.client.update_entry(directory, entry)
+            etag = hashlib.md5(body).hexdigest()
+            return self._send(200, extra={"ETag": f'"{etag}"'})
         path = self.s3.object_path(bucket, key)
         etag = self._put_body_to(path, self.headers.get("Content-Type", ""))
         directory, name = path.rsplit("/", 1)
@@ -716,6 +740,15 @@ class S3Handler(BaseHTTPRequestHandler):
         return reader.md5.hexdigest()
 
     def _find_object(self, bucket: str, key: str) -> filer_pb2.Entry:
+        if key.endswith("/"):
+            # directory-marker key: resolves to the directory entry
+            path = self.s3.object_path(bucket, key.rstrip("/"))
+            directory, name = path.rsplit("/", 1)
+            entry = self.s3.client.find_entry(directory, name)
+            if entry is None or not entry.is_directory:
+                raise S3Error(NO_SUCH_KEY[2], NO_SUCH_KEY[0],
+                              NO_SUCH_KEY[1])
+            return entry
         path = self.s3.object_path(bucket, key)
         directory, name = path.rsplit("/", 1)
         entry = self.s3.client.find_entry(directory, name)
@@ -761,6 +794,17 @@ class S3Handler(BaseHTTPRequestHandler):
             self.send_header("ETag", f'"{_entry_etag(entry)}"')
             self.end_headers()
             return
+        if entry.is_directory:
+            # directory-marker key: serve the (usually empty) inline body
+            body = bytes(entry.content)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in self._object_headers(entry).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+            return
         try:
             resp = self.s3.client.open_object(
                 self.s3.object_path(bucket, key),
@@ -797,7 +841,9 @@ class S3Handler(BaseHTTPRequestHandler):
             self.end_headers()
             return
         extra = self._object_headers(entry)
-        extra["Content-Length"] = str(_entry_size(entry))
+        extra["Content-Length"] = str(
+            len(entry.content) if entry.is_directory
+            else _entry_size(entry))
         self.send_response(200)
         self.send_header("Content-Type",
                          entry.attributes.mime or "application/octet-stream")
@@ -807,6 +853,17 @@ class S3Handler(BaseHTTPRequestHandler):
 
     def delete_object(self, bucket: str, key: str):
         self._authz(ACTION_WRITE, bucket)
+        if key.endswith("/"):
+            # marker delete: drop the directory when it has no children
+            # (children keep the prefix alive on AWS too — there it
+            # exists purely through them)
+            path = self.s3.object_path(bucket, key.rstrip("/"))
+            directory, name = path.rsplit("/", 1)
+            if not list(self.s3.client.list_entries(path, limit=1)):
+                self.s3.client.delete_entry(
+                    directory, name, is_delete_data=True,
+                    is_recursive=True)
+            return self._send(204)
         path = self.s3.object_path(bucket, key)
         directory, name = path.rsplit("/", 1)
         self.s3.client.delete_entry(directory, name, is_delete_data=True,
@@ -855,7 +912,37 @@ class S3Handler(BaseHTTPRequestHandler):
         src = urllib.parse.unquote(self.headers["x-amz-copy-source"])
         src_bucket, _, src_key = src.lstrip("/").partition("/")
         self._authz(ACTION_READ, src_bucket)
+        directive = (self.headers.get("x-amz-metadata-directive")
+                     or "COPY").upper()
+        if (src_bucket, src_key) == (bucket, key) and directive != "REPLACE":
+            # AWS: copying onto itself is only valid as the canonical
+            # metadata-rewrite (s3tests test_object_copy_to_itself)
+            raise S3Error(
+                400, "InvalidRequest",
+                "This copy request is illegal because it is copying an "
+                "object to itself without changing the object's "
+                "metadata.")
         src_entry = self._find_object(src_bucket, src_key)
+        if (src_bucket, src_key) == (bucket, key):
+            # REPLACE onto itself = the canonical metadata rewrite: no
+            # data movement, just swap the user-metadata keys in place
+            directory, name = self.s3.object_path(
+                bucket, key).rsplit("/", 1)
+            for k in [k for k in src_entry.extended
+                      if k.startswith(META_PREFIX)]:
+                del src_entry.extended[k]
+            for hk, hv in self.headers.items():
+                if hk.lower().startswith("x-amz-meta-"):
+                    src_entry.extended[
+                        META_PREFIX + hk[len("x-amz-meta-"):].lower()
+                    ] = hv.encode()
+            src_entry.attributes.mtime = int(time.time())
+            self.s3.client.update_entry(directory, src_entry)
+            etag = _entry_etag(src_entry)
+            root = ET.Element("CopyObjectResult", xmlns=XMLNS)
+            _el(root, "ETag", f'"{etag}"')
+            _el(root, "LastModified", _iso(int(time.time())))
+            return self._send(200, _xml_bytes(root))
         dst = self.s3.object_path(bucket, key)
         try:
             resp = self.s3.client.open_object(
@@ -872,12 +959,18 @@ class S3Handler(BaseHTTPRequestHandler):
             )
         etag = reader.md5.hexdigest()
         directory, name = dst.rsplit("/", 1)
-        meta = {
-            k: v.decode()
-            for k, v in src_entry.extended.items()
-            if k.startswith(META_PREFIX)
-        }
-        self._save_meta(directory, name, etag, extra=meta)
+        if directive == "REPLACE":
+            # user metadata comes from THIS request's x-amz-meta headers
+            # (harvested by _save_meta), not the source entry
+            self._save_meta(directory, name, etag)
+        else:
+            meta = {
+                k: v.decode()
+                for k, v in src_entry.extended.items()
+                if k.startswith(META_PREFIX)
+            }
+            self._save_meta(directory, name, etag, extra=meta,
+                            request_meta=False)
         root = ET.Element("CopyObjectResult", xmlns=XMLNS)
         _el(root, "ETag", f'"{etag}"')
         _el(root, "LastModified", _iso(int(time.time())))

@@ -100,11 +100,14 @@ class CqlClient:
     PAGE_SIZE = 5000  # result paging keeps any single frame bounded
 
     def query(self, cql: str,
-              values: list[bytes | None] | None = None) -> list[list[bytes | None]]:
+              values: list[bytes | None] | None = None,
+              max_rows: int | None = None) -> list[list[bytes | None]]:
         """Execute one statement with blob-typed bound values; returns
         rows of cell blobs (RESULT Rows) or [] (Void).  Follows result
         paging (has_more_pages + paging_state) so cluster-wide scans
-        arrive in bounded frames."""
+        arrive in bounded frames; `max_rows` stops requesting pages once
+        the caller has enough — a bounded listing must not transfer a
+        million-row partition."""
         rows: list[list[bytes | None]] = []
         paging_state: bytes | None = None
         while True:
@@ -145,7 +148,8 @@ class CqlClient:
                 return rows
             page, paging_state = self._parse_rows(payload)
             rows.extend(page)
-            if paging_state is None:
+            if paging_state is None or (
+                    max_rows is not None and len(rows) >= max_rows):
                 return rows
 
     @staticmethod

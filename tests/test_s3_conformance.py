@@ -597,3 +597,131 @@ def test_object_acl_default(s3):
     code, _, body = _req("GET", f"{s3}/acl2-b/o?acl")
     assert code == 200
     assert b"FULL_CONTROL" in body
+
+
+# ---------------------------------------------------------------------------
+# Second tranche (r05): delimiter variants, unicode keys, copy directives
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_list_delimiter_alt(s3):
+    # s3tests test_bucket_list_delimiter_alt: delimiter 'a' groups on a
+    # non-slash character
+    _mk_bucket(s3, "dalt-b")
+    for k in ("bar", "baza", "cab", "foo"):
+        _put(s3, "dalt-b", k)
+    code, _, body = _req("GET", f"{s3}/dalt-b?delimiter=a")
+    assert code == 200
+    root = _xml(body)
+    assert _keys(root) == ["foo"]
+    prefixes = sorted(
+        _text(p, "Prefix") for p in _findall(root, "CommonPrefixes"))
+    assert prefixes == ["ba", "ca"]
+
+
+def test_bucket_list_delimiter_prefix_ends_with_delimiter(s3):
+    # s3tests test_bucket_list_delimiter_prefix_ends_with_delimiter
+    # adapted to weed semantics: a trailing-slash PUT creates a
+    # directory marker (filer_server_handlers_write.go mkdir branch),
+    # which surfaces as a CommonPrefix when listing its parent
+    _mk_bucket(s3, "dpe-b")
+    code, _, _ = _req("PUT", f"{s3}/dpe-b/asdf/", b"")
+    assert code == 200
+    code, _, body = _req("GET", f"{s3}/dpe-b?delimiter=/")
+    root = _xml(body)
+    assert [_text(p, "Prefix")
+            for p in _findall(root, "CommonPrefixes")] == ["asdf/"]
+    # objects under the marker list normally
+    _put(s3, "dpe-b", "asdf/child.txt", b"c")
+    code, _, body = _req(
+        "GET", f"{s3}/dpe-b?prefix=asdf/&delimiter=/")
+    assert _keys(_xml(body)) == ["asdf/child.txt"]
+
+
+def test_bucket_list_unicode_keys(s3):
+    # s3tests test_bucket_list_distinct + unicode coverage
+    _mk_bucket(s3, "uni-b")
+    keys = ["éclair.txt", "日本語/doc.md", "plain.txt"]
+    for k in keys:
+        code, _, _ = _req(
+            "PUT", f"{s3}/uni-b/{urllib.parse.quote(k)}", b"u")
+        assert code == 200
+    code, _, body = _req("GET", f"{s3}/uni-b")
+    got = _keys(_xml(body))
+    assert sorted(got) == sorted(
+        ["éclair.txt", "日本語/doc.md", "plain.txt"])
+    code, _, b = _req(
+        "GET", f"{s3}/uni-b/{urllib.parse.quote(keys[0])}")
+    assert (code, b) == (200, b"u")
+
+
+def test_object_copy_replace_metadata(s3):
+    # s3tests test_object_copy_canned_acl/metadata: REPLACE directive
+    # swaps user metadata; default COPY carries it over
+    _mk_bucket(s3, "cmd-b")
+    _put(s3, "cmd-b", "src", b"copy-meta",
+         {"x-amz-meta-orig": "one"})
+    code, _, _ = _req(
+        "PUT", f"{s3}/cmd-b/kept", b"",
+        {"x-amz-copy-source": "/cmd-b/src"})
+    assert code == 200
+    assert _req("HEAD", f"{s3}/cmd-b/kept")[1].get(
+        "x-amz-meta-orig") == "one"
+    code, _, _ = _req(
+        "PUT", f"{s3}/cmd-b/swapped", b"",
+        {"x-amz-copy-source": "/cmd-b/src",
+         "x-amz-metadata-directive": "REPLACE",
+         "x-amz-meta-fresh": "two"})
+    assert code == 200
+    h = _req("HEAD", f"{s3}/cmd-b/swapped")[1]
+    assert h.get("x-amz-meta-fresh") == "two"
+    assert h.get("x-amz-meta-orig") is None
+
+
+def test_object_copy_to_itself_without_replace(s3):
+    # s3tests test_object_copy_to_itself: same source+dest without
+    # REPLACE is invalid
+    _mk_bucket(s3, "self-b")
+    _put(s3, "self-b", "me", b"x")
+    code, _, body = _req(
+        "PUT", f"{s3}/self-b/me", b"",
+        {"x-amz-copy-source": "/self-b/me"})
+    assert code == 400
+    assert b"InvalidRequest" in body
+    # with REPLACE it is the canonical way to rewrite metadata in place
+    code, _, _ = _req(
+        "PUT", f"{s3}/self-b/me", b"",
+        {"x-amz-copy-source": "/self-b/me",
+         "x-amz-metadata-directive": "REPLACE",
+         "x-amz-meta-new": "v"})
+    assert code == 200
+    assert _req("HEAD", f"{s3}/self-b/me")[1].get("x-amz-meta-new") == "v"
+
+
+def test_directory_marker_lifecycle(s3):
+    # weed-adapted: marker PUT/HEAD/GET/DELETE round trip, with a
+    # non-empty marker body served back and a real md5 ETag
+    _mk_bucket(s3, "mk-b")
+    code, headers, _ = _req("PUT", f"{s3}/mk-b/folder/", b"")
+    assert code == 200
+    assert headers.get("ETag").strip('"') == hashlib.md5(b"").hexdigest()
+    code, headers, _ = _req("HEAD", f"{s3}/mk-b/folder/")
+    assert code == 200 and headers.get("Content-Length") == "0"
+    code, _, body = _req("GET", f"{s3}/mk-b/folder/")
+    assert (code, body) == (200, b"")
+    # non-empty marker body rides along and reads back
+    code, headers, _ = _req("PUT", f"{s3}/mk-b/notes/", b"marker-bytes")
+    assert headers.get("ETag").strip('"') == \
+        hashlib.md5(b"marker-bytes").hexdigest()
+    code, _, body = _req("GET", f"{s3}/mk-b/notes/")
+    assert (code, body) == (200, b"marker-bytes")
+    # DELETE removes an empty marker; children keep a prefix alive
+    code, _, _ = _req("DELETE", f"{s3}/mk-b/notes/")
+    assert code == 204
+    code, _, _ = _req("HEAD", f"{s3}/mk-b/notes/")
+    assert code == 404
+    _put(s3, "mk-b", "folder/kid.txt", b"k")
+    code, _, _ = _req("DELETE", f"{s3}/mk-b/folder/")
+    assert code == 204
+    code, _, body = _req("GET", f"{s3}/mk-b/folder/kid.txt")
+    assert (code, body) == (200, b"k")
