@@ -674,12 +674,19 @@ class S3Handler(BaseHTTPRequestHandler):
                           f"{directory}/{name} vanished after write")
         entry.extended[ETAG_KEY] = etag.encode()
         if request_meta:
-            for hk, hv in self.headers.items():
-                if hk.lower().startswith("x-amz-meta-"):
-                    entry.extended[META_PREFIX + hk[len("x-amz-meta-"):].lower()] = hv.encode()
+            self._harvest_request_meta(entry)
         for k, v in (extra or {}).items():
             entry.extended[k] = v.encode()
         client.update_entry(directory, entry)
+
+    def _harvest_request_meta(self, entry) -> None:
+        """Copy this request's x-amz-meta-* headers onto the entry under
+        the stored META_PREFIX convention (lower-cased suffixes)."""
+        for hk, hv in self.headers.items():
+            if hk.lower().startswith("x-amz-meta-"):
+                entry.extended[
+                    META_PREFIX + hk[len("x-amz-meta-"):].lower()
+                ] = hv.encode()
 
     def put_object(self, bucket: str, key: str):
         self._authz(ACTION_WRITE, bucket)
@@ -695,13 +702,24 @@ class S3Handler(BaseHTTPRequestHandler):
             path = self.s3.object_path(bucket, key.rstrip("/"))
             directory, name = path.rsplit("/", 1)
             entry = self.s3.client.find_entry(directory, name)
+            if entry is not None and not entry.is_directory:
+                # a FILE occupies the slashless name; the filer cannot
+                # hold a file and a directory under one name, so the
+                # marker write must fail loudly rather than pretend
+                raise S3Error(
+                    409, "InvalidRequest",
+                    "a regular object exists at this key's directory "
+                    "name; delete it before creating the folder marker")
             if entry is None:
                 self.s3.client.mkdir(directory, name)
                 entry = self.s3.client.find_entry(directory, name)
+            etag = hashlib.md5(body).hexdigest()
             if entry is not None and body:
                 entry.content = body
+                # persist the ETag: _entry_etag's chunk-list fallback
+                # would otherwise disagree with the value returned here
+                entry.extended[ETAG_KEY] = etag.encode()
                 self.s3.client.update_entry(directory, entry)
-            etag = hashlib.md5(body).hexdigest()
             return self._send(200, extra={"ETag": f'"{etag}"'})
         path = self.s3.object_path(bucket, key)
         etag = self._put_body_to(path, self.headers.get("Content-Type", ""))
@@ -854,12 +872,18 @@ class S3Handler(BaseHTTPRequestHandler):
     def delete_object(self, bucket: str, key: str):
         self._authz(ACTION_WRITE, bucket)
         if key.endswith("/"):
-            # marker delete: drop the directory when it has no children
-            # (children keep the prefix alive on AWS too — there it
-            # exists purely through them)
+            # marker delete: only a DIRECTORY entry is a marker — a plain
+            # file under the slashless name is a DIFFERENT key on AWS and
+            # must never be destroyed by a marker cleanup.  Drop the
+            # directory only when it has no children (children keep the
+            # prefix alive on AWS too — there it exists purely through
+            # them); anything else is a 204 no-op.
             path = self.s3.object_path(bucket, key.rstrip("/"))
             directory, name = path.rsplit("/", 1)
-            if not list(self.s3.client.list_entries(path, limit=1)):
+            entry = self.s3.client.find_entry(directory, name)
+            if (entry is not None and entry.is_directory
+                    and not list(self.s3.client.list_entries(
+                        path, limit=1))):
                 self.s3.client.delete_entry(
                     directory, name, is_delete_data=True,
                     is_recursive=True)
@@ -931,11 +955,7 @@ class S3Handler(BaseHTTPRequestHandler):
             for k in [k for k in src_entry.extended
                       if k.startswith(META_PREFIX)]:
                 del src_entry.extended[k]
-            for hk, hv in self.headers.items():
-                if hk.lower().startswith("x-amz-meta-"):
-                    src_entry.extended[
-                        META_PREFIX + hk[len("x-amz-meta-"):].lower()
-                    ] = hv.encode()
+            self._harvest_request_meta(src_entry)
             src_entry.attributes.mtime = int(time.time())
             self.s3.client.update_entry(directory, src_entry)
             etag = _entry_etag(src_entry)
@@ -995,9 +1015,7 @@ class S3Handler(BaseHTTPRequestHandler):
         entry.extended["Content-Type"] = (
             self.headers.get("Content-Type") or ""
         ).encode()
-        for hk, hv in self.headers.items():
-            if hk.lower().startswith("x-amz-meta-"):
-                entry.extended[META_PREFIX + hk[len("x-amz-meta-"):].lower()] = hv.encode()
+        self._harvest_request_meta(entry)
         client.create_entry(self._uploads_dir(bucket), entry)
         root = ET.Element("InitiateMultipartUploadResult", xmlns=XMLNS)
         _el(root, "Bucket", bucket)

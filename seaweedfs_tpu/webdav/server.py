@@ -1,10 +1,14 @@
-"""WebDAV (class 1) server backed by the filer.
+"""WebDAV (class 1+2) server backed by the filer.
 
 Reference: weed/server/webdav_server.go:45,53 — the reference adapts the
-filer to golang.org/x/net/webdav's FileSystem interface; here the DAV
-verbs (OPTIONS/PROPFIND/MKCOL/GET/PUT/DELETE/MOVE/COPY/HEAD) are served
-directly over the filer's gRPC metadata + HTTP data planes, which covers
-davfs2/cadaver/Finder-style clients.
+filer to golang.org/x/net/webdav's FileSystem interface (whose memLS
+provides class-2 locking); here the DAV verbs (OPTIONS/PROPFIND/
+PROPPATCH/MKCOL/GET/PUT/DELETE/MOVE/COPY/HEAD/LOCK/UNLOCK) are served
+directly over the filer's gRPC metadata + HTTP data planes, with an
+in-memory exclusive-write lock table (RFC 4918 §6-9: timeouts, depth-
+infinity ancestor coverage, lock-null resource creation, If-header
+token checks answering 423 otherwise) — which covers davfs2/cadaver/
+Finder AND the Windows/Office write clients that refuse class-1 shares.
 """
 
 from __future__ import annotations
@@ -36,6 +40,95 @@ class WebDavServer:
         self.port = port
         self.client = FilerClient(filer)
         self._httpd: ThreadingHTTPServer | None = None
+        # class-2 lock table: path -> {token, owner, expires, depth}
+        # (in-memory, like golang.org/x/net/webdav's memLS the reference
+        # serves its locks from)
+        self._locks: dict[str, dict] = {}
+        self._locks_guard = threading.Lock()
+
+    def acquire_lock(self, path: str, owner: str, timeout_s: float,
+                     depth_infinity: bool) -> dict | None:
+        """-> lock dict, or None when a live conflicting lock exists."""
+        import time as _time
+        import uuid
+
+        with self._locks_guard:
+            self._expire_locked()
+            conflict = self._covering_lock(path)
+            if conflict is not None:
+                return None
+            if depth_infinity:
+                # an exclusive subtree lock conflicts with any live lock
+                # below it (two "exclusive" locks must never overlap)
+                prefix = path.rstrip("/") + "/"
+                if any(p.startswith(prefix) for p in self._locks):
+                    return None
+            lock = {
+                "token": f"opaquelocktoken:{uuid.uuid4()}",
+                "owner": owner,
+                "expires": _time.monotonic() + timeout_s,
+                "timeout": timeout_s,
+                "depth": "infinity" if depth_infinity else "0",
+                "path": path,
+            }
+            self._locks[path] = lock
+            return lock
+
+    def refresh_lock(self, path: str, token: str,
+                     timeout_s: float) -> dict | None:
+        import time as _time
+
+        with self._locks_guard:
+            self._expire_locked()
+            lock = self._locks.get(path)
+            if lock is None or lock["token"] != token:
+                return None
+            lock["expires"] = _time.monotonic() + timeout_s
+            lock["timeout"] = timeout_s
+            return lock
+
+    def release_lock(self, path: str, token: str) -> bool:
+        with self._locks_guard:
+            lock = self._locks.get(path)
+            if lock is None or lock["token"] != token:
+                return False
+            del self._locks[path]
+            return True
+
+    def covering_lock(self, path: str) -> dict | None:
+        with self._locks_guard:
+            self._expire_locked()
+            return self._covering_lock(path)
+
+    def descendant_locks(self, path: str) -> list[dict]:
+        """Live locks held BELOW path — a directory delete/move (or a
+        depth-infinity lock) conflicts with them (RFC 4918 §6.1/7)."""
+        prefix = path.rstrip("/") + "/"
+        with self._locks_guard:
+            self._expire_locked()
+            return [lk for p, lk in self._locks.items()
+                    if p.startswith(prefix)]
+
+    def _covering_lock(self, path: str) -> dict | None:
+        lock = self._locks.get(path)
+        if lock is not None:
+            return lock
+        # depth-infinity locks on ancestors cover the subtree
+        at = path
+        while at not in ("", "/"):
+            at = at.rsplit("/", 1)[0] or "/"
+            lock = self._locks.get(at)
+            if lock is not None and lock["depth"] == "infinity":
+                return lock
+        return None
+
+    def _expire_locked(self) -> None:
+        import time as _time
+
+        now = _time.monotonic()
+        for p in [p for p, lk in self._locks.items()
+                  if lk["expires"] <= now]:
+            del self._locks[p]
 
     def start(self) -> None:
         handler = type("BoundDavHandler", (DavHandler,), {"dav": self})
@@ -100,9 +193,138 @@ class DavHandler(BaseHTTPRequestHandler):
     def do_OPTIONS(self):
         self._send(200, extra={
             "Allow": "OPTIONS, GET, HEAD, PUT, DELETE, PROPFIND, "
-                     "MKCOL, MOVE, COPY",
+                     "PROPPATCH, MKCOL, MOVE, COPY, LOCK, UNLOCK",
             "MS-Author-Via": "DAV",
         })
+
+    # -- class-2 locking (RFC 4918 §9.10/9.11) ----------------------------
+
+    def _may_modify(self, path: str, subtree: bool = False) -> bool:
+        """True when no live lock covers path, or the request's If /
+        Lock-Token headers carry the covering lock's token.  With
+        `subtree` (directory DELETE/MOVE), locks held on DESCENDANTS
+        block too — removing a tree must not destroy a locked child."""
+        presented = (self.headers.get("If", "") + " "
+                     + self.headers.get("Lock-Token", ""))
+        lock = self.dav.covering_lock(path)
+        if lock is not None and lock["token"] not in presented:
+            return False
+        if subtree:
+            for lk in self.dav.descendant_locks(path):
+                if lk["token"] not in presented:
+                    return False
+        return True
+
+    def _timeout_seconds(self) -> float:
+        hdr = self.headers.get("Timeout", "")
+        for part in hdr.split(","):
+            part = part.strip()
+            if part.lower().startswith("second-"):
+                try:
+                    return min(float(part[len("second-"):]), 3600.0)
+                except ValueError:
+                    pass
+        return 3600.0
+
+    def _lock_xml(self, lock: dict) -> bytes:
+        prop = ET.Element(f"{{{DAV_NS}}}prop")
+        disc = ET.SubElement(prop, f"{{{DAV_NS}}}lockdiscovery")
+        al = ET.SubElement(disc, f"{{{DAV_NS}}}activelock")
+        lt = ET.SubElement(al, f"{{{DAV_NS}}}locktype")
+        ET.SubElement(lt, f"{{{DAV_NS}}}write")
+        ls = ET.SubElement(al, f"{{{DAV_NS}}}lockscope")
+        ET.SubElement(ls, f"{{{DAV_NS}}}exclusive")
+        ET.SubElement(al, f"{{{DAV_NS}}}depth").text = lock["depth"]
+        if lock["owner"]:
+            ET.SubElement(al, f"{{{DAV_NS}}}owner").text = lock["owner"]
+        ET.SubElement(al, f"{{{DAV_NS}}}timeout").text = (
+            f"Second-{int(lock['timeout'])}")
+        tok = ET.SubElement(al, f"{{{DAV_NS}}}locktoken")
+        ET.SubElement(tok, f"{{{DAV_NS}}}href").text = lock["token"]
+        ET.register_namespace("D", DAV_NS)
+        return b'<?xml version="1.0" encoding="utf-8"?>' + \
+            ET.tostring(prop)
+
+    def do_LOCK(self):
+        path = self._path()
+        try:
+            body = self._read_body()
+        except ValueError as e:
+            return self._send(400, str(e).encode())
+        timeout_s = self._timeout_seconds()
+        if not body:
+            # refresh: the If header names the token being extended
+            presented = self.headers.get("If", "")
+            lock = self.dav.covering_lock(path)
+            if lock is None or lock["token"] not in presented:
+                return self._send(412)
+            lock = self.dav.refresh_lock(lock["path"], lock["token"],
+                                         timeout_s)
+            return self._send(200, self._lock_xml(lock),
+                              extra={"Lock-Token": f"<{lock['token']}>"})
+        owner = ""
+        try:
+            root = ET.fromstring(body)
+            o = root.find(f"{{{DAV_NS}}}owner")
+            if o is not None:
+                owner = "".join(o.itertext()).strip()
+        except ET.ParseError:
+            return self._send(400)
+        depth_inf = (self.headers.get("Depth", "infinity").lower()
+                     != "0")
+        lock = self.dav.acquire_lock(path, owner, timeout_s, depth_inf)
+        if lock is None:
+            return self._send(423)
+        created = False
+        if self._find(path) is None:
+            # RFC 4918: LOCK on an unmapped URL creates an empty
+            # resource (golang webdav's behavior the reference inherits)
+            self.dav.client.put_object(path, b"")
+            created = True
+        self._send(201 if created else 200, self._lock_xml(lock),
+                   extra={"Lock-Token": f"<{lock['token']}>"})
+
+    def do_UNLOCK(self):
+        path = self._path()
+        token = self.headers.get("Lock-Token", "").strip().strip("<>")
+        lock = self.dav.covering_lock(path)
+        if lock is None or lock["token"] != token:
+            return self._send(409)
+        self.dav.release_lock(lock["path"], token)
+        self._send(204)
+
+    def do_PROPPATCH(self):
+        path = self._path()
+        if not self._may_modify(path):
+            return self._send(423)
+        try:
+            body = self._read_body()
+        except ValueError as e:
+            return self._send(400, str(e).encode())
+        if self._find(path) is None:
+            return self._send(404)
+        # acknowledge every requested property (dead-prop storage is not
+        # modeled; clients mostly PROPPATCH timestamps after uploads)
+        props: list[str] = []
+        try:
+            root = ET.fromstring(body or b"<propertyupdate/>")
+            for prop in root.iter():
+                if prop.tag.endswith("}prop"):
+                    props.extend(c.tag for c in prop)
+        except ET.ParseError:
+            return self._send(400)
+        ms = ET.Element(f"{{{DAV_NS}}}multistatus")
+        resp = ET.SubElement(ms, f"{{{DAV_NS}}}response")
+        ET.SubElement(resp, f"{{{DAV_NS}}}href").text = path
+        stat = ET.SubElement(resp, f"{{{DAV_NS}}}propstat")
+        pr = ET.SubElement(stat, f"{{{DAV_NS}}}prop")
+        for tag in props:
+            ET.SubElement(pr, tag)
+        ET.SubElement(stat, f"{{{DAV_NS}}}status").text = \
+            "HTTP/1.1 200 OK"
+        ET.register_namespace("D", DAV_NS)
+        self._send(207, b'<?xml version="1.0" encoding="utf-8"?>'
+                   + ET.tostring(ms))
 
     def do_PROPFIND(self):
         try:
@@ -188,6 +410,8 @@ class DavHandler(BaseHTTPRequestHandler):
 
     def do_PUT(self):
         path = self._path()
+        if not self._may_modify(path):
+            return self._send(423)
         try:
             body = self._read_body()
         except ValueError as e:
@@ -201,6 +425,8 @@ class DavHandler(BaseHTTPRequestHandler):
 
     def do_MKCOL(self):
         path = self._path()
+        if not self._may_modify(path):
+            return self._send(423)
         if self._find(path) is not None:
             return self._send(405)
         directory, name = path.rsplit("/", 1)
@@ -212,6 +438,8 @@ class DavHandler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         path = self._path()
+        if not self._may_modify(path, subtree=True):
+            return self._send(423)
         entry = self._find(path)
         if entry is None:
             return self._send(404)
@@ -237,6 +465,9 @@ class DavHandler(BaseHTTPRequestHandler):
         dst = self._destination()
         if dst is None:
             return self._send(400)
+        if not (self._may_modify(src, subtree=True)
+                and self._may_modify(dst, subtree=True)):
+            return self._send(423)
         if self._find(src) is None:
             return self._send(404)
         overwrote = self._find(dst) is not None
@@ -262,6 +493,8 @@ class DavHandler(BaseHTTPRequestHandler):
         dst = self._destination()
         if dst is None:
             return self._send(400)
+        if not self._may_modify(dst):
+            return self._send(423)
         entry = self._find(src)
         if entry is None:
             return self._send(404)

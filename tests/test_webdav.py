@@ -119,3 +119,131 @@ def test_copy(dav):
     for p in ("/cpsrc.txt", "/cpdst.txt"):
         code, _, body = _dav(dav.port, "GET", p)
         assert code == 200 and body == b"copy-me"
+
+
+_LOCKINFO = (
+    b'<?xml version="1.0" encoding="utf-8"?>'
+    b'<D:lockinfo xmlns:D="DAV:">'
+    b'<D:lockscope><D:exclusive/></D:lockscope>'
+    b'<D:locktype><D:write/></D:locktype>'
+    b'<D:owner>tester</D:owner>'
+    b'</D:lockinfo>')
+
+
+def test_dav_class2_lock_cycle(dav):
+    """RFC 4918 class-2: LOCK/UNLOCK with token enforcement — the surface
+    Windows/Office write clients require (the reference gets it from
+    x/net/webdav's memLS)."""
+    port = dav.port
+    code, headers, _ = _dav(port, "OPTIONS", "/")
+    assert "2" in headers.get("DAV", "")
+    assert "LOCK" in headers.get("Allow", "")
+
+    _dav(port, "PUT", "/locked.txt", b"v1")
+    code, headers, body = _dav(port, "LOCK", "/locked.txt", _LOCKINFO,
+                               {"Timeout": "Second-600"})
+    assert code == 200
+    token = headers.get("Lock-Token", "").strip("<>")
+    assert token.startswith("opaquelocktoken:")
+    assert b"lockdiscovery" in body and token.encode() in body
+
+    # without the token, writes answer 423 Locked
+    code, _, _ = _dav(port, "PUT", "/locked.txt", b"intruder")
+    assert code == 423
+    code, _, _ = _dav(port, "DELETE", "/locked.txt")
+    assert code == 423
+    # with the token in If, the owner writes through
+    code, _, _ = _dav(port, "PUT", "/locked.txt", b"v2",
+                      {"If": f"(<{token}>)"})
+    assert code in (201, 204)
+    assert _dav(port, "GET", "/locked.txt")[2] == b"v2"
+
+    # refresh (bodyless LOCK with If), then unlock
+    code, _, _ = _dav(port, "LOCK", "/locked.txt", None,
+                      {"If": f"(<{token}>)", "Timeout": "Second-60"})
+    assert code == 200
+    code, _, _ = _dav(port, "UNLOCK", "/locked.txt", None,
+                      {"Lock-Token": f"<{token}>"})
+    assert code == 204
+    code, _, _ = _dav(port, "PUT", "/locked.txt", b"v3")
+    assert code in (201, 204)
+    _dav(port, "DELETE", "/locked.txt")
+
+
+def test_dav_lock_unmapped_and_depth(dav):
+    """LOCK on an unmapped URL creates an empty resource (201); a
+    depth-infinity lock on a collection covers its children."""
+    port = dav.port
+    code, headers, _ = _dav(port, "LOCK", "/ghost.bin", _LOCKINFO)
+    assert code == 201
+    token = headers.get("Lock-Token", "").strip("<>")
+    assert _dav(port, "GET", "/ghost.bin")[0] == 200
+    _dav(port, "UNLOCK", "/ghost.bin", None,
+         {"Lock-Token": f"<{token}>"})
+
+    _dav(port, "MKCOL", "/ldir")
+    _dav(port, "PUT", "/ldir/kid.txt", b"k")
+    code, headers, _ = _dav(port, "LOCK", "/ldir", _LOCKINFO,
+                            {"Depth": "infinity"})
+    assert code == 200
+    token = headers.get("Lock-Token", "").strip("<>")
+    code, _, _ = _dav(port, "PUT", "/ldir/kid.txt", b"blocked")
+    assert code == 423
+    code, _, _ = _dav(port, "PUT", "/ldir/kid.txt", b"ok",
+                      {"If": f"(<{token}>)"})
+    assert code in (201, 204)
+    # second exclusive lock on a covered child is refused
+    code, _, _ = _dav(port, "LOCK", "/ldir/kid.txt", _LOCKINFO)
+    assert code == 423
+    _dav(port, "UNLOCK", "/ldir", None, {"Lock-Token": f"<{token}>"})
+
+
+def test_dav_proppatch_acknowledged(dav):
+    port = dav.port
+    _dav(port, "PUT", "/pp.txt", b"x")
+    body = (b'<?xml version="1.0"?>'
+            b'<D:propertyupdate xmlns:D="DAV:" xmlns:Z="urn:x">'
+            b'<D:set><D:prop><Z:Win32LastModifiedTime>x'
+            b'</Z:Win32LastModifiedTime></D:prop></D:set>'
+            b'</D:propertyupdate>')
+    code, _, out = _dav(port, "PROPPATCH", "/pp.txt", body)
+    assert code == 207
+    assert b"200 OK" in out
+    _dav(port, "DELETE", "/pp.txt")
+
+
+def test_dav_child_lock_blocks_directory_ops(dav):
+    """A lock on a child blocks deleting/moving its ancestor directory,
+    COPY respects destination locks, and MKCOL inside an exclusively
+    locked collection is refused (RFC 4918 §6.1/7 overlap rules)."""
+    port = dav.port
+    _dav(port, "MKCOL", "/cl")
+    _dav(port, "PUT", "/cl/held.txt", b"h")
+    code, headers, _ = _dav(port, "LOCK", "/cl/held.txt", _LOCKINFO)
+    assert code == 200
+    token = headers.get("Lock-Token", "").strip("<>")
+    # deleting the parent would destroy the locked child -> 423
+    assert _dav(port, "DELETE", "/cl")[0] == 423
+    assert _dav(port, "MOVE", "/cl", None,
+                {"Destination": f"http://127.0.0.1:{port}/cl2"})[0] == 423
+    # COPY onto the locked resource without the token -> 423
+    _dav(port, "PUT", "/other.txt", b"o")
+    assert _dav(port, "COPY", "/other.txt", None,
+                {"Destination":
+                 f"http://127.0.0.1:{port}/cl/held.txt"})[0] == 423
+    # an exclusive subtree lock over a live child lock is refused
+    assert _dav(port, "LOCK", "/cl", _LOCKINFO,
+                {"Depth": "infinity"})[0] == 423
+    _dav(port, "UNLOCK", "/cl/held.txt", None,
+         {"Lock-Token": f"<{token}>"})
+    # with the child lock gone, the subtree lock works and gates MKCOL
+    code, headers, _ = _dav(port, "LOCK", "/cl", _LOCKINFO,
+                            {"Depth": "infinity"})
+    assert code == 200
+    token = headers.get("Lock-Token", "").strip("<>")
+    assert _dav(port, "MKCOL", "/cl/sub")[0] == 423
+    assert _dav(port, "MKCOL", "/cl/sub", None,
+                {"If": f"(<{token}>)"})[0] == 201
+    _dav(port, "UNLOCK", "/cl", None, {"Lock-Token": f"<{token}>"})
+    _dav(port, "DELETE", "/cl")
+    _dav(port, "DELETE", "/other.txt")
