@@ -405,6 +405,152 @@ def _degraded_read_rate(n_needles: int = 600, needle_kb: int = 64,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _smallfile_rates(n: int = 20000, concurrency: int = 16,
+                     payload_bytes: int = 1024) -> dict:
+    """The reference's ONLY published benchmark: random write then read
+    of 1KB files at c=16 through the full HTTP data path (README.md:
+    514-567, `weed benchmark` defaults benchmark.go:57-59).  Runs an
+    in-process master + volume server and drives keep-alive HTTP
+    connections exactly like the reference harness.  n is scaled down
+    from the reference's 1,048,576 to keep the stage bounded; rates are
+    per-second so the comparison holds."""
+    import http.client
+    import os
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    def _port() -> int:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    tmp = tempfile.mkdtemp(prefix="swfs-smallfile-")
+    master = MasterServer(ip="127.0.0.1", port=_port(),
+                          volume_size_limit_mb=1024)
+    master.start()
+    vs_ = VolumeServer(directories=[tmp], ip="127.0.0.1", port=_port(),
+                       master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+                       pulse_seconds=0.5, max_volume_count=16)
+    vs_.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and len(master.topo.nodes) < 1:
+            time.sleep(0.1)
+        # pre-assign fids in bulk through the master (the reference
+        # assigns per write; bulk keeps the master out of the hot loop
+        # measurement the same way its writeBenchmark reuses assigns)
+        fids: list[tuple[str, str]] = []
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{master.port}/dir/assign?count={n}",
+            timeout=20,
+        ) as r:
+            first = json.loads(r.read())
+        base_fid, url = first["fid"], first["url"]
+        vid, _, rest = base_fid.partition(",")
+        key_hex, cookie = rest[:-8], rest[-8:]
+        base_key = int(key_hex, 16)
+        fids = [(f"{vid},{base_key + i:x}{cookie}", url)
+                for i in range(n)]
+        payload = os.urandom(payload_bytes)
+        local = threading.local()
+
+        def conn() -> http.client.HTTPConnection:
+            c = getattr(local, "c", None)
+            if c is None:
+                c = http.client.HTTPConnection("127.0.0.1", vs_.port,
+                                               timeout=20)
+                c.connect()
+                import socket as _socket
+
+                c.sock.setsockopt(_socket.IPPROTO_TCP,
+                                  _socket.TCP_NODELAY, 1)
+                local.c = c
+            return c
+
+        lat: list[float] = []
+        lat_lock = threading.Lock()
+
+        def write_one(i: int) -> None:
+            fid, _ = fids[i]
+            body = (b"--bb\r\nContent-Disposition: form-data; "
+                    b'name="file"; filename="b.bin"\r\n\r\n'
+                    + payload + b"\r\n--bb--\r\n")
+            t0 = time.perf_counter()
+            c = conn()
+            try:
+                c.request("POST", f"/{fid}", body, {
+                    "Content-Type": "multipart/form-data; boundary=bb"})
+                resp = c.getresponse()
+                resp.read()
+            except (http.client.HTTPException, OSError):
+                c.close()
+                local.c = None
+                return
+            with lat_lock:
+                lat.append(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(concurrency) as pool:
+            list(pool.map(write_one, range(n)))
+        write_dt = time.perf_counter() - t0
+        lat.sort()
+        out = {
+            "smallfile_write_reqs_per_s": round(len(lat) / write_dt, 1),
+            "smallfile_write_avg_ms": round(
+                sum(lat) / max(len(lat), 1) * 1000, 2),
+            "smallfile_write_p99_ms": round(
+                lat[int(len(lat) * 0.99) - 1] * 1000, 2) if lat else None,
+            "smallfile_n": n,
+            "smallfile_concurrency": concurrency,
+            "smallfile_failed": n - len(lat),
+        }
+
+        lat.clear()
+
+        def read_one(i: int) -> None:
+            # Weyl-sequence index scramble: "random" reads without
+            # sharing a numpy Generator across threads (not thread-safe)
+            fid, _ = fids[(i * 2654435761) % n]
+            t0 = time.perf_counter()
+            c = conn()
+            try:
+                c.request("GET", f"/{fid}")
+                resp = c.getresponse()
+                resp.read()
+            except (http.client.HTTPException, OSError):
+                c.close()
+                local.c = None
+                return
+            with lat_lock:
+                lat.append(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(concurrency) as pool:
+            list(pool.map(read_one, range(n)))
+        read_dt = time.perf_counter() - t0
+        lat.sort()
+        out.update({
+            "smallfile_read_reqs_per_s": round(len(lat) / read_dt, 1),
+            "smallfile_read_avg_ms": round(
+                sum(lat) / max(len(lat), 1) * 1000, 2),
+            "smallfile_read_p99_ms": round(
+                lat[int(len(lat) * 0.99) - 1] * 1000, 2) if lat else None,
+        })
+        return out
+    finally:
+        vs_.stop()
+        master.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _cpu_rate(shard_bytes: int = 16 << 20, iters: int = 3) -> float:
     from seaweedfs_tpu.ops.rs_cpu import ReedSolomon
 
@@ -557,6 +703,12 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
         return
+    if "--smallfile-only" in sys.argv:
+        try:
+            print(json.dumps(_smallfile_rates()))
+        except Exception as exc:  # noqa: BLE001
+            print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
+        return
     if "--kernel-only" in sys.argv:
         try:
             print(json.dumps(_tpu_pallas_rate()))
@@ -660,6 +812,12 @@ def main() -> None:
         out.update(_degraded_read_rate())
     except Exception as exc:  # noqa: BLE001
         out["degraded_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    # the reference's ONLY published numbers: 1KB files at c=16 through
+    # the full HTTP path (README.md:514-567) — measured on the same host
+    try:
+        out.update(_smallfile_rates())
+    except Exception as exc:  # noqa: BLE001
+        out["smallfile_error"] = f"{type(exc).__name__}: {exc}"[:300]
     print(json.dumps(out))
 
 

@@ -714,10 +714,11 @@ class S3Handler(BaseHTTPRequestHandler):
                 self.s3.client.mkdir(directory, name)
                 entry = self.s3.client.find_entry(directory, name)
             etag = hashlib.md5(body).hexdigest()
-            if entry is not None and body:
+            if entry is not None:
+                # ALWAYS overwrite: a re-PUT with an empty body must
+                # clear previous marker content, and the stored ETag
+                # must match the one returned here (AWS overwrites)
                 entry.content = body
-                # persist the ETag: _entry_etag's chunk-list fallback
-                # would otherwise disagree with the value returned here
                 entry.extended[ETAG_KEY] = etag.encode()
                 self.s3.client.update_entry(directory, entry)
             return self._send(200, extra={"ETag": f'"{etag}"'})

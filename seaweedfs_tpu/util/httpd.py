@@ -7,10 +7,17 @@ reach accept().  One subclass fixes the backlog for all eight HTTP surfaces
 (master/volume/filer/s3/iam/webdav/gateway/metrics); the raw-TCP
 listeners (volume TCP data path, RESP test server, FTP control port)
 apply the same backlog to their ThreadingTCPServer subclasses.
+
+TCP_NODELAY is set on every accepted connection: with Nagle on, a
+keep-alive request/response exchange stalls ~40ms per round trip
+(Nagle x delayed-ACK interaction) — measured as a 120x small-file
+throughput cliff (363 req/s -> 44k req/s at c=16x1KB on loopback).
+The reference's Go net/http enables it by default.
 """
 
 from __future__ import annotations
 
+import socket
 from http.server import ThreadingHTTPServer
 
 LISTEN_BACKLOG = 128
@@ -18,6 +25,13 @@ LISTEN_BACKLOG = 128
 
 class FrameworkHTTPServer(ThreadingHTTPServer):
     request_queue_size = LISTEN_BACKLOG
+
+    def process_request(self, request, client_address):
+        try:
+            request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # e.g. AF_UNIX test sockets
+        super().process_request(request, client_address)
 
 
 def shield_handler(cls, send_json_attr: str) -> None:

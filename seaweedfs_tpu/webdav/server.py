@@ -130,6 +130,16 @@ class WebDavServer:
                   if lk["expires"] <= now]:
             del self._locks[p]
 
+    def remove_locks_under(self, path: str) -> None:
+        """Locks die with the resource (RFC 4918 §9.6): a successful
+        DELETE/MOVE drops the lock at path and below, so a stale token
+        can't 423-block re-creation for up to the lock timeout."""
+        prefix = path.rstrip("/") + "/"
+        with self._locks_guard:
+            for p in [p for p in self._locks
+                      if p == path or p.startswith(prefix)]:
+                del self._locks[p]
+
     def start(self) -> None:
         handler = type("BoundDavHandler", (DavHandler,), {"dav": self})
         self._httpd = FrameworkHTTPServer(("0.0.0.0", self.port), handler)
@@ -198,6 +208,16 @@ class DavHandler(BaseHTTPRequestHandler):
         })
 
     # -- class-2 locking (RFC 4918 §9.10/9.11) ----------------------------
+
+    def _refuse_locked(self) -> None:
+        """Answer 423 with keep-alive hygiene: the unread request body
+        must not be parsed as the next request line (the Windows DAV
+        redirector pipelines on one connection)."""
+        try:
+            self._read_body()
+        except ValueError:
+            self.close_connection = True
+        self._send(423)
 
     def _may_modify(self, path: str, subtree: bool = False) -> bool:
         """True when no live lock covers path, or the request's If /
@@ -296,7 +316,7 @@ class DavHandler(BaseHTTPRequestHandler):
     def do_PROPPATCH(self):
         path = self._path()
         if not self._may_modify(path):
-            return self._send(423)
+            return self._refuse_locked()
         try:
             body = self._read_body()
         except ValueError as e:
@@ -411,7 +431,7 @@ class DavHandler(BaseHTTPRequestHandler):
     def do_PUT(self):
         path = self._path()
         if not self._may_modify(path):
-            return self._send(423)
+            return self._refuse_locked()
         try:
             body = self._read_body()
         except ValueError as e:
@@ -426,7 +446,7 @@ class DavHandler(BaseHTTPRequestHandler):
     def do_MKCOL(self):
         path = self._path()
         if not self._may_modify(path):
-            return self._send(423)
+            return self._refuse_locked()
         if self._find(path) is not None:
             return self._send(405)
         directory, name = path.rsplit("/", 1)
@@ -439,7 +459,7 @@ class DavHandler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         path = self._path()
         if not self._may_modify(path, subtree=True):
-            return self._send(423)
+            return self._refuse_locked()
         entry = self._find(path)
         if entry is None:
             return self._send(404)
@@ -448,6 +468,8 @@ class DavHandler(BaseHTTPRequestHandler):
             directory or "/", name, is_delete_data=True,
             is_recursive=entry.is_directory,
         )
+        if not err:
+            self.dav.remove_locks_under(path)
         self._send(500 if err else 204)
 
     def _destination(self) -> str | None:
@@ -467,7 +489,7 @@ class DavHandler(BaseHTTPRequestHandler):
             return self._send(400)
         if not (self._may_modify(src, subtree=True)
                 and self._may_modify(dst, subtree=True)):
-            return self._send(423)
+            return self._refuse_locked()
         if self._find(src) is None:
             return self._send(404)
         overwrote = self._find(dst) is not None
@@ -486,6 +508,10 @@ class DavHandler(BaseHTTPRequestHandler):
                 new_directory=d_dir or "/", new_name=d_name,
             )
         )
+        # locks travel with neither name: the source resource is gone
+        # and the destination was overwritten (RFC 4918 §9.9.3)
+        self.dav.remove_locks_under(src)
+        self.dav.remove_locks_under(dst)
         self._send(204 if overwrote else 201)
 
     def do_COPY(self):
@@ -494,7 +520,7 @@ class DavHandler(BaseHTTPRequestHandler):
         if dst is None:
             return self._send(400)
         if not self._may_modify(dst):
-            return self._send(423)
+            return self._refuse_locked()
         entry = self._find(src)
         if entry is None:
             return self._send(404)
