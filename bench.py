@@ -425,12 +425,21 @@ def _smallfile_rates(n: int = 20000, concurrency: int = 16,
     from seaweedfs_tpu.master.server import MasterServer
     from seaweedfs_tpu.volume.server import VolumeServer
 
+    used_ports: set[int] = set()
+
     def _port() -> int:
+        # mirrors tests/helpers.free_port: servers derive grpc_port as
+        # port+10000, so anything above 55535 would overflow the port
+        # space, and the two calls must not collide with each other
         import socket
 
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            return s.getsockname()[1]
+        while True:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                p = s.getsockname()[1]
+            if p <= 55000 and p not in used_ports:
+                used_ports.add(p)
+                return p
 
     tmp = tempfile.mkdtemp(prefix="swfs-smallfile-")
     master = MasterServer(ip="127.0.0.1", port=_port(),
@@ -490,6 +499,8 @@ def _smallfile_rates(n: int = 20000, concurrency: int = 16,
                     "Content-Type": "multipart/form-data; boundary=bb"})
                 resp = c.getresponse()
                 resp.read()
+                if resp.status >= 300:
+                    return  # counted as failed, not timed as a success
             except (http.client.HTTPException, OSError):
                 c.close()
                 local.c = None
@@ -525,6 +536,8 @@ def _smallfile_rates(n: int = 20000, concurrency: int = 16,
                 c.request("GET", f"/{fid}")
                 resp = c.getresponse()
                 resp.read()
+                if resp.status >= 300:
+                    return
             except (http.client.HTTPException, OSError):
                 c.close()
                 local.c = None

@@ -552,21 +552,9 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         return self._json(200, {"collection": name, "deleted": True})
 
     def _drain_body(self, cap: int = 1 << 20) -> None:
-        """Read and discard an unneeded request body so the next request
-        on this keep-alive connection doesn't parse it as a request line;
-        bodies over `cap` close the connection instead."""
-        try:
-            length = int(self.headers.get("Content-Length") or 0)
-        except ValueError:
-            length = 0
-        if length > cap:
-            self.close_connection = True
-            return
-        while length > 0:
-            chunk = self.rfile.read(min(length, 1 << 16))
-            if not chunk:
-                break
-            length -= len(chunk)
+        from ..util.httpd import drain_request_body
+
+        drain_request_body(self, cap)
 
     def do_POST(self):
         u = urllib.parse.urlparse(self.path)

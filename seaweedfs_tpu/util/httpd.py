@@ -34,6 +34,30 @@ class FrameworkHTTPServer(ThreadingHTTPServer):
         super().process_request(request, client_address)
 
 
+def drain_request_body(handler, cap: int = 1 << 20) -> None:
+    """Discard an unneeded request body in bounded chunks so the next
+    request on a keep-alive connection doesn't parse leftover payload
+    bytes as a request line; bodies over `cap` (or chunked bodies) close
+    the connection instead of buffering gigabytes to throw away.  The
+    one early-reply body-hygiene helper for every handler class."""
+    te = (handler.headers.get("Transfer-Encoding") or "").lower()
+    if "chunked" in te:
+        handler.close_connection = True
+        return
+    try:
+        length = int(handler.headers.get("Content-Length") or 0)
+    except ValueError:
+        length = 0
+    if length > cap:
+        handler.close_connection = True
+        return
+    while length > 0:
+        chunk = handler.rfile.read(min(length, 1 << 16))
+        if not chunk:
+            break
+        length -= len(chunk)
+
+
 def shield_handler(cls, send_json_attr: str) -> None:
     """Wrap a BaseHTTPRequestHandler subclass's do_* verbs so an
     unhandled exception answers 500 (via the named send-json method)

@@ -212,11 +212,11 @@ class DavHandler(BaseHTTPRequestHandler):
     def _refuse_locked(self) -> None:
         """Answer 423 with keep-alive hygiene: the unread request body
         must not be parsed as the next request line (the Windows DAV
-        redirector pipelines on one connection)."""
-        try:
-            self._read_body()
-        except ValueError:
-            self.close_connection = True
+        redirector pipelines on one connection) — drained in bounded
+        chunks, never buffered."""
+        from ..util.httpd import drain_request_body
+
+        drain_request_body(self)
         self._send(423)
 
     def _may_modify(self, path: str, subtree: bool = False) -> bool:
@@ -444,9 +444,14 @@ class DavHandler(BaseHTTPRequestHandler):
         self._send(204 if existed else 201)
 
     def do_MKCOL(self):
+        from ..util.httpd import drain_request_body
+
         path = self._path()
+        # extended-MKCOL bodies must be drained on EVERY early reply,
+        # not just the 423 path, or the keep-alive stream desyncs
+        drain_request_body(self)
         if not self._may_modify(path):
-            return self._refuse_locked()
+            return self._send(423)
         if self._find(path) is not None:
             return self._send(405)
         directory, name = path.rsplit("/", 1)
