@@ -425,20 +425,22 @@ def _smallfile_rates(n: int = 20000, concurrency: int = 16,
     from seaweedfs_tpu.master.server import MasterServer
     from seaweedfs_tpu.volume.server import VolumeServer
 
-    used_ports: set[int] = set()
+    reserved_ports: set[int] = set()
 
     def _port() -> int:
         # mirrors tests/helpers.free_port: servers derive grpc_port as
         # port+10000, so anything above 55535 would overflow the port
-        # space, and the two calls must not collide with each other
+        # space, and BOTH the http port and its derived grpc sibling
+        # must stay clear of every previously reserved pair
         import socket
 
         while True:
             with socket.socket() as s:
                 s.bind(("127.0.0.1", 0))
                 p = s.getsockname()[1]
-            if p <= 55000 and p not in used_ports:
-                used_ports.add(p)
+            if (p <= 55000 and p not in reserved_ports
+                    and p + 10000 not in reserved_ports):
+                reserved_ports.update((p, p + 10000))
                 return p
 
     tmp = tempfile.mkdtemp(prefix="swfs-smallfile-")
@@ -556,6 +558,7 @@ def _smallfile_rates(n: int = 20000, concurrency: int = 16,
                 sum(lat) / max(len(lat), 1) * 1000, 2),
             "smallfile_read_p99_ms": round(
                 lat[int(len(lat) * 0.99) - 1] * 1000, 2) if lat else None,
+            "smallfile_read_failed": n - len(lat),
         })
         return out
     finally:

@@ -21,9 +21,14 @@ from helpers import free_port
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _spawn(args, cwd):
+def _env():
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _spawn(args, cwd):
+    env = _env()
     # subprocesses must not touch the (possibly wedged) device tunnel:
     # the volume server's -ec.codec default probes in a subprocess, but
     # cpu pins it outright
@@ -100,10 +105,7 @@ def test_cli_three_process_cluster(tmp_path):
             [sys.executable, "-m", "seaweedfs_tpu", "shell",
              "-m", f"127.0.0.1:{mport}", "-c", "volume.list"],
             cwd=str(tmp_path), capture_output=True, text=True,
-            timeout=30,
-            env={**os.environ,
-                 "PYTHONPATH": REPO + os.pathsep
-                 + os.environ.get("PYTHONPATH", "")},
+            timeout=30, env=_env(),
         )
         assert out.returncode == 0
         assert f"127.0.0.1:{vport}" in out.stdout

@@ -322,3 +322,53 @@ def test_replicated_write_storm(tmp_path_factory):
         for s in servers:
             s.stop()
         master.stop()
+
+
+def test_hardlink_counter_survives_concurrent_unlink_storm():
+    """N names hardlinked to one file unlink concurrently from many
+    threads: the locked counter RMW must reclaim the shared chunks
+    EXACTLY once, with no leak (counter never reaching 0) and no
+    double-free (reclaimed while links remain)."""
+    import threading
+
+    from seaweedfs_tpu.filer.filer import Filer
+    from seaweedfs_tpu.filer.filerstore import make_store
+    from seaweedfs_tpu.pb import filer_pb2
+
+    deleted: list[str] = []
+    lock = threading.Lock()
+
+    def collect(fids):
+        with lock:
+            deleted.extend(fids)
+
+    f = Filer(make_store("memory"), delete_chunks_fn=collect)
+    n_links = 24
+    hid = b"s" * 17
+    for i in range(n_links):
+        e = filer_pb2.Entry(name=f"l{i}", hard_link_id=hid,
+                            hard_link_counter=n_links)
+        e.chunks.append(filer_pb2.FileChunk(
+            file_id="9,shared", offset=0, size=10, mtime=1))
+        f.create_entry("/storm", e)
+
+    errs: list[Exception] = []
+
+    def unlink(i: int) -> None:
+        try:
+            f.delete_entry("/storm", f"l{i}")
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=unlink, args=(i,))
+               for i in range(n_links)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    f.drain_deletions()
+    assert not errs
+    # exactly one reclamation of the shared chunk — no leak, no double
+    assert deleted == ["9,shared"], deleted
+    assert f.store.kv_get(hid) is None  # meta dropped with the last link
+    f.close()
