@@ -331,14 +331,15 @@ def _degraded_read_rate(n_needles: int = 600, needle_kb: int = 64,
 
     Floor analysis (r05): this host exposes ONE vCPU, so c=16 cannot
     exceed a single core's throughput.  After the r05 optimisation pass
-    (single-row decode instead of all-lost reconstruct, mmap'd shard
-    reads replacing per-interval pread, .ecx key-column searchsorted
-    replacing the pread binary search, and void*-address ctypes
-    marshalling) the per-read CPU cost is ~130us — needle parse + 64KB
-    native CRC32C, the 10-way survivor gather, and one GF row decode —
-    which bounds this host at ~7-8k reads/s (r04: 5.0k).  The reference's
-    ~47k figure (README.md:545) is an UNdegraded 1KB-needle run on a
-    multi-core laptop; matching its shape needs cores, not algorithm.
+    (single-row decode instead of all-lost reconstruct, .ecx key-column
+    searchsorted replacing the pread binary search, and void*-address
+    ctypes marshalling) the per-read CPU cost is ~150us — needle parse +
+    64KB native CRC32C, the 10-way survivor pread gather, and one GF row
+    decode — bounding this host at ~6.5-8k reads/s (r04: 5.0k).  Shard
+    reads stay pread, NOT mmap: a truncating racer turns mapped reads
+    into process-killing SIGBUS (observed; see EcVolumeShard.read_at).
+    The reference's ~47k figure (README.md:545) is an UNdegraded
+    1KB-needle run on a multi-core laptop; its shape needs cores.
     """
     import os
     import shutil

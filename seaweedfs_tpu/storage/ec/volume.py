@@ -44,36 +44,18 @@ class EcVolumeShard:
     def __post_init__(self):
         self._f = open(self.path, "rb")
         self.size = os.path.getsize(self.path)
-        # read-only mmap: shard files never change size while mounted,
-        # and a degraded read fans in 10 sibling interval reads — slicing
-        # the map costs ~1us vs ~6us per pread syscall on this host.
-        # Falls back to pread when the map can't be made (empty file).
-        self._mm = None
-        if self.size > 0:
-            import mmap
-
-            try:
-                self._mm = mmap.mmap(self._f.fileno(), 0,
-                                     prot=mmap.PROT_READ)
-            except (OSError, ValueError):
-                self._mm = None
 
     def read_at(self, offset: int, length: int) -> bytes:
-        # positioned read discipline: concurrent degraded reads share this
-        # handle (reference: ReadAt pread, ec_shard.go:93); the mmap slice
-        # is the syscall-free equivalent
-        mm = self._mm
-        if mm is not None:
-            return mm[offset:offset + length]
+        # positioned read: concurrent degraded reads share this handle, so
+        # a seek+read pair would interleave (reference: ReadAt pread
+        # discipline, ec_shard.go:93).  Deliberately NOT an mmap: a shard
+        # file truncated by a racing re-copy turns a mapped read into
+        # SIGBUS and kills the whole volume server (observed in the r05
+        # suite); pread of a truncated/deleted-but-open file just short-
+        # reads, which callers already handle.
         return os.pread(self._f.fileno(), length, offset)
 
     def close(self) -> None:
-        if self._mm is not None:
-            try:
-                self._mm.close()
-            except BufferError:
-                pass  # a frombuffer view is still alive; freed at GC
-            self._mm = None
         self._f.close()
 
 
@@ -306,10 +288,14 @@ class EcVolume:
         return self.read_shard_interval(shard_id, off, iv.size)
 
     def read_shard_interval(self, shard_id: int, offset: int, length: int) -> bytes:
-        # 1. local shard
+        # 1. local shard; a short pread means a racing truncate/re-copy —
+        # fall through to remote/reconstruct instead of handing the
+        # caller a truncated buffer to choke on
         sh = self.shards.get(shard_id)
         if sh is not None:
-            return sh.read_at(offset, length)
+            buf = sh.read_at(offset, length)
+            if len(buf) == length:
+                return buf
         # 2. remote shard via injected fetcher
         if self.remote_fetch is not None:
             data = self.remote_fetch(shard_id, offset, length)
