@@ -568,18 +568,22 @@ def _smallfile_rates(n: int = 20000, concurrency: int = 16,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
-def _cpu_rate(shard_bytes: int = 16 << 20, iters: int = 3) -> float:
+def _cpu_rate(shard_bytes: int = 16 << 20, iters: int = 5) -> float:
+    """Best single-pass rate: this shared vCPU sees multi-second steal
+    spikes (observed swinging a mean-of-3 between 3.7 and 5.9 GB/s), so
+    the min-latency pass is the codec's actual capability."""
     from seaweedfs_tpu.ops.rs_cpu import ReedSolomon
 
     rs = ReedSolomon()
     rng = np.random.default_rng(1)
     data = rng.integers(0, 256, (10, shard_bytes), dtype=np.uint8)
     rs.parity_of(data)  # warm
-    start = time.perf_counter()
+    best = float("inf")
     for _ in range(iters):
+        start = time.perf_counter()
         rs.parity_of(data)
-    dt = time.perf_counter() - start
-    return (10 * shard_bytes * iters) / dt / 1e9
+        best = min(best, time.perf_counter() - start)
+    return (10 * shard_bytes) / best / 1e9
 
 
 def _stage_in_subprocess(

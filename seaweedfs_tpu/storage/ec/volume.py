@@ -288,18 +288,22 @@ class EcVolume:
         return self.read_shard_interval(shard_id, off, iv.size)
 
     def read_shard_interval(self, shard_id: int, offset: int, length: int) -> bytes:
-        # 1. local shard; a short pread means a racing truncate/re-copy —
-        # fall through to remote/reconstruct instead of handing the
-        # caller a truncated buffer to choke on
+        # 1. local shard; a short pread means a racing truncate/re-copy
+        # and a closed fd means a racing unmount — both fall through to
+        # remote/reconstruct instead of failing the needle read
         sh = self.shards.get(shard_id)
         if sh is not None:
-            buf = sh.read_at(offset, length)
+            try:
+                buf = sh.read_at(offset, length)
+            except (OSError, ValueError):
+                buf = b""
             if len(buf) == length:
                 return buf
-        # 2. remote shard via injected fetcher
+        # 2. remote shard via injected fetcher (same length discipline:
+        # a peer mid-copy can short-serve too)
         if self.remote_fetch is not None:
             data = self.remote_fetch(shard_id, offset, length)
-            if data is not None:
+            if data is not None and len(data) == length:
                 return data
         # 3. degraded: reconstruct from any DATA_SHARDS other shards
         return self._reconstruct_interval(shard_id, offset, length)
