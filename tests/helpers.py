@@ -40,23 +40,34 @@ def make_volume(
 _used_ports: set[int] = set()
 
 
-def free_port(limit: int = 55000) -> int:
-    """A free TCP port whose +10000 gRPC sibling stays below 65536,
-    never handed out twice in one test session.
+def free_port() -> int:
+    """A free TCP port, never handed out twice in one test session.
 
-    Every server derives grpc_port = port + 10000; an ephemeral port
-    above 55535 silently wraps modulo 65536 inside grpc and dials the
-    wrong place.  Reuse matters because pb/rpc.py caches one channel per
-    address process-wide: a port recycled from an earlier module's dead
-    server would serve its stale, backed-off channel to the new one."""
+    Reuse matters because pb/rpc.py caches one channel per address
+    process-wide: a port recycled from an earlier module's dead server
+    would serve its stale, backed-off channel to the new one.
+
+    Ports come from 20000-22767: DISJOINT from the kernel's ephemeral
+    range (32768-60999) — and so are the derived grpc_port = port+10000
+    siblings (30000-32767, ending just below the ephemeral floor; the
+    band also keeps them under 65536).  A port-0 server (fake stores,
+    FTP PASV sockets) can therefore never squat on a port this function
+    later hands a module fixture — a race that made whole modules error
+    with 'Failed to bind' roughly once per several full-suite runs."""
+    import random
     import socket
 
-    while True:
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        if port <= limit and port not in _used_ports \
-                and port + 10000 not in _used_ports:
-            _used_ports.add(port)
-            _used_ports.add(port + 10000)
-            return port
+    rng = random.Random()
+    for _ in range(20000):  # fail loud, never hang, if the band drains
+        port = rng.randrange(20000, 22768)
+        if port in _used_ports:
+            continue
+        try:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", port))
+        except OSError:
+            continue
+        _used_ports.add(port)
+        return port
+    raise RuntimeError(
+        "free_port: test port band 20000-22767 exhausted or blocked")
