@@ -17,6 +17,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..util.httpd import FrameworkHTTPServer, shield_handler
 
 from ..pb import filer_pb2
+from ..telemetry import http_request, serve_debug_http
 from . import filechunks
 from .filer import join_path, split_path
 
@@ -54,12 +55,16 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
     # -- read / list -------------------------------------------------------
 
     def do_GET(self):
-        from ..stats.metrics import REQUEST_COUNTER
+        with http_request(self, "filer", "get"):
+            self._do_get()
 
-        REQUEST_COUNTER.labels("filer", "get").inc()
+    def _do_get(self):
         u = urllib.parse.urlparse(self.path)
         path = urllib.parse.unquote(u.path)
         q = urllib.parse.parse_qs(u.query)
+        # debug/observability surface (exact paths, ahead of the namespace)
+        if serve_debug_http(self, path):
+            return
         entry = self.filer.find_entry(path)
         if entry is None:
             return self._json(404, {"error": f"{path}: not found"})
@@ -67,7 +72,9 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
             return self._list_dir(path, q)
         return self._read_file(path, entry)
 
-    do_HEAD = do_GET
+    def do_HEAD(self):
+        with http_request(self, "filer", "get"):
+            self._do_get()
 
     def _list_dir(self, path: str, q: dict):
         limit = int(q.get("limit", ["100"])[0])
@@ -125,15 +132,14 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
     # -- write -------------------------------------------------------------
 
     def do_POST(self):
-        self._upload()
+        with http_request(self, "filer", "post"):
+            self._upload()
 
     def do_PUT(self):
-        self._upload()
+        with http_request(self, "filer", "post"):
+            self._upload()
 
     def _upload(self):
-        from ..stats.metrics import REQUEST_COUNTER
-
-        REQUEST_COUNTER.labels("filer", "post").inc()
         u = urllib.parse.urlparse(self.path)
         path = urllib.parse.unquote(u.path)
         q = urllib.parse.parse_qs(u.query)
@@ -185,6 +191,10 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
     # -- delete ------------------------------------------------------------
 
     def do_DELETE(self):
+        with http_request(self, "filer", "delete"):
+            self._do_delete()
+
+    def _do_delete(self):
         u = urllib.parse.urlparse(self.path)
         path = urllib.parse.unquote(u.path)
         q = urllib.parse.parse_qs(u.query)

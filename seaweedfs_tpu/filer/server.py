@@ -16,6 +16,7 @@ import threading
 import time
 
 from ..operation import delete_file_ids, download, upload_data
+from ..telemetry import trace
 from ..util import glog
 from ..operation.assign import AssignResult, assign_any
 from ..pb import filer_pb2
@@ -235,7 +236,10 @@ class FilerServer:
             collection, replication, ttl,
         )
         if len(offsets) > 1:
-            chunks = list(self._pool.map(upload_one, offsets))
+            # wrap_context: the pool workers must upload under THIS
+            # request's trace, not as orphan roots
+            chunks = list(self._pool.map(trace.wrap_context(upload_one),
+                                         offsets))
         elif data:
             chunks = [upload_one(0)]
         entry = filer_pb2.Entry(name=name)
@@ -316,7 +320,8 @@ class FilerServer:
             return b""
         if len(views) == 1:
             return self._fetch_view(views[0])
-        parts = list(self._pool.map(self._fetch_view, views))
+        parts = list(self._pool.map(trace.wrap_context(self._fetch_view),
+                                    views))
         # assemble honoring logical offsets (holes read as zeros)
         out = bytearray(size)
         for v, blob in zip(views, parts):

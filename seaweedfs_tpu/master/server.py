@@ -22,7 +22,8 @@ import grpc
 from ..pb import master_pb2
 from ..pb import rpc as rpclib
 from ..pb import volume_server_pb2 as vs
-from ..stats.metrics import REQUEST_COUNTER, serve_metrics
+from ..stats.metrics import serve_metrics
+from ..telemetry import http_request, record_op, serve_debug_http
 from ..storage.replica_placement import ReplicaPlacement
 from ..util import glog
 from ..topology.placement import Candidate, pick_nodes_for_write
@@ -285,7 +286,15 @@ class MasterServer:
 
     def assign(self, count: int, collection: str, replication: str,
                ttl: str, data_center: str = "", rack: str = "") -> tuple[str, str, str, int]:
-        REQUEST_COUNTER.labels("master", "assign").inc()
+        # instrumented HERE (not in the HTTP layer) so gRPC Assign and
+        # /dir/assign both land in the same ("master","assign") series,
+        # now with a latency histogram + span instead of counter-only
+        with record_op("master", "assign", collection=collection):
+            return self._assign(count, collection, replication, ttl,
+                                data_center, rack)
+
+    def _assign(self, count: int, collection: str, replication: str,
+                ttl: str, data_center: str = "", rack: str = "") -> tuple[str, str, str, int]:
         layout = self.get_layout(collection, replication, ttl)
         try:
             vid, node_ids = layout.pick_for_write()
@@ -500,6 +509,29 @@ class MasterServer:
 # ---------------------------------------------------------------------------
 
 
+# request-metric op per path; unknown paths collapse to "other" so a
+# scanner can't explode the label cardinality.  /dir/assign is absent
+# on purpose: the logical ("master","assign") series inside
+# MasterServer.assign() covers it (shared with the gRPC path), and a
+# second middleware series for the same request would double-count
+# master QPS.
+_MASTER_OPS = {
+    "/dir/lookup": "dir.lookup",
+    "/dir/status": "cluster.status", "/cluster/status": "cluster.status",
+    "/cluster/healthz": "cluster.healthz", "/stats/health": "cluster.healthz",
+    "/cluster/raft": "cluster.raft",
+    "/vol/vacuum": "vol.vacuum", "/vol/grow": "vol.grow",
+    "/vol/status": "vol.status", "/col/delete": "col.delete",
+    "/submit": "submit", "/debug/profile": "debug.profile",
+    "/debug/traces": "debug.traces", "/metrics": "metrics",
+    "/ui": "ui", "/ui/": "ui", "/ui/index.html": "ui",
+}
+
+
+def _master_op(path: str) -> str:
+    return _MASTER_OPS.get(path.split("?")[0], "other")
+
+
 class _MasterHttpHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     master: MasterServer = None
@@ -530,6 +562,10 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_DELETE(self):
+        with http_request(self, "master", _master_op(self.path)):
+            self._do_delete()
+
+    def _do_delete(self):
         u = urllib.parse.urlparse(self.path)
         if u.path == "/col/delete":
             return self._col_delete(u)
@@ -557,6 +593,10 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         drain_request_body(self, cap)
 
     def do_POST(self):
+        with http_request(self, "master", _master_op(self.path)):
+            self._do_post()
+
+    def _do_post(self):
         u = urllib.parse.urlparse(self.path)
         if u.path == "/col/delete":
             return self._col_delete(u)
@@ -626,11 +666,25 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         return self._json(404, {"error": f"unknown path {u.path}"})
 
     def do_GET(self):
+        from ..telemetry import trace
+
+        if self.path.split("?")[0] == "/dir/assign":
+            # metered once, inside MasterServer.assign(); here only the
+            # caller's trace context is adopted so the assign span joins
+            with trace.remote_context(self.headers.get(trace.TRACEPARENT)):
+                return self._do_get()
+        with http_request(self, "master", _master_op(self.path)):
+            self._do_get()
+
+    def _do_get(self):
         u = urllib.parse.urlparse(self.path)
         q = urllib.parse.parse_qs(u.query)
 
         def qget(name, default=""):
             return q.get(name, [default])[0]
+
+        if serve_debug_http(self, u.path):
+            return
 
         if (((u.path.startswith("/dir/") and u.path != "/dir/status")
                 or u.path in ("/vol/grow", "/vol/status"))

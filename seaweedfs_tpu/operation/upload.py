@@ -14,6 +14,9 @@ import urllib.request
 import uuid
 from dataclasses import dataclass
 
+from ..telemetry import trace
+from ..util.http_util import trace_headers
+
 _COMPRESSIBLE_PREFIXES = ("text/", "application/json", "application/xml")
 
 
@@ -62,9 +65,14 @@ def upload_data(
     last: Exception | None = None
     for attempt in range(retries):
         try:
-            req = urllib.request.Request(url, data=body, headers=headers, method="POST")
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                out = json.loads(resp.read() or b"{}")
+            with trace.child_span("http.upload", url=url, bytes=len(payload)):
+                # traceparent captured inside the span: the volume
+                # server's span must parent to http.upload, not above it
+                req = urllib.request.Request(
+                    url, data=body, headers=trace_headers(headers),
+                    method="POST")
+                with urllib.request.urlopen(req, timeout=timeout) as resp:
+                    out = json.loads(resp.read() or b"{}")
             return UploadResult(
                 name=out.get("name", filename),
                 size=out.get("size", len(data)),
@@ -80,10 +88,12 @@ def upload_data(
 
 def download(url: str, timeout: float = 30.0,
              range_header: str | None = None) -> bytes:
-    headers = {"Range": range_header} if range_header else {}
-    req = urllib.request.Request(url, headers=headers)
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return resp.read()
+    with trace.child_span("http.download", url=url):
+        headers = trace_headers(
+            {"Range": range_header} if range_header else {})
+        req = urllib.request.Request(url, headers=headers)
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read()
 
 
 def _is_compressible(mime: str, filename: str) -> bool:

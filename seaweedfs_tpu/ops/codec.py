@@ -15,11 +15,98 @@ on hosts without jax at all.
 
 from __future__ import annotations
 
+import time
+
+from ..stats.metrics import EC_BYTES_HISTOGRAM, EC_OP_HISTOGRAM
+from ..telemetry import trace
 from .rs_cpu import ReedSolomon
 
 DATA_SHARDS = 10
 PARITY_SHARDS = 4
 TOTAL_SHARDS = DATA_SHARDS + PARITY_SHARDS
+
+
+# ---------------------------------------------------------------------------
+# EC-codec telemetry: every blocking codec call through get_codec records
+# seaweedfs_ec_op_seconds{op,impl} + seaweedfs_ec_op_bytes{op,impl} and a
+# span, so degraded-read and rebuild cost shows up in /metrics and
+# /debug/traces attributed to the backend that did the GF math.
+# ---------------------------------------------------------------------------
+
+
+def _nbytes(x) -> int:
+    if x is None:
+        return 0
+    n = getattr(x, "nbytes", None)
+    if n is not None:
+        return int(n)
+    try:
+        return len(x)
+    except TypeError:
+        return 0
+
+
+def _arg_bytes(arg) -> int:
+    if isinstance(arg, (list, tuple)):
+        return sum(_nbytes(s) for s in arg)
+    return _nbytes(arg)
+
+
+class InstrumentedCodec:
+    """Transparent telemetry proxy over a codec.
+
+    Delegates everything (attributes, the device-resident async entries,
+    hasattr-probed capabilities) and times only the BLOCKING operations —
+    the async encode_device* futures are left alone because their wall
+    time at dispatch is not the compute time; rs_jax spans cover those.
+    """
+
+    _TIMED = frozenset({
+        "encode", "parity_of", "parity_into",
+        "reconstruct", "reconstruct_data", "reconstruct_one", "verify",
+    })
+
+    def __init__(self, inner, impl: str):
+        self._inner = inner
+        self._impl = impl
+
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name not in self._TIMED or not callable(attr):
+            return attr
+        impl = self._impl
+        # histogram children and span name resolved ONCE per (op, impl):
+        # the per-chunk encode loop must not pay registry-lock lookups
+        # or import-machinery hits on every call
+        op_hist = EC_OP_HISTOGRAM.labels(name, impl)
+        bytes_hist = EC_BYTES_HISTOGRAM.labels(name, impl)
+        span_name = f"ec.{name}"
+        child_span = trace.child_span
+        perf_counter = time.perf_counter
+
+        def timed(*args, **kwargs):
+            nbytes = _arg_bytes(args[0]) if args else 0
+            t0 = perf_counter()
+            try:
+                # metrics always; spans only inside an active trace — a
+                # bulk encode calls this once per segment, and a root
+                # span per segment would evict every request trace from
+                # the ring
+                with child_span(span_name, impl=impl, bytes=nbytes):
+                    return attr(*args, **kwargs)
+            finally:
+                op_hist.observe(perf_counter() - t0)
+                bytes_hist.observe(nbytes)
+
+        timed.__name__ = name
+        # cache on the instance: per-chunk hot paths (parity_into in the
+        # encode loop) must not rebuild the closure every call
+        self.__dict__[name] = timed
+        return timed
+
+
+def _instrument(codec, impl: str):
+    return InstrumentedCodec(codec, impl)
 
 
 def available_codecs() -> list[str]:
@@ -122,17 +209,21 @@ def get_codec(name: str = "cpu", data_shards: int = DATA_SHARDS,
             _AUTO_CHOICE.append(_resolve_auto())
         name = _AUTO_CHOICE[0]
     if name in ("cpu", "go", "numpy"):
-        return ReedSolomon(data_shards, parity_shards)
+        return _instrument(ReedSolomon(data_shards, parity_shards), "cpu")
     if name in ("tpu", "pallas", "tpu_pallas"):
         from .rs_jax import ReedSolomonTPU
 
-        return ReedSolomonTPU(data_shards, parity_shards, impl="pallas")
+        return _instrument(
+            ReedSolomonTPU(data_shards, parity_shards, impl="pallas"),
+            "pallas")
     if name in ("jax", "tpu_xor"):
         from .rs_jax import ReedSolomonTPU
 
-        return ReedSolomonTPU(data_shards, parity_shards, impl="xor")
+        return _instrument(
+            ReedSolomonTPU(data_shards, parity_shards, impl="xor"), "xor")
     if name in ("tpu_mxu", "mxu"):
         from .rs_jax import ReedSolomonTPU
 
-        return ReedSolomonTPU(data_shards, parity_shards, impl="mxu")
+        return _instrument(
+            ReedSolomonTPU(data_shards, parity_shards, impl="mxu"), "mxu")
     raise ValueError(f"unknown ec codec {name!r}")

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import gf256
+from ..telemetry import trace
 
 _REDUCE = 0x1D  # low byte of the field polynomial 0x11D
 
@@ -182,15 +183,27 @@ class ReedSolomonTPU:
         return apply_matrix(rows, inputs, self.impl)
 
     def parity_of(self, data: np.ndarray) -> np.ndarray:
-        """(data_shards, B) -> (parity_shards, B), the bulk-pipeline entry."""
+        """(data_shards, B) -> (parity_shards, B), the bulk-pipeline entry.
+
+        The three hops are spanned separately so a slow rebuild is
+        attributable to transfer vs compute (behind a thin tunnel the
+        device put dominates; on a pod host the kernel does)."""
         assert data.shape[0] == self.data_shards
-        return np.asarray(self.encode_device(jnp.asarray(data)))
+        with trace.child_span("ec.device_put", impl=self.impl,
+                              bytes=int(data.nbytes)):
+            dev = jnp.asarray(data)
+        with trace.child_span("ec.device_compute", impl=self.impl):
+            # jit dispatch is async: block here so compute time lands in
+            # THIS span, not misattributed to the device_get transfer
+            parity = jax.block_until_ready(self.encode_device(dev))
+        with trace.child_span("ec.device_get", impl=self.impl):
+            return np.asarray(parity)
 
     # -- numpy convenience (same shapes as rs_cpu) ------------------------
 
     def encode(self, shards: list[np.ndarray]) -> None:
         data = np.stack(shards[: self.data_shards])
-        parity = np.asarray(self.encode_device(jnp.asarray(data)))
+        parity = self.parity_of(data)
         for i in range(self.parity_shards):
             shards[self.data_shards + i][:] = parity[i]
 
@@ -203,13 +216,20 @@ class ReedSolomonTPU:
         if len(present) < self.data_shards:
             raise ValueError("too few shards to reconstruct")
         sub = present[: self.data_shards]
-        inputs = jnp.asarray(np.stack([shards[i] for i in sub]))
+        stacked = np.stack([shards[i] for i in sub])
+        with trace.child_span("ec.device_put", impl=self.impl,
+                              bytes=int(stacked.nbytes)):
+            inputs = jnp.asarray(stacked)
         out = list(shards)
         missing_data = [i for i in range(self.data_shards) if shards[i] is None]
         if missing_data:
             dec = gf256.decode_matrix_for(self.matrix, self.data_shards, present)
             rows = dec[np.asarray(missing_data)]
-            rec = np.asarray(self.apply_rows_device(rows, inputs))
+            with trace.child_span("ec.device_compute", impl=self.impl):
+                dev = jax.block_until_ready(
+                    self.apply_rows_device(rows, inputs))
+            with trace.child_span("ec.device_get", impl=self.impl):
+                rec = np.asarray(dev)
             for i, r in zip(missing_data, rec):
                 out[i] = r
         if not data_only:

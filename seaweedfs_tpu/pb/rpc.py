@@ -14,6 +14,7 @@ from typing import Callable
 
 import grpc
 
+from ..telemetry import trace as _trace
 from . import filer_pb2, master_pb2, messaging_pb2, volume_server_pb2
 
 UU, US, SU, SS = "uu", "us", "su", "ss"  # unary/stream request x response
@@ -166,11 +167,51 @@ def configure_security(server_credentials=None, channel_credentials=None) -> Non
 # Server side
 # ---------------------------------------------------------------------------
 
+# request-metric `type` label per service (the gRPC surface of each
+# server, kept distinct from its HTTP surface's type label)
+_GRPC_TYPE = {
+    "master_pb.Seaweed": "masterGrpc",
+    "volume_server_pb.VolumeServer": "volumeServerGrpc",
+    "filer_pb.SeaweedFiler": "filerGrpc",
+    "messaging_pb.SeaweedMessaging": "messagingGrpc",
+    "etcdserverpb.KV": "etcdGrpc",
+}
+
+
+def _traced_unary(server_type: str, method: str, fn: Callable) -> Callable:
+    """Wrap a unary-unary servicer fn with trace adoption + request
+    metrics: the caller's `traceparent` rides in as gRPC metadata."""
+
+    def handler(request, context):
+        from ..telemetry import record_op, trace as _trace
+
+        md = {k: v for k, v in (context.invocation_metadata() or ())}
+        with _trace.remote_context(md.get(_trace.TRACEPARENT)):
+            with record_op(server_type, method):
+                return fn(request, context)
+
+    return handler
+
+
+def _counted_stream(server_type: str, method: str, fn: Callable) -> Callable:
+    """Streaming rpcs are counted but not timed (a stream's lifetime is
+    not a request latency) and not spanned (the generator body outlives
+    the handler call, so a scoped span would lie)."""
+
+    def handler(request_or_iterator, context):
+        from ..stats.metrics import REQUEST_COUNTER
+
+        REQUEST_COUNTER.labels(server_type, method).inc()
+        return fn(request_or_iterator, context)
+
+    return handler
+
 
 def generic_handler(service: Service, impl: object) -> grpc.GenericRpcHandler:
     """Build a GenericRpcHandler from an object with methods named like the
     service's rpcs.  Unimplemented rpcs answer UNIMPLEMENTED."""
     handlers = {}
+    server_type = _GRPC_TYPE.get(service.name, service.name)
     for name, m in service.methods.items():
         fn: Callable | None = getattr(impl, name, None)
         if fn is None:
@@ -178,13 +219,17 @@ def generic_handler(service: Service, impl: object) -> grpc.GenericRpcHandler:
         deser = m.request.FromString
         ser = m.response.SerializeToString
         if m.kind == UU:
-            handlers[name] = grpc.unary_unary_rpc_method_handler(fn, deser, ser)
+            handlers[name] = grpc.unary_unary_rpc_method_handler(
+                _traced_unary(server_type, name, fn), deser, ser)
         elif m.kind == US:
-            handlers[name] = grpc.unary_stream_rpc_method_handler(fn, deser, ser)
+            handlers[name] = grpc.unary_stream_rpc_method_handler(
+                _counted_stream(server_type, name, fn), deser, ser)
         elif m.kind == SU:
-            handlers[name] = grpc.stream_unary_rpc_method_handler(fn, deser, ser)
+            handlers[name] = grpc.stream_unary_rpc_method_handler(
+                _counted_stream(server_type, name, fn), deser, ser)
         else:
-            handlers[name] = grpc.stream_stream_rpc_method_handler(fn, deser, ser)
+            handlers[name] = grpc.stream_stream_rpc_method_handler(
+                _counted_stream(server_type, name, fn), deser, ser)
     return grpc.method_handlers_generic_handler(service.name, handlers)
 
 
@@ -271,9 +316,31 @@ class Stub:
             call = self._channel.stream_unary(path, **kw)
         else:
             call = self._channel.stream_stream(path, **kw)
-        if self._timeout is None:
-            return call
-        return lambda *args, **kwargs: call(*args, timeout=self._timeout, **kwargs)
+        timeout = self._timeout
+        unary_response = m.kind in (UU, SU)
+
+        def _call_with_trace(args, kwargs):
+            # the header is captured INSIDE any client span so the
+            # server's span parents to it, not to the enclosing span
+            metadata = list(kwargs.pop("metadata", ()) or ())
+            hdr = _trace.traceparent_header()
+            if hdr is not None:
+                metadata.append((_trace.TRACEPARENT, hdr))
+            return call(*args, metadata=metadata, **kwargs)
+
+        def invoke(*args, **kwargs):
+            if timeout is not None and "timeout" not in kwargs:
+                kwargs["timeout"] = timeout
+            if unary_response and _trace.current_context() is not None:
+                # client-side span: only when already inside a trace (a
+                # root span per background heartbeat would flood the
+                # ring), and only for unary responses (a returned stream
+                # outlives the call)
+                with _trace.start_span(f"grpc{path}"):
+                    return _call_with_trace(args, kwargs)
+            return _call_with_trace(args, kwargs)
+
+        return invoke
 
 
 def master_stub(address: str, timeout: float | None = None) -> Stub:

@@ -16,6 +16,7 @@ import grpc
 
 from ..pb import filer_pb2
 from ..pb import rpc as rpclib
+from ..util.http_util import trace_headers
 
 GRPC_PORT_OFFSET = 10000
 
@@ -149,7 +150,8 @@ class FilerClient:
             f"http://{self.http_address}{urllib.parse.quote(path)}",
             data=data,
             method="PUT",
-            headers={"Content-Type": mime or "application/octet-stream"},
+            headers=trace_headers(
+                {"Content-Type": mime or "application/octet-stream"}),
         )
         with urllib.request.urlopen(req, timeout=120) as r:
             r.read()
@@ -162,10 +164,10 @@ class FilerClient:
             f"http://{self.http_address}{urllib.parse.quote(path)}",
             data=reader,
             method="PUT",
-            headers={
+            headers=trace_headers({
                 "Content-Type": mime or "application/octet-stream",
                 "Content-Length": str(length),
-            },
+            }),
         )
         with urllib.request.urlopen(req, timeout=600) as r:
             r.read()
@@ -174,7 +176,7 @@ class FilerClient:
         """Streaming GET: returns the live HTTP response (file-like with
         .status/.headers) — caller must close it.  Raises HTTPError on
         non-2xx so callers branch on .code."""
-        headers = {}
+        headers = trace_headers()
         if range_header:
             headers["Range"] = range_header
         req = urllib.request.Request(
@@ -185,7 +187,7 @@ class FilerClient:
 
     def get_object(self, path: str, range_header: str = "") -> tuple[int, dict, bytes]:
         """-> (status, headers, body); raises on network failure only."""
-        headers = {}
+        headers = trace_headers()
         if range_header:
             headers["Range"] = range_header
         req = urllib.request.Request(

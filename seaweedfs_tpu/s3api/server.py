@@ -295,7 +295,7 @@ class S3Handler(BaseHTTPRequestHandler):
         return self.rfile.read(length) if length else b""
 
     def _route(self):
-        from ..stats.metrics import REQUEST_COUNTER
+        from ..telemetry import http_request, serve_debug_http
 
         u = urllib.parse.urlsplit(self.path)
         path = urllib.parse.unquote(u.path)
@@ -314,22 +314,30 @@ class S3Handler(BaseHTTPRequestHandler):
             raw_query=u.query,
             headers={k.lower(): v for k, v in self.headers.items()},
         )
-        REQUEST_COUNTER.labels("s3", self.command.lower()).inc()
-        try:
-            self.identity = self.s3.iam.authenticate(self.auth_req)
-            self._dispatch(bucket, key)
-        except AuthError as e:
-            self._send(e.status, _error_xml(e.code, str(e), self.path))
-        except S3Error as e:
-            self._send_error(e.status, e.code, str(e))
-        except FilerUnavailable as e:
-            # never report an outage as NoSuchKey — sync clients would
-            # mirror the "deletion"
-            self._send_error(503, "ServiceUnavailable", str(e))
-        except BrokenPipeError:
-            pass
-        except Exception as e:  # internal
-            self._send_error(500, "InternalError", f"{type(e).__name__}: {e}")
+        with http_request(self, "s3", self.command.lower()):
+            try:
+                self.identity = self.s3.iam.authenticate(self.auth_req)
+                # debug/observability surface: authenticated (traces
+                # carry object keys and internal volume URLs), exact
+                # paths, ahead of the bucket namespace — a bucket
+                # literally named "metrics" is shadowed (see METRICS.md)
+                if (self.command in ("GET", "HEAD")
+                        and serve_debug_http(self, u.path)):
+                    return
+                self._dispatch(bucket, key)
+            except AuthError as e:
+                self._send(e.status, _error_xml(e.code, str(e), self.path))
+            except S3Error as e:
+                self._send_error(e.status, e.code, str(e))
+            except FilerUnavailable as e:
+                # never report an outage as NoSuchKey — sync clients would
+                # mirror the "deletion"
+                self._send_error(503, "ServiceUnavailable", str(e))
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # internal
+                self._send_error(500, "InternalError",
+                                 f"{type(e).__name__}: {e}")
 
     do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _route
 

@@ -5,13 +5,19 @@ Reference surface: weed/stats/metrics.go:25-123.
 
 from .metrics import (
     Counter,
+    EC_BYTES_HISTOGRAM,
+    EC_OP_HISTOGRAM,
     Gauge,
     Histogram,
     Registry,
     REGISTRY,
+    REQUEST_COUNTER,
+    REQUEST_HISTOGRAM,
     serve_metrics,
 )
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY", "serve_metrics",
+    "EC_BYTES_HISTOGRAM", "EC_OP_HISTOGRAM",
+    "REQUEST_COUNTER", "REQUEST_HISTOGRAM",
 ]

@@ -21,6 +21,19 @@ _DEFAULT_BUCKETS = (
 )
 
 
+def escape_label_value(v: str) -> str:
+    """Prometheus text exposition: label values escape \\, \" and newline."""
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def format_le(bound: float) -> str:
+    """Render a bucket bound as a float consistently (`10.0`, not `10`),
+    so scrapers that string-match bounds see one canonical spelling."""
+    return repr(float(bound))
+
+
 class Metric:
     def __init__(self, name: str, help_: str, label_names: tuple[str, ...]):
         self.name = name
@@ -46,7 +59,8 @@ class Metric:
         if not key:
             return ""
         pairs = ",".join(
-            f'{n}="{v}"' for n, v in zip(self.label_names, key)
+            f'{n}="{escape_label_value(v)}"'
+            for n, v in zip(self.label_names, key)
         )
         return "{" + pairs + "}"
 
@@ -167,11 +181,15 @@ class Histogram(Metric):
         for key, child in items:
             base = dict(zip(self.label_names, key))
             for b, c in zip(child.buckets, child.counts):
-                labels = {**base, "le": repr(b) if b != int(b) else str(b)}
-                pairs = ",".join(f'{n}="{v}"' for n, v in labels.items())
+                labels = {**base, "le": format_le(b)}
+                pairs = ",".join(
+                    f'{n}="{escape_label_value(v)}"'
+                    for n, v in labels.items()
+                )
                 out.append(f"{self.name}_bucket{{{pairs}}} {c}")
             inf_pairs = ",".join(
-                f'{n}="{v}"' for n, v in {**base, "le": "+Inf"}.items()
+                f'{n}="{escape_label_value(v)}"'
+                for n, v in {**base, "le": "+Inf"}.items()
             )
             out.append(f"{self.name}_bucket{{{inf_pairs}}} {child.count}")
             ls = self._label_str(key)
@@ -240,10 +258,23 @@ CHUNK_CACHE_COUNTER = REGISTRY.counter(
     labels=("result",),
 )
 
+# EC codec telemetry: encode/reconstruct wall time and bytes moved per
+# call, labeled by op and backend impl (cpu / xor / mxu / pallas) so the
+# rebuild-traffic cost the warehouse-cluster study flags is attributable
+EC_OP_HISTOGRAM = REGISTRY.histogram(
+    "seaweedfs_ec_op_seconds", "EC codec operation latency",
+    labels=("op", "impl"),
+)
+_EC_BYTE_BUCKETS = tuple(float(4 ** k) for k in range(5, 16))  # 1KB..1GB
+EC_BYTES_HISTOGRAM = REGISTRY.histogram(
+    "seaweedfs_ec_op_bytes", "bytes processed per EC codec operation",
+    labels=("op", "impl"), buckets=_EC_BYTE_BUCKETS,
+)
+
 
 def serve_metrics(port: int, registry: Registry = REGISTRY,
                   host: str = "0.0.0.0") -> ThreadingHTTPServer:
-    """Expose GET /metrics in Prometheus text format."""
+    """Expose GET /metrics (Prometheus text) and GET /debug/traces (JSON)."""
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -252,7 +283,13 @@ def serve_metrics(port: int, registry: Registry = REGISTRY,
             pass
 
         def do_GET(self):
-            if self.path.split("?")[0] != "/metrics":
+            path = self.path.split("?")[0]
+            if path == "/debug/traces":
+                from ..telemetry import serve_debug_http
+
+                serve_debug_http(self, path)
+                return
+            if path != "/metrics":
                 self.send_response(404)
                 self.send_header("Content-Length", "0")
                 self.end_headers()

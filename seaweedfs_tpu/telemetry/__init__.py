@@ -1,0 +1,36 @@
+"""Request tracing + telemetry: spans, traceparent propagation, middleware.
+
+See trace.py (span recorder + W3C context) and middleware.py (the shared
+HTTP request instrumentation used by master/volume/filer/S3).
+"""
+
+from . import trace  # noqa: F401
+from .middleware import (  # noqa: F401
+    DEBUG_TRACES_PATH,
+    METRICS_PATH,
+    SLOW_REQUEST_SECONDS,
+    debug_traces_body,
+    http_request,
+    record_op,
+    serve_debug_http,
+)
+from .trace import (  # noqa: F401
+    TRACER,
+    Span,
+    Tracer,
+    current_trace_id,
+    inject_headers,
+    parse_traceparent,
+    remote_context,
+    start_span,
+    traceparent_header,
+    wrap_context,
+)
+
+__all__ = [
+    "TRACER", "Span", "Tracer", "current_trace_id", "inject_headers",
+    "parse_traceparent", "remote_context", "start_span",
+    "traceparent_header", "wrap_context", "http_request", "record_op",
+    "debug_traces_body", "serve_debug_http",
+    "DEBUG_TRACES_PATH", "METRICS_PATH", "SLOW_REQUEST_SECONDS",
+]

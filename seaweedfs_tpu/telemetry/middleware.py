@@ -1,0 +1,91 @@
+"""Shared request instrumentation for the four HTTP server types.
+
+One code path replaces the previous ad-hoc `REQUEST_COUNTER.labels(...)`
+call sites: every request through `http_request` / `record_op` gets,
+uniformly,
+
+  * seaweedfs_request_total{type,op}        (counter)
+  * seaweedfs_request_seconds{type,op}      (latency histogram)
+  * an active span (joined to the caller's trace via `traceparent`)
+  * a slow-request glog line carrying the trace id when the request
+    exceeds SLOW_REQUEST_SECONDS
+
+so the master, volume, filer and S3 gateways cannot drift apart in what
+they measure (the pre-refactor state: master assign counted but never
+timed, filer counted but never timed, volume did both by hand).
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+from ..stats.metrics import REQUEST_COUNTER, REQUEST_HISTOGRAM
+from ..util import glog
+from . import trace
+
+SLOW_REQUEST_SECONDS = float(
+    os.environ.get("SEAWEEDFS_TPU_SLOW_REQUEST_S", "1.0"))
+
+DEBUG_TRACES_PATH = "/debug/traces"
+METRICS_PATH = "/metrics"
+
+
+@contextmanager
+def record_op(server_type: str, op: str, **attrs):
+    """Instrument one logical operation: counter + histogram + span."""
+    REQUEST_COUNTER.labels(server_type, op).inc()
+    hist = REQUEST_HISTOGRAM.labels(server_type, op)
+    span = None
+    try:
+        with trace.start_span(f"{server_type}.{op}", **attrs) as span:
+            yield span
+    finally:
+        if span is not None:
+            hist.observe(span.duration)
+            if span.duration >= SLOW_REQUEST_SECONDS:
+                glog.warning(
+                    "slow request %s.%s took %.3fs trace=%s",
+                    server_type, op, span.duration, span.trace_id,
+                )
+
+
+@contextmanager
+def http_request(handler, server_type: str, op: str):
+    """`record_op` for a BaseHTTPRequestHandler request: adopts the
+    caller's `traceparent` (if any) so the span joins their trace."""
+    incoming = handler.headers.get(trace.TRACEPARENT)
+    with trace.remote_context(incoming):
+        with record_op(
+            server_type, op,
+            method=handler.command, path=handler.path.split("?")[0],
+        ) as span:
+            yield span
+
+
+def debug_traces_body(limit: int = 50) -> bytes:
+    """JSON body for GET /debug/traces on any server."""
+    return trace.TRACER.traces_json(limit)
+
+
+def serve_debug_http(handler, path: str) -> bool:
+    """Answer /metrics or /debug/traces on a BaseHTTPRequestHandler.
+
+    The one implementation of the observability surface every server
+    type mounts on its main HTTP port; returns True when `path` was one
+    of the two endpoints (response fully written), False otherwise."""
+    if path == DEBUG_TRACES_PATH:
+        body, ctype = debug_traces_body(), "application/json"
+    elif path == METRICS_PATH:
+        from ..stats.metrics import REGISTRY
+
+        body, ctype = REGISTRY.render().encode(), "text/plain; version=0.0.4"
+    else:
+        return False
+    handler.send_response(200)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    if handler.command != "HEAD":
+        handler.wfile.write(body)
+    return True

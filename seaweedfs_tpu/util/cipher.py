@@ -11,10 +11,23 @@ from __future__ import annotations
 
 import os
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:  # gated: hosts without the cryptography wheel can still run the
+    # plaintext path (maybe_seal(enabled=False)); only actually sealing
+    # or opening a sealed chunk requires the dependency
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # pragma: no cover - environment-dependent
+    AESGCM = None
 
 KEY_SIZE = 32
 NONCE_SIZE = 12
+
+
+def _require_aesgcm():
+    if AESGCM is None:
+        raise RuntimeError(
+            "chunk encryption requires the 'cryptography' package, "
+            "which is not installed on this host")
+    return AESGCM
 
 
 def gen_cipher_key() -> bytes:
@@ -23,13 +36,14 @@ def gen_cipher_key() -> bytes:
 
 def encrypt(plaintext: bytes, key: bytes) -> bytes:
     nonce = os.urandom(NONCE_SIZE)
-    return nonce + AESGCM(key).encrypt(nonce, plaintext, None)
+    return nonce + _require_aesgcm()(key).encrypt(nonce, plaintext, None)
 
 
 def decrypt(blob: bytes, key: bytes) -> bytes:
     if len(blob) < NONCE_SIZE:
         raise ValueError("ciphertext too short")
-    return AESGCM(key).decrypt(blob[:NONCE_SIZE], blob[NONCE_SIZE:], None)
+    return _require_aesgcm()(key).decrypt(
+        blob[:NONCE_SIZE], blob[NONCE_SIZE:], None)
 
 
 def maybe_seal(data: bytes, enabled: bool) -> tuple[bytes, bytes]:

@@ -13,7 +13,15 @@ dotted-path convention ("grpc.ca", "jwt.signing.key") the reference uses.
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:  # stdlib on 3.11+; gated so 3.10 hosts still run (a missing TOML
+    # parser only matters when a .toml file is actually present)
+    import tomllib
+except ImportError:  # pragma: no cover - environment-dependent
+    try:
+        import tomli as tomllib  # the 3.10 backport, if installed
+    except ImportError:
+        tomllib = None
 
 SEARCH_PATHS = (
     ".",
@@ -72,6 +80,10 @@ def load_configuration(
     for d in search_paths:
         path = os.path.join(d, f"{name}.toml")
         if os.path.isfile(path):
+            if tomllib is None:
+                raise RuntimeError(
+                    f"found {path} but no TOML parser is available "
+                    "(python < 3.11 without the tomli backport)")
             with open(path, "rb") as f:
                 return Configuration(tomllib.load(f), path=path)
     if required:

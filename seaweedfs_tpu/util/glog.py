@@ -28,6 +28,16 @@ _sink = sys.stderr
 _max_bytes = 0  # 0 = no rotation
 _log_path: str | None = None
 _written = 0
+# optional per-line context (e.g. the active trace id) resolved at emit
+# time from a thread-local; installed by telemetry.trace at import
+_context_fn = None
+
+
+def set_context_provider(fn) -> None:
+    """Install a zero-arg callable whose non-None return value is stamped
+    into every log line as `trace=<value>` (the log<->trace join key)."""
+    global _context_fn
+    _context_fn = fn
 
 
 def set_verbosity(v: int) -> None:
@@ -63,16 +73,30 @@ def _emit(level: str, fmt: str, *args) -> None:
     now = time.time()
     stamp = time.strftime("%m%d %H:%M:%S", time.localtime(now))
     micros = int((now % 1) * 1e6)
-    line = f"{_LEVEL_CHAR[level]}{stamp}.{micros:06d} {where}] {msg}\n"
+    ctx = ""
+    if _context_fn is not None:
+        try:
+            val = _context_fn()
+        except Exception:
+            val = None
+        if val:
+            ctx = f" trace={val}"
+    line = f"{_LEVEL_CHAR[level]}{stamp}.{micros:06d} {where}{ctx}] {msg}\n"
     with _lock:
         try:
             _sink.write(line)
             _written += len(line)
             if _max_bytes and _log_path and _written >= _max_bytes:
+                # rotate atomically from the logger's view: whatever
+                # happens to os.replace, _sink ends up an OPEN handle on
+                # _log_path.  (Previously a failed replace left _sink
+                # closed and every later log was silently dropped.)
                 _sink.close()
-                os.replace(_log_path, _log_path + ".1")
-                _sink = open(_log_path, "a", buffering=1)
-                _written = 0
+                try:
+                    os.replace(_log_path, _log_path + ".1")
+                finally:
+                    _sink = open(_log_path, "a", buffering=1)
+                    _written = _sink.tell()
         except (OSError, ValueError, io.UnsupportedOperation):
             pass
 
@@ -89,6 +113,19 @@ def error(fmt: str, *args) -> None:
     _emit("error", fmt, *args)
 
 
+def flush() -> None:
+    """Flush the active sink; never raises (a dead sink is not fatal)."""
+    with _lock:
+        try:
+            _sink.flush()
+        except (OSError, ValueError, io.UnsupportedOperation):
+            pass
+
+
 def fatal(fmt: str, *args) -> None:
     _emit("fatal", fmt, *args)
+    # the process is about to exit: make sure the F line hits the disk
+    # before SystemExit unwinds (a block-buffered file sink would
+    # otherwise lose the one line that explains the death)
+    flush()
     raise SystemExit(1)

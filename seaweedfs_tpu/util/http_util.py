@@ -44,6 +44,19 @@ def read_chunked_body(rfile, max_bytes: int = 1 << 30) -> bytes:
     return bytes(out)
 
 
+def trace_headers(headers: dict | None = None) -> dict:
+    """Copy of `headers` with the active W3C `traceparent` injected.
+
+    The one helper every outgoing HTTP request in the framework routes
+    through, so a client write yields a connected trace across
+    filer -> master -> volume -> replication hops."""
+    from ..telemetry import trace
+
+    out = dict(headers or {})
+    trace.inject_headers(out)
+    return out
+
+
 GRPC_PORT_OFFSET = 10000
 
 

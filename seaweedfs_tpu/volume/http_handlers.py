@@ -18,7 +18,7 @@ from ..util.httpd import FrameworkHTTPServer, shield_handler
 
 from .. import images
 from ..security.jwt import token_from_header, verify_write_jwt
-from ..stats.metrics import REQUEST_COUNTER, REQUEST_HISTOGRAM
+from ..telemetry import http_request, serve_debug_http
 from ..storage.file_id import FileId
 from ..storage.needle import FLAG_HAS_MIME, FLAG_HAS_NAME, Needle
 
@@ -76,19 +76,15 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
     # -- read -------------------------------------------------------------
 
     def do_GET(self):
-        REQUEST_COUNTER.labels("volumeServer", "get").inc()
-        t0 = time.perf_counter()
-        try:
+        with http_request(self, "volumeServer", "get"):
             self._do_get()
-        finally:
-            REQUEST_HISTOGRAM.labels("volumeServer", "get").observe(
-                time.perf_counter() - t0
-            )
 
     def _do_get(self):
         path = urllib.parse.urlparse(self.path)
         if path.path in ("/status", "/healthz"):
             return self._send_json(200, {"Version": "seaweedfs-tpu", **self.store.status()})
+        if serve_debug_http(self, path.path):
+            return
         if path.path == "/debug/profile":
             from ..util.grace import profile_status
 
@@ -174,14 +170,8 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
     # -- write ------------------------------------------------------------
 
     def do_POST(self):
-        REQUEST_COUNTER.labels("volumeServer", "post").inc()
-        t0 = time.perf_counter()
-        try:
+        with http_request(self, "volumeServer", "post"):
             self._do_post()
-        finally:
-            REQUEST_HISTOGRAM.labels("volumeServer", "post").observe(
-                time.perf_counter() - t0
-            )
 
     def _do_post(self):
         path = urllib.parse.urlparse(self.path)
@@ -227,7 +217,10 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
     # -- delete -----------------------------------------------------------
 
     def do_DELETE(self):
-        REQUEST_COUNTER.labels("volumeServer", "delete").inc()
+        with http_request(self, "volumeServer", "delete"):
+            self._do_delete()
+
+    def _do_delete(self):
         path = urllib.parse.urlparse(self.path)
         qs = urllib.parse.parse_qs(path.query)
         try:

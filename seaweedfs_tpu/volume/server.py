@@ -349,19 +349,27 @@ class VolumeServer:
         if v is None or v.super_block.replica_placement.copy_count() <= 1:
             return None
         sep = "&" if "?" in path else "?"
+        from ..telemetry import trace
+        from ..util.http_util import trace_headers
+
         for peer in self.other_replica_locations(fid.volume_id):
             url = f"http://{peer}{path}{sep}type=replicate"
-            req = urllib.request.Request(url, data=body, method="POST")
-            ct = headers.get("Content-Type")
-            if ct:
-                req.add_header("Content-Type", ct)
-            auth = headers.get("Authorization")
-            if auth:  # write jwt travels with the replica fan-out
-                req.add_header("Authorization", auth)
             try:
-                with urllib.request.urlopen(req, timeout=10) as r:
-                    if r.status >= 300:
-                        return f"peer {peer} status {r.status}"
+                with trace.child_span("volumeServer.replicate", peer=peer):
+                    # traceparent captured inside the span so the peer's
+                    # span parents to the replicate hop
+                    req = urllib.request.Request(
+                        url, data=body, method="POST",
+                        headers=trace_headers())
+                    ct = headers.get("Content-Type")
+                    if ct:
+                        req.add_header("Content-Type", ct)
+                    auth = headers.get("Authorization")
+                    if auth:  # write jwt travels with the replica fan-out
+                        req.add_header("Authorization", auth)
+                    with urllib.request.urlopen(req, timeout=10) as r:
+                        if r.status >= 300:
+                            return f"peer {peer} status {r.status}"
             except OSError as e:
                 return f"peer {peer}: {e}"
         return None
@@ -371,9 +379,12 @@ class VolumeServer:
         if v is None or v.super_block.replica_placement.copy_count() <= 1:
             return
         sep = "&" if "?" in path else "?"
+        from ..util.http_util import trace_headers
+
         for peer in self.other_replica_locations(fid.volume_id):
             url = f"http://{peer}{path}{sep}type=replicate"
-            req = urllib.request.Request(url, method="DELETE")
+            req = urllib.request.Request(
+                url, method="DELETE", headers=trace_headers())
             if auth:
                 req.add_header("Authorization", auth)
             try:

@@ -5,6 +5,7 @@ Reference test analogue: weed/filer/filechunks_test.go and the compose
 harness (SURVEY.md §4 tiers 1 and 4).
 """
 
+import importlib.util
 import json
 import socket
 import time
@@ -511,6 +512,9 @@ def test_leveldb_store_torn_tail_heals(tmp_path):
     s3.close()
 
 
+@pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="chunk encryption needs the cryptography package")
 def test_cipher_round_trip_and_opaque_volume_bytes(tmp_path_factory):
     """-encryptVolumeData: chunks are AES-GCM sealed on upload, decrypted
     transparently on read; the bytes on the volume server reveal nothing

@@ -1,0 +1,169 @@
+"""Strict validation of the Prometheus text exposition format.
+
+A mini-parser walks the full rendered output and checks the invariants a
+real Prometheus scraper relies on: HELP-then-TYPE ordering per family,
+cumulative (non-decreasing) histogram buckets ending in an +Inf bucket
+equal to _count, _sum/_count presence per labelset, float-rendered `le`
+bounds, and backslash/quote escaping in label values.
+"""
+
+import re
+
+from seaweedfs_tpu.stats.metrics import (
+    Registry,
+    escape_label_value,
+    format_le,
+)
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})? (?P<value>[^ ]+)$"
+)
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse(text: str):
+    """-> (families, samples): families[name] = (help, type);
+    samples = [(name, {label: raw_value}, float)]."""
+    families: dict[str, list] = {}
+    samples = []
+    pending_help: dict[str, str] = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            assert name not in families, f"duplicate HELP for {name}"
+            pending_help[name] = help_
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            # HELP must have directly preceded TYPE for the same family
+            assert name in pending_help, f"TYPE before HELP for {name}"
+            families[name] = (pending_help.pop(name), kind.strip())
+            continue
+        assert not line.startswith("#"), f"unknown comment {line!r}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line {line!r}"
+        labels = dict(_LABEL_RE.findall(m.group("labels") or ""))
+        samples.append((m.group("name"), labels, float(m.group("value"))))
+    return families, samples
+
+
+def _family_of(sample_name: str, families) -> str:
+    for suffix in ("_bucket", "_sum", "_count"):
+        base = sample_name[: -len(suffix)] if sample_name.endswith(suffix) else None
+        if base in families and families[base][1] == "histogram":
+            return base
+    return sample_name
+
+
+def _build_registry() -> Registry:
+    r = Registry()
+    c = r.counter("t_requests_total", "requests", labels=("type", "op"))
+    c.labels("volume", "get").inc(3)
+    c.labels("filer", "post").inc()
+    g = r.gauge("t_volumes", "volumes", labels=("collection",))
+    g.labels("pics").set(7)
+    # label values exercising the escaping rules
+    c.labels('he said "hi"', "back\\slash").inc()
+    h = r.histogram("t_latency_seconds", "latency",
+                    labels=("op",), buckets=(0.25, 1, 10))
+    for v in (0.1, 0.5, 3.0, 50.0):
+        h.labels("read").observe(v)
+    h.labels("write").observe(0.2)
+    return r
+
+
+def test_full_output_parses_and_is_consistent():
+    text = _build_registry().render()
+    families, samples = _parse(text)
+
+    assert families["t_requests_total"][1] == "counter"
+    assert families["t_volumes"][1] == "gauge"
+    assert families["t_latency_seconds"][1] == "histogram"
+
+    # every sample belongs to a declared family
+    for name, labels, _ in samples:
+        assert _family_of(name, families) in families, name
+
+    # histogram invariants per labelset
+    by_op: dict[str, list] = {}
+    sums = {}
+    counts = {}
+    for name, labels, value in samples:
+        if name == "t_latency_seconds_bucket":
+            by_op.setdefault(labels["op"], []).append((labels["le"], value))
+        elif name == "t_latency_seconds_sum":
+            sums[labels["op"]] = value
+        elif name == "t_latency_seconds_count":
+            counts[labels["op"]] = value
+    assert set(by_op) == {"read", "write"}
+    for op, buckets in by_op.items():
+        les = [le for le, _ in buckets]
+        assert les[-1] == "+Inf"
+        # finite bounds render as floats, in ascending order
+        finite = [float(le) for le in les[:-1]]
+        assert finite == sorted(finite)
+        values = [v for _, v in buckets]
+        assert values == sorted(values), f"{op}: non-cumulative buckets"
+        assert values[-1] == counts[op], f"{op}: +Inf != _count"
+        assert op in sums and op in counts
+    # the int bucket bound 1 and 10 must render as 1.0 / 10.0
+    read_les = [le for le, _ in by_op["read"]]
+    assert read_les == ["0.25", "1.0", "10.0", "+Inf"]
+    # cumulative counts: 0.1<=0.25; 0.5<=1; 3<=10; 50 only in +Inf
+    assert [v for _, v in by_op["read"]] == [1, 2, 3, 4]
+    assert sums["read"] == 0.1 + 0.5 + 3.0 + 50.0
+
+
+def test_label_value_escaping_round_trips():
+    text = _build_registry().render()
+    # raw escaped forms present in the exposition
+    assert r'type="he said \"hi\""' in text
+    assert r'op="back\\slash"' in text
+    # and the parser (which unescapes per the spec regex) sees the family
+    _, samples = _parse(text)
+    escaped = [
+        labels for name, labels, _ in samples
+        if name == "t_requests_total" and "hi" in labels.get("type", "")
+    ]
+    assert escaped, "escaped labelset missing from exposition"
+
+
+def test_escape_helpers():
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+    assert format_le(10) == "10.0"
+    assert format_le(10.0) == "10.0"
+    assert format_le(0.25) == "0.25"
+    assert format_le(0.0001) == "0.0001"
+
+
+def test_preexisting_request_label_pairs_render():
+    """The label pairs the seed emitted must still appear after the
+    middleware refactor (ISSUE satellite: no silent metric loss)."""
+    from seaweedfs_tpu.stats.metrics import (
+        REGISTRY,
+        REQUEST_COUNTER,
+        REQUEST_HISTOGRAM,
+    )
+
+    legacy = [
+        ("master", "assign"),
+        ("filer", "get"), ("filer", "post"),
+        ("volumeServer", "get"), ("volumeServer", "post"),
+        ("volumeServer", "delete"),
+        ("s3", "get"), ("s3", "put"),
+    ]
+    for t, op in legacy:
+        REQUEST_COUNTER.labels(t, op).inc(0)
+        REQUEST_HISTOGRAM.labels(t, op)
+    text = REGISTRY.render()
+    for t, op in legacy:
+        assert f'seaweedfs_request_total{{type="{t}",op="{op}"}}' in text
+        assert (f'seaweedfs_request_seconds_count{{type="{t}",op="{op}"}}'
+                in text)

@@ -6,6 +6,7 @@ Reference analogues: weed/filesys/dirty_page_interval_test.go, the mount
 compose tier (docker/compose/local-mount-compose.yml), meta_cache/.
 """
 
+import importlib.util
 import os
 import socket
 import time
@@ -334,6 +335,9 @@ def test_kernel_fuse_mount(mount_cluster, tmp_path):
         m.stop()
 
 
+@pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="chunk encryption needs the cryptography package")
 def test_wfs_cipher_write_and_read(mount_cluster, tmp_path):
     """Against a -encryptVolumeData filer, mount WRITES seal chunks with
     per-chunk keys and mount READS decrypt them; volume bytes stay

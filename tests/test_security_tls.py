@@ -7,6 +7,7 @@ weed/command/scaffold.go (default config emission).
 
 from __future__ import annotations
 
+import importlib.util
 import socket
 import time
 
@@ -65,7 +66,8 @@ def test_toml_discovery_and_dotted_access(tmp_path):
 
 
 def test_scaffold_emits_parseable_toml(tmp_path):
-    import tomllib
+    tomllib = pytest.importorskip(
+        "tomllib", reason="no TOML parser on python < 3.11")
 
     for name in ("security", "master", "filer"):
         data = tomllib.loads(scaffold(name))
@@ -76,6 +78,9 @@ def test_scaffold_emits_parseable_toml(tmp_path):
     assert "grpc" in s
 
 
+@pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="cert generation needs the cryptography package")
 def test_mtls_cluster_roundtrip(tmp_path, plaintext_rpc):
     """A master+volume cluster where every gRPC hop is mutually
     authenticated: heartbeats, lookups, admin rpcs."""
@@ -116,6 +121,9 @@ def test_mtls_cluster_roundtrip(tmp_path, plaintext_rpc):
         master.stop()
 
 
+@pytest.mark.skipif(
+    importlib.util.find_spec("cryptography") is None,
+    reason="cert generation needs the cryptography package")
 def test_mtls_rejects_unauthenticated_client(tmp_path, plaintext_rpc):
     """A client without a certificate cannot complete the handshake."""
     certs = generate_dev_certs(str(tmp_path / "certs"),

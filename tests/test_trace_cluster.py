@@ -1,0 +1,172 @@
+"""Cross-process tracing: one trace id spans filer -> volume hops.
+
+Real subprocesses through the CLI (the tier-4 harness of
+test_cli_processes.py): a client PUT to the filer with an explicit W3C
+`traceparent` must surface the SAME trace id in the filer process's
+/debug/traces AND the volume process's /debug/traces — proving the
+context crossed the process boundary on the chunk upload — with >= 3
+spans overall, and every server's /metrics must expose the request
+latency histograms the middleware emits.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+from helpers import free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CLIENT_TRACE_ID = "c0ffee" + "ab" * 13  # 32 hex chars
+CLIENT_SPAN_ID = "11" * 8
+TRACEPARENT = f"00-{CLIENT_TRACE_ID}-{CLIENT_SPAN_ID}-01"
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _spawn(args, cwd):
+    return subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", *args],
+        cwd=cwd, env=_env(),
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+
+
+def _wait_http(url, deadline_s=25):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                return r.status
+        except (urllib.error.URLError, ConnectionError, OSError):
+            time.sleep(0.3)
+    raise TimeoutError(url)
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return json.loads(r.read())
+
+
+def _trace_spans(port, trace_id):
+    doc = _get_json(f"http://127.0.0.1:{port}/debug/traces")
+    for t in doc["traces"]:
+        if t["traceId"] == trace_id:
+            return t["spans"]
+    return []
+
+
+def test_one_trace_spans_filer_and_volume_processes(tmp_path):
+    mport, vport, fport = free_port(), free_port(), free_port()
+    vol_dir = tmp_path / "v1"
+    vol_dir.mkdir()
+    procs = []
+    try:
+        procs.append(_spawn(["master", "-port", str(mport)], str(tmp_path)))
+        _wait_http(f"http://127.0.0.1:{mport}/cluster/healthz")
+        procs.append(_spawn(
+            ["volume", "-dir", str(vol_dir), "-port", str(vport),
+             "-mserver", f"127.0.0.1:{mport}", "-ec.codec", "cpu"],
+            str(tmp_path)))
+        procs.append(_spawn(
+            ["filer", "-master", f"127.0.0.1:{mport}",
+             "-port", str(fport), "-store", str(tmp_path / "filer.db")],
+            str(tmp_path)))
+        _wait_http(f"http://127.0.0.1:{fport}/")
+
+        # wait for the volume server to register with the master
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                if _get_json(
+                    f"http://127.0.0.1:{mport}/dir/assign"
+                ).get("fid"):
+                    break
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.3)
+        else:
+            raise AssertionError("master never produced an assignment")
+
+        # one client PUT carrying an explicit traceparent
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fport}/traced/file.bin",
+            data=os.urandom(4096), method="PUT",
+            headers={"traceparent": TRACEPARENT},
+        )
+        with urllib.request.urlopen(req, timeout=15) as r:
+            assert r.status == 201
+
+        filer_spans = _trace_spans(fport, CLIENT_TRACE_ID)
+        volume_spans = _trace_spans(vport, CLIENT_TRACE_ID)
+
+        assert filer_spans, "filer did not adopt the client trace id"
+        assert volume_spans, (
+            "volume server did not join the trace: the traceparent was "
+            "not propagated on the chunk upload hop")
+        names = {s["name"] for s in filer_spans + volume_spans}
+        assert "filer.post" in names
+        assert "volumeServer.post" in names
+        assert len(filer_spans) + len(volume_spans) >= 3, names
+        # the filer's edge span hangs off the client's span id
+        edge = [s for s in filer_spans if s["name"] == "filer.post"]
+        assert edge and edge[0]["parentId"] == CLIENT_SPAN_ID
+        # spans are linked: every volume span's trace matches, and the
+        # chunk-upload hop's parent exists in the filer process
+        filer_ids = {s["spanId"] for s in filer_spans}
+        assert any(s["parentId"] in filer_ids for s in volume_spans)
+
+        # /metrics on every server exposes the middleware histograms
+        for port, needle in (
+            (fport, 'seaweedfs_request_seconds_count{type="filer",op="post"}'),
+            (vport, 'seaweedfs_request_seconds_count{type="volumeServer",op="post"}'),
+            (mport, 'seaweedfs_request_seconds_count{type="master",op="assign"}'),
+        ):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ) as r:
+                text = r.read().decode()
+            assert needle in text, f"port {port} missing {needle}"
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_ec_codec_metrics_after_encode_reconstruct_cycle():
+    """One encode/reconstruct cycle must surface
+    seaweedfs_ec_op_seconds{op,impl} (+ byte histograms) in /metrics."""
+    import numpy as np
+
+    from seaweedfs_tpu.ops.codec import get_codec
+    from seaweedfs_tpu.stats.metrics import REGISTRY
+
+    codec = get_codec("cpu")
+    shards = [
+        np.random.randint(0, 256, 512, dtype=np.uint8) if i < 10
+        else np.zeros(512, np.uint8)
+        for i in range(14)
+    ]
+    codec.encode(shards)
+    broken = list(shards)
+    broken[2] = broken[11] = None
+    rec = codec.reconstruct(broken)
+    assert np.array_equal(rec[2], shards[2])
+    text = REGISTRY.render()
+    for op in ("encode", "reconstruct"):
+        assert (f'seaweedfs_ec_op_seconds_count{{op="{op}",impl="cpu"}}'
+                in text)
+        assert f'seaweedfs_ec_op_bytes_count{{op="{op}",impl="cpu"}}' in text

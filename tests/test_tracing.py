@@ -1,0 +1,220 @@
+"""Telemetry unit tests: span recorder, traceparent, middleware, glog.
+
+The cross-process trace assertion lives in test_trace_cluster.py; this
+module covers the in-process invariants.
+"""
+
+import io
+import json
+import os
+import threading
+
+import pytest
+
+from seaweedfs_tpu.telemetry import middleware, trace
+from seaweedfs_tpu.util import glog
+
+
+# -- traceparent -------------------------------------------------------------
+
+
+def test_traceparent_format_and_parse_roundtrip():
+    with trace.start_span("root") as span:
+        hdr = trace.traceparent_header()
+        assert hdr == f"00-{span.trace_id}-{span.span_id}-01"
+        parsed = trace.parse_traceparent(hdr)
+        assert parsed == (span.trace_id, span.span_id)
+    assert trace.traceparent_header() is None
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-short-span-01",
+    "00-" + "g" * 32 + "-" + "1" * 16 + "-01",      # non-hex
+    "00-+" + "a" * 31 + "-" + "1" * 16 + "-01",     # int() quirk: sign
+    "00-" + "a_a".ljust(32, "b") + "-" + "1" * 16 + "-01",  # underscore
+    "zz-" + "1" * 32 + "-" + "1" * 16 + "-01",      # non-hex version
+    "ff-" + "1" * 32 + "-" + "1" * 16 + "-01",      # forbidden version
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",      # all-zero trace id
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",      # all-zero span id
+])
+def test_parse_traceparent_rejects_malformed(bad):
+    assert trace.parse_traceparent(bad) is None
+
+
+def test_remote_context_adopts_caller_trace():
+    hdr = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    with trace.remote_context(hdr):
+        with trace.start_span("child") as span:
+            assert span.trace_id == "ab" * 16
+            assert span.parent_id == "cd" * 8
+    # malformed header -> fresh root trace, not a crash
+    with trace.remote_context("nope"):
+        with trace.start_span("orphan") as span:
+            assert span.parent_id == ""
+
+
+# -- span recorder -----------------------------------------------------------
+
+
+def test_span_nesting_links_parents():
+    t = trace.Tracer(max_spans=16)
+    with trace.start_span("outer", tracer=t) as outer:
+        with trace.start_span("inner", tracer=t) as inner:
+            pass
+    assert inner.trace_id == outer.trace_id
+    assert inner.parent_id == outer.span_id
+    traces = t.recent_traces()
+    assert len(traces) == 1
+    assert [s["name"] for s in traces[0]["spans"]] == ["outer", "inner"]
+
+
+def test_span_records_error_status():
+    t = trace.Tracer(max_spans=4)
+    with pytest.raises(ValueError):
+        with trace.start_span("boom", tracer=t):
+            raise ValueError("x")
+    (span,) = t.spans()
+    assert span.status.startswith("error")
+    assert span.duration >= 0
+
+
+def test_ring_buffer_is_bounded():
+    t = trace.Tracer(max_spans=8)
+    for i in range(50):
+        with trace.start_span(f"s{i}", tracer=t):
+            pass
+    spans = t.spans()
+    assert len(spans) == 8
+    assert spans[-1].name == "s49"  # newest kept, oldest evicted
+
+
+def test_wrap_context_carries_trace_into_worker_thread():
+    t = trace.Tracer(max_spans=8)
+    seen = {}
+
+    def worker():
+        with trace.start_span("pool-task", tracer=t) as s:
+            seen["trace"] = s.trace_id
+            seen["parent"] = s.parent_id
+
+    with trace.start_span("request", tracer=t) as root:
+        th = threading.Thread(target=trace.wrap_context(worker))
+        th.start()
+        th.join()
+    assert seen["trace"] == root.trace_id
+    assert seen["parent"] == root.span_id
+    # without wrap_context the same worker starts an orphan trace
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    assert seen["parent"] == ""
+
+
+def test_traces_json_shape():
+    t = trace.Tracer(max_spans=8)
+    with trace.start_span("a", tracer=t, path="/x"):
+        pass
+    doc = json.loads(t.traces_json())
+    (tr,) = doc["traces"]
+    (span,) = tr["spans"]
+    assert span["name"] == "a"
+    assert span["attrs"] == {"path": "/x"}
+    assert span["durationMs"] >= 0
+    assert tr["traceId"] == span["traceId"]
+
+
+# -- middleware --------------------------------------------------------------
+
+
+class _FakeHandler:
+    command = "GET"
+    path = "/dir/assign?count=1"
+
+    def __init__(self, headers=None):
+        self.headers = headers or {}
+
+
+def test_http_request_emits_counter_histogram_span():
+    from seaweedfs_tpu.stats.metrics import REQUEST_COUNTER, REQUEST_HISTOGRAM
+
+    hdr = "00-" + "77" * 16 + "-" + "88" * 8 + "-01"
+    before = REQUEST_COUNTER.labels("testsrv", "op1").value
+    h_child = REQUEST_HISTOGRAM.labels("testsrv", "op1")
+    count_before = h_child.count
+    with middleware.http_request(_FakeHandler({"traceparent": hdr}),
+                                 "testsrv", "op1") as span:
+        pass
+    assert REQUEST_COUNTER.labels("testsrv", "op1").value == before + 1
+    assert h_child.count == count_before + 1
+    assert span.trace_id == "77" * 16  # joined the caller's trace
+    assert span.attrs["path"] == "/dir/assign"
+
+
+def test_record_op_observes_histogram_on_exception():
+    from seaweedfs_tpu.stats.metrics import REQUEST_HISTOGRAM
+
+    h_child = REQUEST_HISTOGRAM.labels("testsrv", "op2")
+    before = h_child.count
+    with pytest.raises(RuntimeError):
+        with middleware.record_op("testsrv", "op2"):
+            raise RuntimeError("x")
+    assert h_child.count == before + 1
+
+
+# -- glog --------------------------------------------------------------------
+
+
+def test_glog_line_carries_trace_id():
+    buf = io.StringIO()
+    glog.set_output(buf)
+    try:
+        with trace.start_span("logged") as span:
+            glog.info("inside span")
+        glog.info("outside span")
+    finally:
+        import sys
+
+        glog.set_output(sys.stderr)
+    inside, outside = buf.getvalue().strip().splitlines()
+    assert f"trace={span.trace_id}" in inside
+    assert "trace=" not in outside
+
+
+def test_glog_survives_rotation_failure(tmp_path, monkeypatch):
+    """A failed os.replace must not leave the sink closed (the seed bug:
+    every log line after a failed rotation was silently dropped)."""
+    path = str(tmp_path / "app.log")
+    glog.set_output(path, max_bytes=64)
+    try:
+        real_replace = os.replace
+
+        def broken_replace(src, dst):
+            raise OSError("EBUSY")
+
+        monkeypatch.setattr(os, "replace", broken_replace)
+        for i in range(5):  # every line overflows max_bytes -> rotation try
+            glog.info("line %d with enough padding to cross the limit", i)
+        monkeypatch.setattr(os, "replace", real_replace)
+        glog.info("after recovery")
+    finally:
+        import sys
+
+        glog.set_output(sys.stderr)
+    content = open(path).read() + (
+        open(path + ".1").read() if os.path.exists(path + ".1") else "")
+    for i in range(5):
+        assert f"line {i}" in content, "log line dropped after failed rotation"
+    assert "after recovery" in content
+
+
+def test_glog_fatal_flushes_before_exit(tmp_path):
+    path = str(tmp_path / "fatal.log")
+    glog.set_output(path, max_bytes=1 << 20)
+    try:
+        with pytest.raises(SystemExit):
+            glog.fatal("dying: %s", "reason")
+        assert "dying: reason" in open(path).read()
+    finally:
+        import sys
+
+        glog.set_output(sys.stderr)
