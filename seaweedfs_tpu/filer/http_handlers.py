@@ -17,7 +17,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ..util.httpd import FrameworkHTTPServer, shield_handler
 
 from ..pb import filer_pb2
-from ..telemetry import http_request, serve_debug_http
+from ..telemetry import http_request, serve_debug_http, trace
 from . import filechunks
 from .filer import join_path, split_path
 
@@ -126,7 +126,13 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
         try:
             data = self.filer_server.read_entry_range(entry, start, length)
         except Exception as e:
-            return self._json(500, {"error": str(e)})
+            # only reached after replica failover AND the refreshed-lookup
+            # (EC degraded-read) round both failed; the trace id links the
+            # 5xx to the per-location failures in /debug/traces
+            return self._json(500, {
+                "error": str(e),
+                "trace": trace.current_trace_id() or "",
+            })
         self._send(status, data, mime, extra)
 
     # -- write -------------------------------------------------------------
@@ -167,7 +173,10 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
                     replication=q.get("replication", [""])[0], ttl=ttl,
                 )
             except Exception as e:
-                return self._json(500, {"error": str(e)})
+                return self._json(500, {
+                    "error": str(e),
+                    "trace": trace.current_trace_id() or "",
+                })
             return self._json(201, {
                 "name": entry.name,
                 "size": filechunks.total_size(entry.chunks),
@@ -182,7 +191,10 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
                 signatures=_signatures(q),
             )
         except Exception as e:
-            return self._json(500, {"error": str(e)})
+            return self._json(500, {
+                "error": str(e),
+                "trace": trace.current_trace_id() or "",
+            })
         self._json(201, {
             "name": entry.name,
             "size": filechunks.total_size(entry.chunks) or len(entry.content),

@@ -10,6 +10,7 @@ volume-server upload, then one CreateEntry records the chunk list
 from __future__ import annotations
 
 import concurrent.futures
+import os
 import random
 import struct
 import threading
@@ -17,7 +18,7 @@ import time
 
 from ..operation import delete_file_ids, download, upload_data
 from ..telemetry import trace
-from ..util import glog
+from ..util import failsafe, faultpoint, glog
 from ..operation.assign import AssignResult, assign_any
 from ..pb import filer_pb2
 from ..pb import rpc as rpclib
@@ -30,8 +31,16 @@ from .grpc_handlers import FilerGrpcService
 from .http_handlers import serve_http
 
 from ..util.http_util import grpc_address as _peer_grpc_addr
+from ..util.http_util import netloc as _netloc
 
 GRPC_PORT_OFFSET = 10000
+
+FP_CHUNK_FETCH = faultpoint.register("filer.chunk.fetch")
+
+# total budget for one chunk read INCLUDING all failover rounds: clamps
+# every nested lookup rpc and download attempt via the ambient deadline
+CHUNK_READ_DEADLINE_S = float(
+    os.environ.get("SEAWEEDFS_TPU_CHUNK_READ_DEADLINE_S", "30"))
 
 
 class FilerServer:
@@ -276,22 +285,39 @@ class FilerServer:
     def _upload_chunk(self, blob: bytes, offset: int, name: str, mime: str,
                       collection: str, replication: str, ttl: str
                       ) -> filer_pb2.FileChunk:
-        result = assign_any(
-            self._master_order(), count=1, collection=collection,
-            replication=replication or self.default_replication, ttl=ttl,
-        )
+        """Assign + upload one chunk.  When the assigned volume server
+        cannot take the write even after upload_data's own retries, the
+        chunk is RE-ASSIGNED — the master hands out a different target
+        and the stale fid is abandoned (it was never recorded anywhere,
+        so it costs nothing)."""
         from ..util.cipher import maybe_seal
 
         stored, cipher_key = maybe_seal(blob, self.cipher)
-        up = upload_data(
-            result.fid_url(), stored, filename=name, mime=mime,
-            jwt=result.auth,
-        )
-        chunk = filechunks.make_chunk(
-            result.fid, offset, len(blob), time.time_ns(), e_tag=up.etag
-        )
-        chunk.cipher_key = cipher_key
-        return chunk
+        last: Exception | None = None
+        for round_no in range(3):
+            result = assign_any(
+                self._master_order(), count=1, collection=collection,
+                replication=replication or self.default_replication, ttl=ttl,
+            )
+            try:
+                up = upload_data(
+                    result.fid_url(), stored, filename=name, mime=mime,
+                    jwt=result.auth,
+                )
+            except Exception as e:  # noqa: BLE001 - re-assign and retry
+                last = e
+                failsafe.RETRY_COUNTER.labels(
+                    "filer", "upload_chunk", "reassign").inc()
+                glog.warning(
+                    "chunk upload to %s failed (%s); re-assigning trace=%s",
+                    result.url, e, trace.current_trace_id() or "-")
+                continue
+            chunk = filechunks.make_chunk(
+                result.fid, offset, len(blob), time.time_ns(), e_tag=up.etag
+            )
+            chunk.cipher_key = cipher_key
+            return chunk
+        raise IOError(f"chunk upload failed after re-assigns: {last}")
 
     def append_file(self, path: str, data: bytes, mime: str = "",
                     collection: str = "", replication: str = "",
@@ -337,23 +363,56 @@ class FilerServer:
             self._fetch_whole, chunks
         )
 
+    def _download_failover(self, file_id: str,
+                           range_header: str | None = None) -> bytes:
+        """Fetch chunk bytes with replica failover + EC degraded-read
+        fallback.
+
+        Round 0 walks the cached locations (breaker-gated, connection-
+        refused locations evicted from the vid cache).  Round 1 forces a
+        fresh master lookup — after a volume moved, lost its last live
+        replica, or was EC-encoded, the master's answer names the servers
+        that can still produce the bytes (EC shard holders rebuild the
+        needle on the fly), so a 5xx only surfaces once even the rebuilt
+        path is gone."""
+        vid = int(file_id.split(",", 1)[0])
+
+        def urls_for(round_no: int) -> list[str]:
+            return self.master_client.lookup_file_id(
+                file_id, refresh=round_no > 0)
+
+        def fetch(url: str) -> bytes:
+            faultpoint.inject(FP_CHUNK_FETCH, ctx=url)
+            # single attempt per location: rotation IS the retry here
+            return download(url, range_header=range_header, retries=1,
+                            use_breaker=False)
+
+        def on_failure(url: str, exc: BaseException) -> None:
+            if failsafe.is_connection_refused(exc):
+                self.master_client.invalidate_location(vid, url)
+
+        try:
+            with failsafe.deadline_scope(CHUNK_READ_DEADLINE_S):
+                return failsafe.call_with_failover(
+                    urls_for, fetch, op="chunk_read", retry_type="filer",
+                    policy=failsafe.RetryPolicy(
+                        max_attempts=2, base_delay=0.05, max_delay=0.5),
+                    idempotent=True, on_peer_failure=on_failure,
+                    peer_key=_netloc,
+                )
+        except failsafe.CircuitOpenError:
+            raise IOError(f"no locations for chunk {file_id}")
+        except Exception as e:
+            raise IOError(f"chunk {file_id} unreadable: {e}") from e
+
     def _fetch_whole(self, file_id: str) -> bytes:
         """Whole-chunk fetch through the tiered cache."""
         cached = self.chunk_cache.get(file_id)
         if cached is not None:
             return cached
-        urls = self.master_client.lookup_file_id(file_id)
-        if not urls:
-            raise IOError(f"no locations for chunk {file_id}")
-        last_err: Exception | None = None
-        for url in urls:
-            try:
-                blob = download(url)
-                self.chunk_cache.set(file_id, blob)
-                return blob
-            except Exception as e:
-                last_err = e
-        raise IOError(f"chunk {file_id} unreadable: {last_err}")
+        blob = self._download_failover(file_id)
+        self.chunk_cache.set(file_id, blob)
+        return blob
 
     def _fetch_view(self, view: filechunks.ChunkView) -> bytes:
         if view.cipher_key:
@@ -371,17 +430,8 @@ class FilerServer:
         if view.chunk_size and view.chunk_size <= (self.max_mb << 20):
             blob = self._fetch_whole(view.file_id)
             return blob[view.offset : view.offset + view.size]
-        urls = self.master_client.lookup_file_id(view.file_id)
-        if not urls:
-            raise IOError(f"no locations for chunk {view.file_id}")
-        last_err: Exception | None = None
-        for url in urls:
-            try:
-                rng = f"bytes={view.offset}-{view.offset + view.size - 1}"
-                return download(url, range_header=rng)
-            except Exception as e:
-                last_err = e
-        raise IOError(f"chunk {view.file_id} unreadable: {last_err}")
+        rng = f"bytes={view.offset}-{view.offset + view.size - 1}"
+        return self._download_failover(view.file_id, range_header=rng)
 
     def manifestize_chunks(self, chunks: list, path: str = "") -> list:
         """Fold an over-long chunk list into manifest chunks before the

@@ -410,7 +410,11 @@ class WFS:
             last: Exception | None = None
             for url in self.lookup_fid_urls(file_id):
                 try:
-                    whole = download(url)
+                    # single attempt per replica, no breaker: this loop IS
+                    # the retry (same discipline as the filer's
+                    # _download_failover), so a dead replica costs one
+                    # timeout before rotating, not three
+                    whole = download(url, retries=1, use_breaker=False)
                     break
                 except Exception as e:  # noqa: BLE001 — try other replicas
                     last = e

@@ -7,10 +7,11 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-import grpc
-
 from ..pb import master_pb2
 from ..pb import rpc as rpclib
+from ..util import failsafe, faultpoint
+
+FP_ASSIGN = faultpoint.register("operation.assign")
 
 
 @dataclass
@@ -57,11 +58,21 @@ def assign(
 
 
 def assign_any(master_grpcs: list[str], **kwargs) -> AssignResult:
-    """Try each master in turn (leader chasing for one-shot callers)."""
-    last: Exception | None = None
-    for m in master_grpcs:
-        try:
-            return assign(m, **kwargs)
-        except (grpc.RpcError, RuntimeError) as e:
-            last = e
-    raise RuntimeError(f"assign failed on all masters: {last}")
+    """Try each master in turn (leader chasing for one-shot callers),
+    under the shared failover policy: breaker-gated per master, jittered
+    backoff between full rounds.  Assign is idempotent (an orphaned fid
+    costs one needle slot, never corrupts data), so everything transient
+    retries."""
+
+    def attempt(master: str) -> AssignResult:
+        faultpoint.inject(FP_ASSIGN, ctx=master)
+        return assign(master, **kwargs)
+
+    try:
+        return failsafe.call_with_failover(
+            list(master_grpcs), attempt, op="assign",
+            retry_type="operation", policy=failsafe.RPC_POLICY,
+            idempotent=True,
+        )
+    except Exception as e:
+        raise RuntimeError(f"assign failed on all masters: {e}") from e

@@ -10,6 +10,7 @@ import grpc
 
 from ..pb import rpc as rpclib
 from ..pb import volume_server_pb2 as vs
+from ..util import failsafe
 
 
 def delete_file_id(lookup, fid: str, jwt: str = "") -> bool:
@@ -39,13 +40,19 @@ def delete_file_ids(lookup, fids: list[str]) -> dict[str, bool]:
         grpc_addr = _grpc_address(locs[0].url)
         by_server.setdefault(grpc_addr, []).append(fid)
     for server, server_fids in by_server.items():
+        # deletes are idempotent (a re-deleted needle answers not-found),
+        # so transient rpc failures retry under the shared policy
         try:
-            resp = rpclib.volume_server_stub(server, timeout=30).BatchDelete(
-                vs.BatchDeleteRequest(file_ids=server_fids)
+            resp = failsafe.call(
+                lambda s=server, f=server_fids: rpclib.volume_server_stub(
+                    s, timeout=30).BatchDelete(
+                        vs.BatchDeleteRequest(file_ids=f)),
+                op="batch_delete", retry_type="operation",
+                policy=failsafe.RPC_POLICY, peer=server, idempotent=True,
             )
             for r in resp.results:
                 results[r.file_id] = not r.error
-        except grpc.RpcError:
+        except (grpc.RpcError, failsafe.CircuitOpenError, OSError):
             for fid in server_fids:
                 results[fid] = False
     return results

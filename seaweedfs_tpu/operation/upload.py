@@ -2,22 +2,31 @@
 
 Reference: weed/operation/upload_content.go:69-191 — multipart POST with
 optional gzip compression, retried; the server answers {name,size,eTag}.
+
+Both directions run under the shared failsafe policy (util/failsafe.py):
+uploads retry only idempotency-safe failures (connect errors and 5xx —
+the body was provably not acknowledged), downloads retry any transient
+failure, and both are breaker-gated per volume server.
 """
 
 from __future__ import annotations
 
 import gzip
 import json
-import time
 import urllib.error
 import urllib.request
 import uuid
 from dataclasses import dataclass
 
 from ..telemetry import trace
+from ..util import failsafe, faultpoint
+from ..util.http_util import netloc as _peer_of
 from ..util.http_util import trace_headers
 
 _COMPRESSIBLE_PREFIXES = ("text/", "application/json", "application/xml")
+
+FP_UPLOAD = faultpoint.register("operation.upload")
+FP_DOWNLOAD = faultpoint.register("operation.download")
 
 
 @dataclass
@@ -62,38 +71,69 @@ def upload_data(
     if jwt:
         headers["Authorization"] = f"BEARER {jwt}"
 
-    last: Exception | None = None
-    for attempt in range(retries):
-        try:
-            with trace.child_span("http.upload", url=url, bytes=len(payload)):
-                # traceparent captured inside the span: the volume
-                # server's span must parent to http.upload, not above it
-                req = urllib.request.Request(
-                    url, data=body, headers=trace_headers(headers),
-                    method="POST")
-                with urllib.request.urlopen(req, timeout=timeout) as resp:
-                    out = json.loads(resp.read() or b"{}")
-            return UploadResult(
-                name=out.get("name", filename),
-                size=out.get("size", len(data)),
-                etag=out.get("eTag", ""),
-                mime=mime,
-                gzipped=gzipped,
-            )
-        except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
-            last = e
-            time.sleep(0.2 * (attempt + 1))
-    raise RuntimeError(f"upload to {url} failed: {last}")
+    def attempt() -> UploadResult:
+        faultpoint.inject(FP_UPLOAD, ctx=url)
+        with trace.child_span("http.upload", url=url, bytes=len(payload)):
+            # traceparent captured inside the span: the volume
+            # server's span must parent to http.upload, not above it
+            req = urllib.request.Request(
+                url, data=body, headers=trace_headers(headers),
+                method="POST")
+            with urllib.request.urlopen(
+                    req, timeout=failsafe.attempt_timeout(timeout)) as resp:
+                out = json.loads(resp.read() or b"{}")
+        return UploadResult(
+            name=out.get("name", filename),
+            size=out.get("size", len(data)),
+            etag=out.get("eTag", ""),
+            mime=mime,
+            gzipped=gzipped,
+        )
+
+    policy = failsafe.RetryPolicy(
+        max_attempts=max(1, retries),
+        base_delay=failsafe.UPLOAD_POLICY.base_delay,
+        max_delay=failsafe.UPLOAD_POLICY.max_delay,
+    )
+    try:
+        return failsafe.call(
+            attempt, op="upload", retry_type="operation",
+            policy=policy, peer=_peer_of(url), idempotent=False,
+        )
+    except Exception as e:
+        raise RuntimeError(f"upload to {url} failed: {e}") from e
 
 
 def download(url: str, timeout: float = 30.0,
-             range_header: str | None = None) -> bytes:
-    with trace.child_span("http.download", url=url):
-        headers = trace_headers(
-            {"Range": range_header} if range_header else {})
-        req = urllib.request.Request(url, headers=headers)
-        with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.read()
+             range_header: str | None = None, retries: int = 3,
+             use_breaker: bool = True) -> bytes:
+    """GET a blob; idempotent, so any transient failure retries.
+
+    `use_breaker=False` skips the per-peer breaker gate — for callers
+    that already gate the peer themselves (failover loops), where a
+    second allow() on the same breaker would starve its own half-open
+    probe."""
+
+    def attempt() -> bytes:
+        with trace.child_span("http.download", url=url):
+            headers = trace_headers(
+                {"Range": range_header} if range_header else {})
+            req = urllib.request.Request(url, headers=headers)
+            with urllib.request.urlopen(
+                    req, timeout=failsafe.attempt_timeout(timeout)) as resp:
+                blob = resp.read()
+        return faultpoint.inject(FP_DOWNLOAD, ctx=url, data=blob)
+
+    policy = failsafe.RetryPolicy(
+        max_attempts=max(1, retries),
+        base_delay=failsafe.DOWNLOAD_POLICY.base_delay,
+        max_delay=failsafe.DOWNLOAD_POLICY.max_delay,
+    )
+    return failsafe.call(
+        attempt, op="download", retry_type="operation",
+        policy=policy, peer=_peer_of(url) if use_breaker else None,
+        idempotent=True,
+    )
 
 
 def _is_compressible(mime: str, filename: str) -> bool:

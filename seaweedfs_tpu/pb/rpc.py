@@ -15,6 +15,7 @@ from typing import Callable
 import grpc
 
 from ..telemetry import trace as _trace
+from ..util import failsafe as _failsafe
 from . import filer_pb2, master_pb2, messaging_pb2, volume_server_pb2
 
 UU, US, SU, SS = "uu", "us", "su", "ss"  # unary/stream request x response
@@ -182,9 +183,9 @@ def _traced_unary(server_type: str, method: str, fn: Callable) -> Callable:
     """Wrap a unary-unary servicer fn with trace adoption + request
     metrics: the caller's `traceparent` rides in as gRPC metadata."""
 
-    def handler(request, context):
-        from ..telemetry import record_op, trace as _trace
+    from ..telemetry import record_op
 
+    def handler(request, context):
         md = {k: v for k, v in (context.invocation_metadata() or ())}
         with _trace.remote_context(md.get(_trace.TRACEPARENT)):
             with record_op(server_type, method):
@@ -329,8 +330,22 @@ class Stub:
             return call(*args, metadata=metadata, **kwargs)
 
         def invoke(*args, **kwargs):
-            if timeout is not None and "timeout" not in kwargs:
-                kwargs["timeout"] = timeout
+            if "timeout" not in kwargs:
+                # deadline propagation: an ambient failsafe.Deadline caps
+                # every nested rpc so a caller's total budget holds across
+                # hops (a 10s stub timeout inside a 2s budget is a lie)
+                effective = timeout
+                dl = _failsafe.current_deadline()
+                if dl is not None:
+                    rem = dl.remaining()
+                    if rem <= 0.0:
+                        # firing a guaranteed-to-fail 1ms rpc would charge
+                        # a DEADLINE_EXCEEDED to a healthy peer's breaker
+                        raise _failsafe.DeadlineExceeded(
+                            f"deadline exceeded before {path}")
+                    effective = rem if effective is None else min(effective, rem)
+                if effective is not None:
+                    kwargs["timeout"] = effective
             if unary_response and _trace.current_context() is not None:
                 # client-side span: only when already inside a trace (a
                 # root span per background heartbeat would flood the

@@ -60,12 +60,18 @@ class Replicator:
         A source that was NEVER reachable raises (an unreachable filer
         must not look like a successful zero-event replication).  A
         stream dropped after traffic — source restart, network blip —
-        RESUBSCRIBES from the last applied event timestamp with a short
-        backoff, the reference's filer.sync reconnect discipline."""
+        RESUBSCRIBES from the last applied event timestamp with the
+        shared capped-jitter backoff (util/failsafe.py), the reference's
+        filer.sync reconnect discipline."""
         import time as _time
 
         import grpc
 
+        from ..telemetry import trace
+        from ..util import failsafe
+
+        backoff = failsafe.Backoff(failsafe.RetryPolicy(
+            max_attempts=1 << 30, base_delay=0.5, max_delay=15.0))
         resume_ns = since_ns
         source_seen = False
         while True:
@@ -87,6 +93,7 @@ class Replicator:
                     signature=self.signature,
                 ):
                     resume_ns = max(resume_ns, resp.ts_ns)
+                    backoff.reset()  # live traffic: next drop starts small
                     if stop_event is not None and stop_event.is_set():
                         return
                     try:
@@ -101,12 +108,16 @@ class Replicator:
                     return
                 if stop_event is not None and stop_event.is_set():
                     return
+                delay = backoff.next()
+                failsafe.RETRY_COUNTER.labels(
+                    "replicator", "subscribe", "stream_drop").inc()
                 glog.warning(
                     "replicate stream from %s dropped (%s); resuming "
-                    "from ts=%d", self.source.filer_http, e.code(),
-                    resume_ns)
+                    "from ts=%d in %.2fs trace=%s",
+                    self.source.filer_http, e.code(), resume_ns, delay,
+                    trace.current_trace_id() or "-")
                 if stop_event is not None:
-                    if stop_event.wait(1.747):
+                    if stop_event.wait(delay):
                         return
                 else:
-                    _time.sleep(1.747)
+                    _time.sleep(delay)

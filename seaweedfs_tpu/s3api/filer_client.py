@@ -16,9 +16,15 @@ import grpc
 
 from ..pb import filer_pb2
 from ..pb import rpc as rpclib
+from ..util import failsafe
 from ..util.http_util import trace_headers
 
 GRPC_PORT_OFFSET = 10000
+
+# the gateway's edge to the filer: bounded retries, no breaker bypass —
+# the filer is the gateway's only backend, so we keep probing it
+_S3_POLICY = failsafe.RetryPolicy(max_attempts=3, base_delay=0.05,
+                                  max_delay=1.0)
 
 
 class FilerUnavailable(IOError):
@@ -41,10 +47,14 @@ class FilerClient:
 
     def find_entry(self, directory: str, name: str) -> filer_pb2.Entry | None:
         try:
-            resp = self.stub().LookupDirectoryEntry(
-                filer_pb2.LookupDirectoryEntryRequest(
-                    directory=directory, name=name
-                )
+            resp = failsafe.call(
+                lambda: self.stub().LookupDirectoryEntry(
+                    filer_pb2.LookupDirectoryEntryRequest(
+                        directory=directory, name=name
+                    )
+                ),
+                op="lookup_entry", retry_type="s3", policy=_S3_POLICY,
+                idempotent=True,
             )
             return resp.entry
         except grpc.RpcError as e:
@@ -61,18 +71,22 @@ class FilerClient:
         limit: int = 1024,
     ) -> list[filer_pb2.Entry]:
         try:
-            return [
-                r.entry
-                for r in self.stub(timeout=60).ListEntries(
-                    filer_pb2.ListEntriesRequest(
-                        directory=directory,
-                        prefix=prefix,
-                        start_from_file_name=start_from,
-                        inclusive_start_from=inclusive,
-                        limit=limit,
+            return failsafe.call(
+                lambda: [
+                    r.entry
+                    for r in self.stub(timeout=60).ListEntries(
+                        filer_pb2.ListEntriesRequest(
+                            directory=directory,
+                            prefix=prefix,
+                            start_from_file_name=start_from,
+                            inclusive_start_from=inclusive,
+                            limit=limit,
+                        )
                     )
-                )
-            ]
+                ],
+                op="list_entries", retry_type="s3", policy=_S3_POLICY,
+                idempotent=True,
+            )
         except grpc.RpcError as e:
             if e.code() == grpc.StatusCode.NOT_FOUND:
                 return []
@@ -146,15 +160,22 @@ class FilerClient:
     # -- bytes ---------------------------------------------------------------
 
     def put_object(self, path: str, data: bytes, mime: str = "") -> None:
-        req = urllib.request.Request(
-            f"http://{self.http_address}{urllib.parse.quote(path)}",
-            data=data,
-            method="PUT",
-            headers=trace_headers(
-                {"Content-Type": mime or "application/octet-stream"}),
-        )
-        with urllib.request.urlopen(req, timeout=120) as r:
-            r.read()
+        # a filer PUT replaces the whole entry, so re-sending after an
+        # ambiguous failure converges on the same result: idempotent
+        def attempt() -> None:
+            req = urllib.request.Request(
+                f"http://{self.http_address}{urllib.parse.quote(path)}",
+                data=data,
+                method="PUT",
+                headers=trace_headers(
+                    {"Content-Type": mime or "application/octet-stream"}),
+            )
+            with urllib.request.urlopen(
+                    req, timeout=failsafe.attempt_timeout(120)) as r:
+                r.read()
+
+        failsafe.call(attempt, op="put_object", retry_type="s3",
+                      policy=_S3_POLICY, idempotent=True)
 
     def put_object_stream(self, path: str, reader, length: int,
                           mime: str = "") -> None:
@@ -190,12 +211,18 @@ class FilerClient:
         headers = trace_headers()
         if range_header:
             headers["Range"] = range_header
-        req = urllib.request.Request(
-            f"http://{self.http_address}{urllib.parse.quote(path)}",
-            headers=headers,
-        )
-        try:
-            with urllib.request.urlopen(req, timeout=120) as r:
+        def attempt() -> tuple[int, dict, bytes]:
+            req = urllib.request.Request(
+                f"http://{self.http_address}{urllib.parse.quote(path)}",
+                headers=headers,
+            )
+            with urllib.request.urlopen(
+                    req, timeout=failsafe.attempt_timeout(120)) as r:
                 return r.status, dict(r.headers), r.read()
+
+        try:
+            return failsafe.call(attempt, op="get_object", retry_type="s3",
+                                 policy=_S3_POLICY, idempotent=True)
         except urllib.error.HTTPError as e:
+            # non-2xx (after any 5xx retries): surface to the S3 layer
             return e.code, dict(e.headers), e.read()

@@ -284,11 +284,11 @@ def serve_metrics(port: int, registry: Registry = REGISTRY,
 
         def do_GET(self):
             path = self.path.split("?")[0]
-            if path == "/debug/traces":
+            if path.startswith("/debug/"):
                 from ..telemetry import serve_debug_http
 
-                serve_debug_http(self, path)
-                return
+                if serve_debug_http(self, path):
+                    return
             if path != "/metrics":
                 self.send_response(404)
                 self.send_header("Content-Length", "0")

@@ -6,6 +6,7 @@ HTTP request instrumentation used by master/volume/filer/S3).
 
 from . import trace  # noqa: F401
 from .middleware import (  # noqa: F401
+    DEBUG_FAULTS_PATH,
     DEBUG_TRACES_PATH,
     METRICS_PATH,
     SLOW_REQUEST_SECONDS,
@@ -32,5 +33,6 @@ __all__ = [
     "parse_traceparent", "remote_context", "start_span",
     "traceparent_header", "wrap_context", "http_request", "record_op",
     "debug_traces_body", "serve_debug_http",
-    "DEBUG_TRACES_PATH", "METRICS_PATH", "SLOW_REQUEST_SECONDS",
+    "DEBUG_FAULTS_PATH", "DEBUG_TRACES_PATH", "METRICS_PATH",
+    "SLOW_REQUEST_SECONDS",
 ]

@@ -28,6 +28,7 @@ SLOW_REQUEST_SECONDS = float(
     os.environ.get("SEAWEEDFS_TPU_SLOW_REQUEST_S", "1.0"))
 
 DEBUG_TRACES_PATH = "/debug/traces"
+DEBUG_FAULTS_PATH = "/debug/faults"
 METRICS_PATH = "/metrics"
 
 
@@ -69,17 +70,39 @@ def debug_traces_body(limit: int = 50) -> bytes:
 
 
 def serve_debug_http(handler, path: str) -> bool:
-    """Answer /metrics or /debug/traces on a BaseHTTPRequestHandler.
+    """Answer /metrics, /debug/traces or /debug/faults on a
+    BaseHTTPRequestHandler.
 
     The one implementation of the observability surface every server
     type mounts on its main HTTP port; returns True when `path` was one
-    of the two endpoints (response fully written), False otherwise."""
+    of the endpoints (response fully written), False otherwise."""
     if path == DEBUG_TRACES_PATH:
         body, ctype = debug_traces_body(), "application/json"
     elif path == METRICS_PATH:
         from ..stats.metrics import REGISTRY
 
         body, ctype = REGISTRY.render().encode(), "text/plain; version=0.0.4"
+    elif path == DEBUG_FAULTS_PATH:
+        import json
+        import urllib.parse
+
+        from ..util import faultpoint
+
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlparse(handler.path).query)
+        try:
+            state = faultpoint.handle_debug_request(query)
+        except (ValueError, PermissionError) as e:
+            body = json.dumps({"error": str(e)}).encode()
+            handler.send_response(403 if isinstance(e, PermissionError)
+                                  else 400)
+            handler.send_header("Content-Type", "application/json")
+            handler.send_header("Content-Length", str(len(body)))
+            handler.end_headers()
+            if handler.command != "HEAD":
+                handler.wfile.write(body)
+            return True
+        body, ctype = json.dumps(state).encode(), "application/json"
     else:
         return False
     handler.send_response(200)

@@ -57,6 +57,16 @@ def trace_headers(headers: dict | None = None) -> dict:
     return out
 
 
+def netloc(url: str) -> str:
+    """host:port of a URL (or of a bare host:port string) — the breaker /
+    location-cache key every failover path shares."""
+    import urllib.parse
+
+    if "//" not in url:
+        return url.split("/", 1)[0]
+    return urllib.parse.urlsplit(url).netloc
+
+
 GRPC_PORT_OFFSET = 10000
 
 

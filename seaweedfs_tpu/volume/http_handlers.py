@@ -21,6 +21,12 @@ from ..security.jwt import token_from_header, verify_write_jwt
 from ..telemetry import http_request, serve_debug_http
 from ..storage.file_id import FileId
 from ..storage.needle import FLAG_HAS_MIME, FLAG_HAS_NAME, Needle
+from ..util import faultpoint
+
+# chaos points on the public data path; ctx is this server's host:port so
+# one server out of several in-process can be targeted via &match=
+FP_GET = faultpoint.register("volume.http.get")
+FP_POST = faultpoint.register("volume.http.post")
 
 
 class VolumeHttpHandler(BaseHTTPRequestHandler):
@@ -114,6 +120,8 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
                 )
             return self._send_json(404, {"error": f"volume {fid.volume_id} not found"})
         try:
+            me = f"{self.volume_server.ip}:{self.volume_server.port}"
+            faultpoint.inject(FP_GET, ctx=me)
             n = self.store.read_needle(fid.volume_id, fid.key)
         except KeyError:
             return self._send_json(404, {"error": "not found"})
@@ -190,6 +198,13 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
         data = body
         if ctype.startswith("multipart/form-data"):
             data, name, mime = _parse_multipart(body, ctype)
+        try:
+            # chaos point: error -> 500 before any write, delay -> slow
+            # ack, partial -> the needle stores a truncated body
+            me = f"{self.volume_server.ip}:{self.volume_server.port}"
+            data = faultpoint.inject(FP_POST, ctx=me, data=data)
+        except faultpoint.FaultInjected as e:
+            return self._send_json(500, {"error": str(e)})
         n = Needle(cookie=fid.cookie, id=fid.key, data=data)
         if name:
             n.set(FLAG_HAS_NAME)

@@ -15,6 +15,7 @@ import grpc
 
 from ..pb import master_pb2
 from ..pb import rpc as rpclib
+from ..util import failsafe
 from .vid_map import Location, VidMap
 
 
@@ -49,6 +50,8 @@ class MasterClient:
 
     def _keep_connected_loop(self) -> None:
         i = 0
+        backoff = failsafe.Backoff(failsafe.RetryPolicy(
+            max_attempts=1 << 30, base_delay=0.25, max_delay=5.0))
         while not self._stop.is_set():
             if self._leader_hint and self._leader_hint in self.masters:
                 master = self._leader_hint
@@ -60,8 +63,10 @@ class MasterClient:
                 self._stream_from(master)
             except grpc.RpcError:
                 pass
+            if self._connected.is_set():
+                backoff.reset()  # the stream was live; reconnect fast
             self._connected.clear()
-            self._stop.wait(0.5)
+            self._stop.wait(backoff.next())
 
     def _stream_from(self, master: str) -> None:
         stub = rpclib.master_stub(master)
@@ -98,33 +103,60 @@ class MasterClient:
 
     # -- lookups ----------------------------------------------------------
 
-    def lookup_volume(self, vid: int) -> list[Location]:
-        locs = self.vid_map.lookup(vid)
-        if locs:
-            return locs
-        # cache miss: ask a master directly
-        for master in self._master_order():
-            try:
-                resp = rpclib.master_stub(master, timeout=10).LookupVolume(
-                    master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)])
-                )
-            except grpc.RpcError:
-                continue
-            for vl in resp.volume_id_locations:
-                for l in vl.locations:
-                    self.vid_map.add_location(
-                        vid, Location(l.url, l.public_url or l.url)
-                    )
-            return self.vid_map.lookup(vid)
-        return []
+    def lookup_volume(self, vid: int, refresh: bool = False) -> list[Location]:
+        """Locations serving vid; `refresh=True` bypasses the cache (used
+        after a cached location turned out dead — the volume may have
+        moved or been EC-encoded, and the master's answer reflects that).
 
-    def lookup_file_id(self, fid: str) -> list[str]:
+        A LookupVolume failure rotates to the next master under the
+        shared failover policy instead of failing the request."""
+        if not refresh:
+            locs = self.vid_map.lookup(vid)
+            if locs:
+                return locs
+
+        def ask(master: str) -> master_pb2.LookupVolumeResponse:
+            return rpclib.master_stub(master, timeout=10).LookupVolume(
+                master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)])
+            )
+
+        try:
+            resp = failsafe.call_with_failover(
+                lambda _round: self._master_order(), ask,
+                op="lookup_volume", retry_type="masterClient",
+                policy=failsafe.RPC_POLICY, idempotent=True,
+            )
+        except (grpc.RpcError, failsafe.CircuitOpenError, OSError):
+            # every master refused/errored: a stale cached answer (even
+            # the one we bypassed) beats none at all
+            return self.vid_map.lookup(vid)
+        if refresh:
+            self.vid_map.delete_volume(vid)
+        for vl in resp.volume_id_locations:
+            for l in vl.locations:
+                self.vid_map.add_location(
+                    vid, Location(l.url, l.public_url or l.url)
+                )
+        return self.vid_map.lookup(vid)
+
+    def lookup_file_id(self, fid: str, refresh: bool = False) -> list[str]:
         """-> public urls serving this file id."""
         vid = int(fid.split(",", 1)[0])
         return [
             f"http://{l.public_url or l.url}/{fid}"
-            for l in self.lookup_volume(vid)
+            for l in self.lookup_volume(vid, refresh=refresh)
         ]
+
+    def invalidate_location(self, vid: int, url_or_netloc: str) -> None:
+        """Evict one cached location of vid — called when a connection to
+        that server was REFUSED (the process is gone; waiting out a TTL
+        would keep routing reads into a dead peer)."""
+        from ..util.http_util import netloc
+
+        server = netloc(url_or_netloc)
+        for loc in list(self.vid_map.lookup(vid)):
+            if server in (loc.url, loc.public_url):
+                self.vid_map.delete_location(vid, loc.url)
 
     def _master_order(self) -> list[str]:
         if self.current_master:

@@ -51,6 +51,10 @@ class VidMap:
             if not locs:
                 del self._locations[vid]
 
+    def delete_volume(self, vid: int) -> None:
+        with self._lock:
+            self._locations.pop(vid, None)
+
     def delete_server(self, url: str) -> None:
         with self._lock:
             for vid in list(self._locations):

@@ -14,6 +14,25 @@ To run the suite against real hardware instead, set SEAWEEDFS_TPU_TEST_REAL=1
 
 import os
 
+import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from the tier-1 run")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection chaos suite; run separately with -m chaos")
+
+
+def pytest_collection_modifyitems(config, items):
+    # chaos tests imply slow: tier-1 (-m 'not slow') stays fast and
+    # deterministic, while `-m chaos` selects exactly the chaos suite
+    for item in items:
+        if "chaos" in item.keywords:
+            item.add_marker(pytest.mark.slow)
+
+
 if not os.environ.get("SEAWEEDFS_TPU_TEST_REAL"):
     os.environ["JAX_PLATFORMS"] = "cpu"
     flags = os.environ.get("XLA_FLAGS", "")

@@ -1,0 +1,412 @@
+"""Unit tests for the unified fault-tolerance layer (util/failsafe.py):
+backoff math, deadlines, failure classification, circuit breakers and
+the retry/failover loops — all with fake clocks/rngs, no sockets.
+"""
+
+import random
+import urllib.error
+
+import grpc
+import pytest
+
+from seaweedfs_tpu.util import failsafe
+
+
+@pytest.fixture(autouse=True)
+def _clean_breakers():
+    failsafe.reset_breakers()
+    yield
+    failsafe.reset_breakers()
+
+
+def _retry_count(rtype, op, reason) -> float:
+    return failsafe.RETRY_COUNTER.labels(rtype, op, reason).value
+
+
+# -- backoff ----------------------------------------------------------------
+
+
+def test_full_jitter_bounds():
+    p = failsafe.RetryPolicy(base_delay=0.1, max_delay=2.0)
+    rng = random.Random(7)
+    for attempt in range(12):
+        cap = min(2.0, 0.1 * 2 ** attempt)
+        for _ in range(50):
+            d = p.delay(attempt, rng)
+            assert 0.0 <= d <= cap
+
+
+def test_backoff_survives_very_long_outages():
+    """Open-ended reconnect loops call next() forever; 2.0**attempt must
+    not overflow a float after ~17 minutes-to-hours of retrying."""
+    b = failsafe.Backoff(failsafe.RetryPolicy(base_delay=0.5, max_delay=15.0))
+    b.attempt = 5000
+    d = b.next()
+    assert 0.0 <= d <= 15.0
+
+
+def test_backoff_grows_and_resets():
+    rng = random.Random(1)
+    b = failsafe.Backoff(
+        failsafe.RetryPolicy(base_delay=1.0, max_delay=64.0), rng=rng)
+    # caps grow 1,2,4,...; a draw can be small, but the CAP must grow:
+    for i in range(5):
+        assert b.policy.delay(i, random.Random(0)) <= 1.0 * 2 ** i
+        b.next()
+    assert b.attempt == 5
+    b.reset()
+    assert b.attempt == 0
+
+
+# -- deadlines --------------------------------------------------------------
+
+
+def test_deadline_scope_clamps_attempt_timeout():
+    assert failsafe.current_deadline() is None
+    assert failsafe.attempt_timeout(30.0) == 30.0
+    with failsafe.deadline_scope(0.5):
+        t = failsafe.attempt_timeout(30.0)
+        assert t is not None and t <= 0.5
+        # no default: the remaining budget is the timeout
+        assert failsafe.attempt_timeout(None) <= 0.5
+    assert failsafe.current_deadline() is None
+
+
+def test_nested_deadline_takes_tighter():
+    with failsafe.deadline_scope(0.2) as outer:
+        with failsafe.deadline_scope(60.0) as inner:
+            assert inner is outer  # the outer, tighter budget wins
+
+
+def test_expired_deadline_raises():
+    clock = {"t": 0.0}
+    dl = failsafe.Deadline(1.0, clock=lambda: clock["t"])
+    assert dl.remaining() == 1.0
+    clock["t"] = 2.0
+    assert dl.expired
+    tok = failsafe._deadline_var.set(dl)
+    try:
+        with pytest.raises(failsafe.DeadlineExceeded):
+            failsafe.attempt_timeout(5.0)
+    finally:
+        failsafe._deadline_var.reset(tok)
+
+
+# -- classification ---------------------------------------------------------
+
+
+@pytest.mark.parametrize("exc,idem,reason,retryable", [
+    (ConnectionRefusedError(), False, "refused", True),
+    (ConnectionResetError(), False, "reset", False),
+    (ConnectionResetError(), True, "reset", True),
+    (TimeoutError(), False, "timeout", False),
+    (TimeoutError(), True, "timeout", True),
+    (urllib.error.HTTPError("u", 500, "boom", {}, None), False, "http_500", True),
+    (urllib.error.HTTPError("u", 503, "boom", {}, None), False, "http_503", True),
+    (urllib.error.HTTPError("u", 404, "nf", {}, None), True, "http_404", False),
+    (urllib.error.URLError(ConnectionRefusedError()), False, "refused", True),
+    (ValueError("nope"), True, "error", False),
+])
+def test_classify_table(exc, idem, reason, retryable):
+    assert failsafe.classify(exc, idem) == (reason, retryable)
+
+
+def test_classify_grpc_unavailable():
+    class Err(grpc.RpcError):
+        def code(self):
+            return grpc.StatusCode.UNAVAILABLE
+
+    assert failsafe.classify(Err(), False) == ("unavailable", True)
+
+
+def test_classify_grpc_not_leader_rotates():
+    class Err(grpc.RpcError):
+        def code(self):
+            return grpc.StatusCode.FAILED_PRECONDITION
+
+    reason, retryable = failsafe.classify(Err(), True)
+    assert retryable, "not-leader must rotate to the next master"
+
+
+def test_is_connection_refused_unwraps_urlerror():
+    assert failsafe.is_connection_refused(ConnectionRefusedError())
+    assert failsafe.is_connection_refused(
+        urllib.error.URLError(ConnectionRefusedError()))
+    assert not failsafe.is_connection_refused(TimeoutError())
+    assert not failsafe.is_connection_refused(
+        urllib.error.HTTPError("u", 500, "b", {}, None))
+
+
+# -- call(): single-peer retry loop -----------------------------------------
+
+
+def test_call_retries_transient_then_succeeds():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ConnectionRefusedError()
+        return "ok"
+
+    before = _retry_count("t", "op1", "refused")
+    got = failsafe.call(
+        flaky, op="op1", retry_type="t",
+        policy=failsafe.RetryPolicy(max_attempts=5, base_delay=0.0,
+                                    max_delay=0.0))
+    assert got == "ok" and calls["n"] == 3
+    assert _retry_count("t", "op1", "refused") == before + 2
+
+
+def test_call_nonretryable_raises_immediately():
+    calls = {"n": 0}
+
+    def fatal():
+        calls["n"] += 1
+        raise ValueError("bad request")
+
+    with pytest.raises(ValueError):
+        failsafe.call(fatal, op="op2", retry_type="t",
+                      policy=failsafe.RetryPolicy(max_attempts=5,
+                                                  base_delay=0.0))
+    assert calls["n"] == 1
+
+
+def test_call_exhausts_attempts():
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise ConnectionRefusedError()
+
+    with pytest.raises(ConnectionRefusedError):
+        failsafe.call(always, op="op3", retry_type="t",
+                      policy=failsafe.RetryPolicy(max_attempts=3,
+                                                  base_delay=0.0,
+                                                  max_delay=0.0))
+    assert calls["n"] == 3
+
+
+def test_call_nonidempotent_does_not_retry_timeout():
+    calls = {"n": 0}
+
+    def times_out():
+        calls["n"] += 1
+        raise TimeoutError()
+
+    with pytest.raises(TimeoutError):
+        failsafe.call(times_out, op="op4", retry_type="t", idempotent=False,
+                      policy=failsafe.RetryPolicy(max_attempts=5,
+                                                  base_delay=0.0))
+    assert calls["n"] == 1
+
+
+# -- circuit breaker --------------------------------------------------------
+
+
+def test_breaker_full_state_machine():
+    clock = {"t": 0.0}
+    br = failsafe.CircuitBreaker("peer:1", failure_threshold=3,
+                                 reset_timeout=10.0,
+                                 clock=lambda: clock["t"])
+    assert br.state == failsafe.CLOSED and br.allow()
+    for _ in range(2):
+        br.record_failure()
+    assert br.state == failsafe.CLOSED, "below threshold stays closed"
+    br.record_failure()
+    assert br.state == failsafe.OPEN
+    assert not br.allow()
+    assert failsafe.CIRCUIT_STATE.labels("peer:1").value == 1.0
+
+    # reset_timeout elapses -> half-open admits exactly one probe
+    clock["t"] = 11.0
+    assert br.allow()
+    assert br.state == failsafe.HALF_OPEN
+    assert failsafe.CIRCUIT_STATE.labels("peer:1").value == 2.0
+    assert not br.allow(), "second concurrent probe must be rejected"
+
+    # failed probe -> back to open for another full reset_timeout
+    br.record_failure()
+    assert br.state == failsafe.OPEN
+    clock["t"] = 15.0
+    assert not br.allow()
+    clock["t"] = 22.0
+    assert br.allow()
+
+    # successful probe -> closed, gauge back to 0
+    br.record_success()
+    assert br.state == failsafe.CLOSED
+    assert failsafe.CIRCUIT_STATE.labels("peer:1").value == 0.0
+    assert br.allow()
+
+
+def test_half_open_probe_released_on_spent_deadline():
+    """A DeadlineExceeded after allow() admitted the half-open probe must
+    free the probe slot — otherwise the breaker wedges open forever."""
+    clock = {"t": 0.0}
+    br = failsafe.CircuitBreaker("peer:dl", failure_threshold=1,
+                                 reset_timeout=1.0, clock=lambda: clock["t"])
+    br.record_failure()
+    assert br.state == failsafe.OPEN
+    clock["t"] = 2.0
+
+    failsafe._breakers["peer:dl"] = br  # route call() to this instance
+    try:
+        def spent():
+            raise failsafe.DeadlineExceeded("budget gone")
+
+        with pytest.raises(failsafe.DeadlineExceeded):
+            failsafe.call(spent, op="dl", retry_type="t", peer="peer:dl",
+                          policy=failsafe.RetryPolicy(max_attempts=1))
+        # probe slot freed and the peer not blamed: the next caller can
+        # probe and a success closes the breaker
+        assert br.allow()
+        br.record_success()
+        assert br.state == failsafe.CLOSED
+    finally:
+        failsafe._breakers.pop("peer:dl", None)
+
+
+def test_breaker_success_resets_failure_run():
+    br = failsafe.CircuitBreaker("peer:2", failure_threshold=3)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == failsafe.CLOSED, "non-consecutive failures don't trip"
+
+
+def test_breaker_registry_reuses_instances():
+    a = failsafe.breaker_for("x:1")
+    assert failsafe.breaker_for("x:1") is a
+    assert failsafe.breaker_for("x:2") is not a
+
+
+# -- call_with_failover ------------------------------------------------------
+
+
+def test_failover_rotates_to_next_peer():
+    seen = []
+
+    def fn(peer):
+        seen.append(peer)
+        if peer == "a":
+            raise ConnectionRefusedError()
+        return f"ok-{peer}"
+
+    got = failsafe.call_with_failover(
+        ["a", "b"], fn, op="fo1", retry_type="t",
+        policy=failsafe.RetryPolicy(max_attempts=2, base_delay=0.0,
+                                    max_delay=0.0))
+    assert got == "ok-b" and seen == ["a", "b"]
+
+
+def test_failover_nonretryable_still_rotates():
+    """One replica answering an authoritative error (404, cookie
+    mismatch) says nothing about the others: rotation must continue and
+    the healthy copy must win."""
+    seen = []
+
+    def fn(peer):
+        seen.append(peer)
+        if peer == "a":
+            raise ValueError("this copy says no")
+        return f"ok-{peer}"
+
+    got = failsafe.call_with_failover(
+        ["a", "b"], fn, op="fo2", retry_type="t",
+        policy=failsafe.RetryPolicy(max_attempts=1, base_delay=0.0))
+    assert got == "ok-b" and seen == ["a", "b"]
+
+    # when EVERY peer refuses authoritatively, the last error surfaces
+    with pytest.raises(ValueError):
+        failsafe.call_with_failover(
+            ["a"], lambda p: (_ for _ in ()).throw(ValueError("no")),
+            op="fo2", retry_type="t",
+            policy=failsafe.RetryPolicy(max_attempts=1, base_delay=0.0))
+
+
+def test_failover_spent_deadline_aborts_without_blaming_peers():
+    clock = {"t": 0.0}
+    dl = failsafe.Deadline(1.0, clock=lambda: clock["t"])
+    clock["t"] = 2.0  # budget already gone
+    tok = failsafe._deadline_var.set(dl)
+    try:
+        def fn(peer):
+            failsafe.attempt_timeout(5.0)  # raises DeadlineExceeded
+            return "never"
+
+        with pytest.raises(failsafe.DeadlineExceeded):
+            failsafe.call_with_failover(
+                ["da", "db"], fn, op="fo-dl", retry_type="t",
+                policy=failsafe.RetryPolicy(max_attempts=2, base_delay=0.0))
+    finally:
+        failsafe._deadline_var.reset(tok)
+    # the peers were never actually contacted: breakers stay pristine
+    assert failsafe.breaker_for("da").state == failsafe.CLOSED
+    assert failsafe.breaker_for("da")._consecutive_failures == 0
+
+
+def test_failover_refreshes_peers_between_rounds():
+    rounds = []
+
+    def peers(round_no):
+        rounds.append(round_no)
+        return ["a"] if round_no == 0 else ["b"]
+
+    def fn(peer):
+        if peer == "a":
+            raise ConnectionRefusedError()
+        return peer
+
+    got = failsafe.call_with_failover(
+        peers, fn, op="fo3", retry_type="t",
+        policy=failsafe.RetryPolicy(max_attempts=3, base_delay=0.0,
+                                    max_delay=0.0))
+    assert got == "b" and rounds == [0, 1]
+
+
+def test_failover_skips_open_breaker_but_probes_when_all_open():
+    # trip both peers' breakers
+    for peer in ("p1", "p2"):
+        br = failsafe.breaker_for(peer)
+        for _ in range(failsafe.BREAKER_FAILURE_THRESHOLD):
+            br.record_failure()
+        assert br.state == failsafe.OPEN
+
+    calls = []
+
+    def fn(peer):
+        calls.append(peer)
+        return "revived"
+
+    # every breaker open: the loop must force-probe rather than wedge
+    got = failsafe.call_with_failover(
+        ["p1", "p2"], fn, op="fo4", retry_type="t",
+        policy=failsafe.RetryPolicy(max_attempts=1, base_delay=0.0))
+    assert got == "revived" and calls == ["p1"]
+    assert failsafe.breaker_for("p1").state == failsafe.CLOSED
+
+
+def test_failover_peer_key_aggregates_breaker_state():
+    urls = ["http://h:1/fid-a", "http://h:1/fid-b"]
+
+    def fn(url):
+        raise ConnectionRefusedError()
+
+    with pytest.raises(ConnectionRefusedError):
+        failsafe.call_with_failover(
+            urls, fn, op="fo5", retry_type="t",
+            policy=failsafe.RetryPolicy(max_attempts=3, base_delay=0.0,
+                                        max_delay=0.0),
+            peer_key=lambda u: u.split("/")[2])
+    br = failsafe.breaker_for("h:1")
+    assert br.state == failsafe.OPEN, "6 failures on one host must trip it"
+
+
+def test_failover_empty_peer_list():
+    with pytest.raises(failsafe.CircuitOpenError):
+        failsafe.call_with_failover(
+            [], lambda p: p, op="fo6", retry_type="t",
+            policy=failsafe.RetryPolicy(max_attempts=2, base_delay=0.0))

@@ -1,0 +1,186 @@
+"""Fault-injection framework tests: registry semantics, env parsing, the
+injection modes, match scoping, and the /debug/faults HTTP surface.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.util import faultpoint
+from seaweedfs_tpu.util.faultpoint import FaultInjected, FaultRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_faults():
+    faultpoint.clear_fault("all")
+    yield
+    faultpoint.clear_fault("all")
+
+
+def _fired(point: str) -> float:
+    return faultpoint.FAULT_COUNTER.labels(point).value
+
+
+# -- registry semantics ------------------------------------------------------
+
+
+def test_unarmed_point_is_passthrough():
+    r = FaultRegistry()
+    r.register("p.a")
+    assert r.inject("p.a", data=b"xyz") == b"xyz"
+    assert r.inject("p.a") is None
+
+
+def test_error_mode_counts_down():
+    r = FaultRegistry()
+    r.set("p.b", "error", count=2)
+    for _ in range(2):
+        with pytest.raises(FaultInjected):
+            r.inject("p.b")
+    # exhausted: back to passthrough
+    assert r.inject("p.b", data=b"ok") == b"ok"
+
+
+def test_delay_mode_sleeps_then_passes_data():
+    r = FaultRegistry()
+    r.set("p.c", "delay", delay=0.05, count=1)
+    t0 = time.perf_counter()
+    assert r.inject("p.c", data=b"d") == b"d"
+    assert time.perf_counter() - t0 >= 0.04
+    # count exhausted: no further delay
+    t0 = time.perf_counter()
+    r.inject("p.c")
+    assert time.perf_counter() - t0 < 0.04
+
+
+def test_partial_mode_truncates():
+    r = FaultRegistry()
+    r.set("p.d", "partial")
+    assert r.inject("p.d", data=b"12345678") == b"1234"
+    # without data to truncate, partial degrades to an error
+    with pytest.raises(FaultInjected):
+        r.inject("p.d")
+
+
+def test_match_scopes_to_context():
+    r = FaultRegistry()
+    r.set("p.e", "error", match="127.0.0.1:8081")
+    # other servers pass through
+    assert r.inject("p.e", ctx="127.0.0.1:8080", data=b"x") == b"x"
+    with pytest.raises(FaultInjected):
+        r.inject("p.e", ctx="127.0.0.1:8081")
+
+
+def test_clear_disarms():
+    r = FaultRegistry()
+    r.set("p.f", "error")
+    r.clear("p.f")
+    assert r.inject("p.f", data=b"x") == b"x"
+    r.set("p.g", "error")
+    r.set("p.h", "error")
+    r.clear("all")
+    assert r.state()["armed"] == {}
+
+
+def test_bad_mode_rejected():
+    r = FaultRegistry()
+    with pytest.raises(ValueError):
+        r.set("p.i", "explode")
+
+
+# -- env parsing -------------------------------------------------------------
+
+
+def test_load_env_formats():
+    r = FaultRegistry()
+    r.load_env("a=error:3, b=delay:0.25, c=delay:0.1:2, d=error, ,junk")
+    armed = r.state()["armed"]
+    assert armed["a"] == {"mode": "error", "delay": 0.0, "remaining": 3,
+                          "match": ""}
+    assert armed["b"]["mode"] == "delay" and armed["b"]["delay"] == 0.25
+    assert armed["b"]["remaining"] == -1
+    assert armed["c"]["remaining"] == 2
+    assert armed["d"]["remaining"] == -1
+    assert "junk" not in armed
+
+
+def test_load_env_bad_entries_skipped():
+    r = FaultRegistry()
+    r.load_env("x=delay:abc,y=error:1")
+    armed = r.state()["armed"]
+    assert "x" not in armed and "y" in armed
+
+
+# -- metrics -----------------------------------------------------------------
+
+
+def test_fault_counter_increments():
+    before = _fired("p.metric")
+    faultpoint.set_fault("p.metric", "error", count=1)
+    with pytest.raises(FaultInjected):
+        faultpoint.inject("p.metric")
+    assert _fired("p.metric") == before + 1
+    # passthrough (exhausted) does not count
+    faultpoint.inject("p.metric")
+    assert _fired("p.metric") == before + 1
+
+
+# -- /debug/faults HTTP surface ---------------------------------------------
+
+
+def test_debug_faults_endpoint_roundtrip(monkeypatch):
+    import seaweedfs_tpu.operation.upload  # noqa: F401 - registers points
+    from seaweedfs_tpu.stats.metrics import serve_metrics
+
+    from helpers import free_port
+
+    port = free_port()
+    httpd = serve_metrics(port, host="127.0.0.1")
+    base = f"http://127.0.0.1:{port}/debug/faults"
+    try:
+        # runtime arming is opt-in: without the flag, ?set= answers 403
+        # (plain listing stays open, like /metrics)
+        monkeypatch.delenv(faultpoint.ENABLE_VAR, raising=False)
+        monkeypatch.delenv(faultpoint.ENV_VAR, raising=False)
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(f"{base}?set=x&mode=error", timeout=5)
+        assert exc_info.value.code == 403
+        with urllib.request.urlopen(base, timeout=5) as r:
+            assert json.loads(r.read())["armed"] == {}
+
+        monkeypatch.setenv(faultpoint.ENABLE_VAR, "1")
+        # arm via query string
+        with urllib.request.urlopen(
+            f"{base}?set=volume.http.get&mode=error&count=3"
+            "&match=127.0.0.1:9999", timeout=5,
+        ) as r:
+            state = json.loads(r.read())
+        assert state["armed"]["volume.http.get"] == {
+            "mode": "error", "delay": 0.0, "remaining": 3,
+            "match": "127.0.0.1:9999",
+        }
+        # the registered points from module imports are listed
+        assert "operation.upload" in state["registered"]
+
+        # plain GET lists without mutating
+        with urllib.request.urlopen(base, timeout=5) as r:
+            state = json.loads(r.read())
+        assert state["armed"]["volume.http.get"]["remaining"] == 3
+
+        # clear
+        with urllib.request.urlopen(f"{base}?clear=volume.http.get",
+                                    timeout=5) as r:
+            state = json.loads(r.read())
+        assert state["armed"] == {}
+
+        # bad numbers answer 400
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(f"{base}?set=x&mode=error&count=banana",
+                                   timeout=5)
+        assert exc_info.value.code == 400
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
