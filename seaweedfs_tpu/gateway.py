@@ -19,11 +19,10 @@ import json
 import threading
 import urllib.error
 import urllib.parse
-import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .util.httpd import FrameworkHTTPServer
 
-from .util import glog
+from .util import connpool, glog
 
 
 class GatewayServer:
@@ -136,8 +135,8 @@ class GatewayHandler(BaseHTTPRequestHandler):
 
         data = self._body()
         master = self.gw.master()
-        with urllib.request.urlopen(
-                f"http://{master}/dir/assign", timeout=30) as r:
+        with connpool.request(
+                "GET", f"http://{master}/dir/assign", timeout=30) as r:
             a = json.loads(r.read())
         if a.get("error"):
             return self._send_json(500, {"error": a["error"]})
@@ -153,8 +152,8 @@ class GatewayHandler(BaseHTTPRequestHandler):
         from .pb import master_pb2
 
         master = self.gw.master()
-        with urllib.request.urlopen(
-                f"http://{master}/dir/lookup?volumeId={vid}",
+        with connpool.request(
+                "GET", f"http://{master}/dir/lookup?volumeId={vid}",
                 timeout=30) as r:
             locations = json.loads(r.read()).get("locations", [])
         return [master_pb2.Location(url=loc["url"],
@@ -177,14 +176,12 @@ class GatewayHandler(BaseHTTPRequestHandler):
         filer = self.gw.filer()
         data = self._body() if method == "PUT" else None
         qs = f"?{query}" if query else ""
-        req = urllib.request.Request(
-            f"http://{filer}{urllib.parse.quote(path)}{qs}", data=data,
-            method=method,
-            headers={"Content-Type":
-                     self.headers.get("Content-Type")
-                     or "application/octet-stream"} if data else {})
+        headers = ({"Content-Type": self.headers.get("Content-Type")
+                    or "application/octet-stream"} if data else {})
         try:
-            with urllib.request.urlopen(req, timeout=120) as r:
+            with connpool.request(
+                    method, f"http://{filer}{urllib.parse.quote(path)}{qs}",
+                    body=data, headers=headers, timeout=120) as r:
                 body = r.read()
                 self.send_response(r.status)
                 ct = r.headers.get("Content-Type", "application/json")
@@ -202,8 +199,8 @@ class GatewayHandler(BaseHTTPRequestHandler):
         filer = self.gw.filer()
         url = (f"http://{filer}/topics/{urllib.parse.quote(topic_path)}"
                f"/messages.log?op=append")
-        req = urllib.request.Request(url, data=data, method="POST",
-                                     headers={"Content-Type":
-                                              "application/octet-stream"})
-        with urllib.request.urlopen(req, timeout=60) as r:
+        with connpool.request(
+                "POST", url, body=data,
+                headers={"Content-Type": "application/octet-stream"},
+                timeout=60) as r:
             self._send_json(r.status, json.loads(r.read() or b"{}"))

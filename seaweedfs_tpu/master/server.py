@@ -152,7 +152,7 @@ class MasterServer:
         ).hexdigest()
 
     def _raft_send(self, peer: str, msg: dict) -> dict | None:
-        import urllib.request
+        from ..util import connpool
 
         payload = json.dumps(msg).encode()
         headers = {"Content-Type": "application/json"}
@@ -160,10 +160,9 @@ class MasterServer:
             # consensus messages forge cluster state; sign them with the
             # same shared secret that protects writes (security/jwt.go)
             headers["X-Raft-Signature"] = self._raft_sig(payload)
-        req = urllib.request.Request(
-            f"http://{peer}/cluster/raft", data=payload, headers=headers
-        )
-        with urllib.request.urlopen(req, timeout=1.0) as r:
+        with connpool.request(
+                "POST", f"http://{peer}/cluster/raft", body=payload,
+                headers=headers, timeout=1.0) as r:
             return json.loads(r.read())
 
     def verify_raft_request(self, payload: bytes, signature: str) -> bool:

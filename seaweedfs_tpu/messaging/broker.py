@@ -20,13 +20,12 @@ import struct
 import threading
 import urllib.error
 import urllib.parse
-import urllib.request
 
 import grpc
 
 from ..pb import messaging_pb2 as mq
 from ..pb import rpc as rpclib
-from ..util import glog
+from ..util import connpool, glog
 
 TOPICS_DIR = "/topics"
 
@@ -72,9 +71,9 @@ class TopicPartition:
         try:
             url = (f"http://{self.filer_http}"
                    f"{urllib.parse.quote(self.filer_path)}")
-            with urllib.request.urlopen(url, timeout=30) as r:
+            with connpool.request("GET", url, timeout=30) as r:
                 blob = r.read()
-        except (urllib.error.HTTPError, urllib.error.URLError):
+        except OSError:  # incl. HTTPError / connection refused
             return
         pos = 0
         while pos + 4 <= len(blob):
@@ -102,11 +101,12 @@ class TopicPartition:
             data = b"".join(pending)
             url = (f"http://{self.filer_http}"
                    f"{urllib.parse.quote(self.filer_path)}?op=append")
-            req = urllib.request.Request(url, data=data, method="POST",
-                                         headers={"Content-Type":
-                                                  "application/octet-stream"})
             try:
-                with urllib.request.urlopen(req, timeout=30) as r:
+                with connpool.request(
+                        "POST", url, body=data,
+                        headers={"Content-Type":
+                                 "application/octet-stream"},
+                        timeout=30) as r:
                     r.read()
             except Exception as e:
                 glog.warning("broker: persist %s failed: %s", self.key, e)
@@ -327,9 +327,8 @@ class MessageBrokerServer:
             url = (f"http://{self.filer_http}"
                    f"{urllib.parse.quote(f'{TOPICS_DIR}/{ns}/{topic}')}"
                    "?recursive=true&ignoreRecursiveError=true")
-            req = urllib.request.Request(url, method="DELETE")
             try:
-                with urllib.request.urlopen(req, timeout=30) as r:
+                with connpool.request("DELETE", url, timeout=30) as r:
                     r.read()
             except urllib.error.HTTPError:
                 pass

@@ -13,13 +13,11 @@ from __future__ import annotations
 
 import gzip
 import json
-import urllib.error
-import urllib.request
 import uuid
 from dataclasses import dataclass
 
 from ..telemetry import trace
-from ..util import failsafe, faultpoint
+from ..util import connpool, failsafe, faultpoint
 from ..util.http_util import netloc as _peer_of
 from ..util.http_util import trace_headers
 
@@ -76,11 +74,9 @@ def upload_data(
         with trace.child_span("http.upload", url=url, bytes=len(payload)):
             # traceparent captured inside the span: the volume
             # server's span must parent to http.upload, not above it
-            req = urllib.request.Request(
-                url, data=body, headers=trace_headers(headers),
-                method="POST")
-            with urllib.request.urlopen(
-                    req, timeout=failsafe.attempt_timeout(timeout)) as resp:
+            with connpool.request(
+                    "POST", url, body=body, headers=trace_headers(headers),
+                    timeout=failsafe.attempt_timeout(timeout)) as resp:
                 out = json.loads(resp.read() or b"{}")
         return UploadResult(
             name=out.get("name", filename),
@@ -118,9 +114,9 @@ def download(url: str, timeout: float = 30.0,
         with trace.child_span("http.download", url=url):
             headers = trace_headers(
                 {"Range": range_header} if range_header else {})
-            req = urllib.request.Request(url, headers=headers)
-            with urllib.request.urlopen(
-                    req, timeout=failsafe.attempt_timeout(timeout)) as resp:
+            with connpool.request(
+                    "GET", url, headers=headers,
+                    timeout=failsafe.attempt_timeout(timeout)) as resp:
                 blob = resp.read()
         return faultpoint.inject(FP_DOWNLOAD, ctx=url, data=blob)
 

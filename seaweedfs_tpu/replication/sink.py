@@ -16,6 +16,7 @@ import urllib.parse
 import urllib.request
 
 from ..pb import filer_pb2
+from ..util import connpool
 
 
 class Sink:
@@ -90,25 +91,21 @@ class FilerSink(Sink):
     def create_entry(self, directory, entry, data):
         if entry.is_directory:
             return  # target filer auto-creates parents on file writes
-        req = urllib.request.Request(
-            self._url(directory, entry.name),
-            data=data,
-            method="PUT",
-            headers={
-                "Content-Type": entry.attributes.mime
-                or "application/octet-stream"
-            },
-        )
-        with urllib.request.urlopen(req, timeout=120) as r:
+        with connpool.request(
+                "PUT", self._url(directory, entry.name), body=data,
+                headers={
+                    "Content-Type": entry.attributes.mime
+                    or "application/octet-stream"
+                },
+                timeout=120) as r:
             r.read()
 
     def delete_entry(self, directory, name, is_directory):
         extra = "recursive=true&ignoreRecursiveError=true" if is_directory else ""
-        req = urllib.request.Request(
-            self._url(directory, name, extra), method="DELETE"
-        )
         try:
-            with urllib.request.urlopen(req, timeout=60) as r:
+            with connpool.request(
+                    "DELETE", self._url(directory, name, extra),
+                    timeout=60) as r:
                 r.read()
         except urllib.error.HTTPError as e:
             if e.code != 404:
@@ -139,24 +136,21 @@ class S3Sink(Sink):
     def create_entry(self, directory, entry, data):
         if entry.is_directory:
             return
-        req = urllib.request.Request(
-            self._url(self._key(directory, entry.name)),
-            data=data,
-            method="PUT",
-            headers={
-                "Content-Type": entry.attributes.mime
-                or "application/octet-stream"
-            },
-        )
-        with urllib.request.urlopen(req, timeout=120) as r:
+        with connpool.request(
+                "PUT", self._url(self._key(directory, entry.name)),
+                body=data,
+                headers={
+                    "Content-Type": entry.attributes.mime
+                    or "application/octet-stream"
+                },
+                timeout=120) as r:
             r.read()
 
     def delete_entry(self, directory, name, is_directory):
-        req = urllib.request.Request(
-            self._url(self._key(directory, name)), method="DELETE"
-        )
         try:
-            with urllib.request.urlopen(req, timeout=60) as r:
+            with connpool.request(
+                    "DELETE", self._url(self._key(directory, name)),
+                    timeout=60) as r:
                 r.read()
         except urllib.error.HTTPError as e:
             if e.code != 404:

@@ -8,10 +8,10 @@ in weed/command/filer_replicate.go.
 from __future__ import annotations
 
 import urllib.parse
-import urllib.request
 
 from ..pb import filer_pb2
 from ..pb import rpc as rpclib
+from ..util import connpool
 
 GRPC_PORT_OFFSET = 10000
 
@@ -53,5 +53,5 @@ class FilerSource:
             return b""
         path = f"{directory.rstrip('/')}/{entry.name}"
         url = f"http://{self.filer_http}{urllib.parse.quote(path)}"
-        with urllib.request.urlopen(url, timeout=60) as r:
+        with connpool.request("GET", url, timeout=60) as r:
             return r.read()

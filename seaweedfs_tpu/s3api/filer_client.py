@@ -10,13 +10,12 @@ from __future__ import annotations
 import time
 import urllib.error
 import urllib.parse
-import urllib.request
 
 import grpc
 
 from ..pb import filer_pb2
 from ..pb import rpc as rpclib
-from ..util import failsafe
+from ..util import connpool, failsafe
 from ..util.http_util import trace_headers
 
 GRPC_PORT_OFFSET = 10000
@@ -163,15 +162,13 @@ class FilerClient:
         # a filer PUT replaces the whole entry, so re-sending after an
         # ambiguous failure converges on the same result: idempotent
         def attempt() -> None:
-            req = urllib.request.Request(
-                f"http://{self.http_address}{urllib.parse.quote(path)}",
-                data=data,
-                method="PUT",
-                headers=trace_headers(
-                    {"Content-Type": mime or "application/octet-stream"}),
-            )
-            with urllib.request.urlopen(
-                    req, timeout=failsafe.attempt_timeout(120)) as r:
+            with connpool.request(
+                    "PUT",
+                    f"http://{self.http_address}{urllib.parse.quote(path)}",
+                    body=data,
+                    headers=trace_headers(
+                        {"Content-Type": mime or "application/octet-stream"}),
+                    timeout=failsafe.attempt_timeout(120)) as r:
                 r.read()
 
         failsafe.call(attempt, op="put_object", retry_type="s3",
@@ -180,17 +177,18 @@ class FilerClient:
     def put_object_stream(self, path: str, reader, length: int,
                           mime: str = "") -> None:
         """PUT from a file-like reader without buffering the whole body
-        (http.client streams objects that expose .read)."""
-        req = urllib.request.Request(
-            f"http://{self.http_address}{urllib.parse.quote(path)}",
-            data=reader,
-            method="PUT",
-            headers=trace_headers({
-                "Content-Type": mime or "application/octet-stream",
-                "Content-Length": str(length),
-            }),
-        )
-        with urllib.request.urlopen(req, timeout=600) as r:
+        (http.client streams objects that expose .read).  The pool sends
+        a non-seekable stream on a fresh dial — a half-consumed reader
+        can't be replayed onto a stale keep-alive socket."""
+        with connpool.request(
+                "PUT",
+                f"http://{self.http_address}{urllib.parse.quote(path)}",
+                body=reader,
+                headers=trace_headers({
+                    "Content-Type": mime or "application/octet-stream",
+                    "Content-Length": str(length),
+                }),
+                timeout=600) as r:
             r.read()
 
     def open_object(self, path: str, range_header: str = ""):
@@ -200,11 +198,10 @@ class FilerClient:
         headers = trace_headers()
         if range_header:
             headers["Range"] = range_header
-        req = urllib.request.Request(
+        return connpool.request(
+            "GET",
             f"http://{self.http_address}{urllib.parse.quote(path)}",
-            headers=headers,
-        )
-        return urllib.request.urlopen(req, timeout=600)
+            headers=headers, timeout=600)
 
     def get_object(self, path: str, range_header: str = "") -> tuple[int, dict, bytes]:
         """-> (status, headers, body); raises on network failure only."""
@@ -212,12 +209,11 @@ class FilerClient:
         if range_header:
             headers["Range"] = range_header
         def attempt() -> tuple[int, dict, bytes]:
-            req = urllib.request.Request(
-                f"http://{self.http_address}{urllib.parse.quote(path)}",
-                headers=headers,
-            )
-            with urllib.request.urlopen(
-                    req, timeout=failsafe.attempt_timeout(120)) as r:
+            with connpool.request(
+                    "GET",
+                    f"http://{self.http_address}{urllib.parse.quote(path)}",
+                    headers=headers,
+                    timeout=failsafe.attempt_timeout(120)) as r:
                 return r.status, dict(r.headers), r.read()
 
         try:

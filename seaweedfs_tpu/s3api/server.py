@@ -53,6 +53,11 @@ from .policy import (
 )
 
 XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+# extended-attribute key bucket policies are stored under on the bucket
+# entry (was referenced undefined — a latent NameError on any bucket that
+# actually carried a policy, caught by the ruff F821 gate)
+POLICY_KEY = b"seaweedfs.s3.policy"
 BUCKETS_DIR = "/buckets"
 UPLOADS_DIR = ".uploads"
 TAG_PREFIX = "Seaweed-X-Amz-Tagging-"

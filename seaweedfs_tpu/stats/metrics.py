@@ -258,6 +258,40 @@ CHUNK_CACHE_COUNTER = REGISTRY.counter(
     labels=("result",),
 )
 
+# keep-alive connection pool (util/connpool.py): every internal HTTP hop
+# either reuses a pooled socket or pays a fresh dial; evictions count
+# sockets dropped for staleness, pool overflow, or a dead keep-alive
+CONNPOOL_REUSE = REGISTRY.counter(
+    "seaweedfs_connpool_reuse_total",
+    "internal HTTP requests served on a reused pooled connection",
+)
+CONNPOOL_DIAL = REGISTRY.counter(
+    "seaweedfs_connpool_dial_total",
+    "fresh TCP dials made by the connection pool",
+)
+CONNPOOL_EVICT = REGISTRY.counter(
+    "seaweedfs_connpool_evict_total",
+    "pooled connections discarded (idle-expired, overflow, or dead)",
+)
+
+# hot-needle cache on the volume-server read path
+NEEDLE_CACHE_HIT = REGISTRY.counter(
+    "seaweedfs_needle_cache_hit_total", "needle reads served from cache",
+)
+NEEDLE_CACHE_MISS = REGISTRY.counter(
+    "seaweedfs_needle_cache_miss_total", "needle reads that missed the cache",
+)
+NEEDLE_CACHE_EVICT = REGISTRY.counter(
+    "seaweedfs_needle_cache_evict_total",
+    "needles evicted from the cache by the byte bound",
+)
+
+REPLICATION_ERROR = REGISTRY.counter(
+    "seaweedfs_replication_error_total",
+    "replica fan-out failures by operation",
+    labels=("op",),
+)
+
 # EC codec telemetry: encode/reconstruct wall time and bytes moved per
 # call, labeled by op and backend impl (cpu / xor / mxu / pallas) so the
 # rebuild-traffic cost the warehouse-cluster study flags is attributable

@@ -30,6 +30,12 @@ class BackendStorageFile(ABC):
     @abstractmethod
     def read_at(self, offset: int, size: int) -> bytes: ...
 
+    def pread(self, offset: int, size: int) -> bytes:
+        """Positioned read safe for concurrent callers.  The default
+        delegates to read_at; DiskFile overrides with a true lock-free
+        os.pread so reads on one volume don't serialize."""
+        return self.read_at(offset, size)
+
     @abstractmethod
     def write_at(self, offset: int, data: bytes) -> int: ...
 
@@ -60,13 +66,20 @@ class DiskFile(BackendStorageFile):
         self._lock = threading.Lock()
 
     def read_at(self, offset: int, size: int) -> bytes:
-        # stays under the lock: volume readers already serialize on
-        # volume._lock (which also guards the vacuum handle swap), so a
-        # lock-free pread here would buy nothing while opening an
-        # fd-reuse hazard against a concurrently swapped handle
         with self._lock:
             self._f.seek(offset)
             return self._f.read(size)
+
+    def pread(self, offset: int, size: int) -> bytes:
+        """Lock-free positioned read: os.pread shares no file-position
+        state, so concurrent GETs on one volume proceed in parallel.
+        Racing handle swaps (vacuum commit, tier moves) surface as
+        OSError/ValueError on the closed fd — Volume.read_needle falls
+        back to the locked path, where it re-reads the fresh handle."""
+        f = self._f
+        if f.closed:
+            raise ValueError(f"{self.name}: file closed")
+        return os.pread(f.fileno(), size, offset)
 
     def write_at(self, offset: int, data: bytes) -> int:
         with self._lock:

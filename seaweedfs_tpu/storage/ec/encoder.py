@@ -161,14 +161,17 @@ except (ValueError, OSError, AttributeError):
 
 def _writev_all(fd: int, bufs: list) -> None:
     """os.writev with partial-write resume, chunked to IOV_MAX iovecs
-    (a small slice_size/small_block ratio can exceed the kernel limit)."""
-    while bufs:
-        n = os.writev(fd, bufs[:_IOV_MAX])
-        while bufs and n >= len(bufs[0]):
-            n -= len(bufs[0])
-            bufs.pop(0)
-        if n and bufs:
-            bufs[0] = memoryview(bufs[0])[n:]
+    (a small slice_size/small_block ratio can exceed the kernel limit).
+    Consumed iovecs advance an index instead of pop(0)-shifting the
+    list — the shift made large batches O(n^2) in iovec count."""
+    i = 0
+    while i < len(bufs):
+        n = os.writev(fd, bufs[i : i + _IOV_MAX])
+        while i < len(bufs) and n >= len(bufs[i]):
+            n -= len(bufs[i])
+            i += 1
+        if n and i < len(bufs):
+            bufs[i] = memoryview(bufs[i])[n:]
 
 
 def _encode_stream_mmap(

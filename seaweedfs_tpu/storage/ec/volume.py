@@ -89,6 +89,9 @@ class EcVolume:
         self._ecx_keys_arr = None  # lazy key cache; False = don't cache
         self._ecj_lock = threading.Lock()
         self._ecx_derived_shard_size: int | None = None
+        # bumped on every tombstone: the needle cache's compare-before-put
+        # token (EC volumes never append, so deletes are the only writers)
+        self.delete_seq = 0
         self.remote_fetch: FetchFn | None = None
         for sid in range(TOTAL_SHARDS):
             p = base_name + to_ext(sid)
@@ -245,6 +248,11 @@ class EcVolume:
         os.pwrite(self._ecx.fileno(), t.size_to_bytes(t.TOMBSTONE_FILE_SIZE),
                   pos + t.NEEDLE_ID_SIZE + t.OFFSET_SIZE)
         with self._ecj_lock:
+            # seq bump under the journal lock: the needle cache's
+            # compare-and-put (store.py) holds the same lock, so a put
+            # can never be published after the invalidation that follows
+            # this delete
+            self.delete_seq += 1
             with open(self.base_name + ".ecj", "ab") as j:
                 j.write(t.needle_id_to_bytes(needle_id))
 

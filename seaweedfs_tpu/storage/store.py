@@ -26,6 +26,7 @@ from .ec.decoder import (
 from .ec.shard_bits import ShardBits
 from .ec.volume import EcVolume
 from .needle import Needle
+from ..util.chunk_cache import NeedleCache
 from .replica_placement import ReplicaPlacement
 from .super_block import CURRENT_VERSION, SuperBlock
 from .ttl import TTL
@@ -45,6 +46,7 @@ class Store:
         codec_name: str = "cpu",
         max_volume_counts: dict[str, int] | None = None,
         disk_types: list[str] | None = None,
+        needle_cache_mb: int | None = None,  # None = env / 32MB default
     ):
         self.ip = ip
         self.port = port
@@ -77,6 +79,17 @@ class Store:
         # vid -> FetchFn factory, injected by the volume server so EcVolumes
         # can read remote shards (store_ec.go's readRemoteEcShardInterval)
         self.ec_fetcher_factory = None
+        # hot-needle cache: repeated small-file GETs skip needle-map
+        # lookup, disk read and CRC parse.  Per-store (never process
+        # global: two in-process test clusters may reuse volume ids);
+        # 0 disables
+        if needle_cache_mb is None:
+            needle_cache_mb = int(
+                os.environ.get("SEAWEEDFS_TPU_NEEDLE_CACHE_MB", "32"))
+        self.needle_cache = (
+            NeedleCache(needle_cache_mb << 20) if needle_cache_mb > 0
+            else None
+        )
 
     # -- lookup -----------------------------------------------------------
 
@@ -145,6 +158,8 @@ class Store:
                 if v is not None:
                     info = self._short_info(v)
                     if loc.delete_volume(vid):
+                        if self.needle_cache is not None:
+                            self.needle_cache.drop_volume(vid)
                         self.deleted_volumes.append(info)
                         return True
             return False
@@ -156,6 +171,8 @@ class Store:
                 if v is not None:
                     info = self._short_info(v)
                     if loc.unmount_volume(vid):
+                        if self.needle_cache is not None:
+                            self.needle_cache.drop_volume(vid)
                         self.deleted_volumes.append(info)
                         return True
             return False
@@ -195,28 +212,67 @@ class Store:
 
     # -- needle ops -------------------------------------------------------
 
+    def invalidate_needle(self, vid: int, needle_id: int) -> None:
+        """Drop one needle from the hot cache.  Called by every mutation
+        that goes through the store, and by handlers that write/delete on
+        a Volume directly (tail receivers, EC blob deletes)."""
+        if self.needle_cache is not None:
+            self.needle_cache.invalidate(vid, needle_id)
+
     def write_needle(self, vid: int, n: Needle) -> int:
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
         _offset, size = v.append_needle(n)
+        self.invalidate_needle(vid, n.id)
         return size
 
     def read_needle(self, vid: int, needle_id: int,
                     expected_cookie: int | None = None) -> Needle:
+        cache = self.needle_cache
+        if cache is not None:
+            n = cache.get(vid, needle_id)
+            if n is not None:
+                if expected_cookie is not None and n.cookie != expected_cookie:
+                    raise PermissionError("cookie mismatch")
+                return n
         v = self.find_volume(vid)
         if v is not None:
-            return v.read_needle(needle_id, expected_cookie)
+            seq = v.write_seq  # snapshot BEFORE the read
+            n = v.read_needle(needle_id, expected_cookie)
+            if cache is not None:
+                # compare-and-put under the volume lock: a racing
+                # append/delete bumps write_seq before its own
+                # invalidate, so a stale needle can never be published
+                # after the invalidation that should have killed it
+                with v._lock:
+                    if v.write_seq == seq:
+                        cache.put(vid, needle_id, n)
+            return n
         ev = self.find_ec_volume(vid)
         if ev is not None:
-            return ev.read_needle(needle_id)
+            seq = ev.delete_seq
+            n = ev.read_needle(needle_id)
+            if cache is not None:
+                # same compare-and-put discipline as the volume path,
+                # serialized by the journal lock the deleter bumps
+                # delete_seq under — without it a preempted reader could
+                # publish a tombstoned needle after its invalidation
+                with ev._ecj_lock:
+                    if ev.delete_seq == seq:
+                        cache.put(vid, needle_id, n)
+            if expected_cookie is not None and n.cookie != expected_cookie:
+                raise PermissionError("cookie mismatch")
+            return n
         raise KeyError(f"volume {vid} not found")
 
     def delete_needle(self, vid: int, needle_id: int) -> int:
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
-        return v.delete_needle(needle_id)
+        freed = v.delete_needle(needle_id)
+        self.invalidate_needle(vid, needle_id)
+        return freed
 
     def delete_ec_needle(self, vid: int, needle_id: int) -> int:
         """Tombstone a needle in a local EC volume (.ecx in place + .ecj).
@@ -230,6 +286,7 @@ class Store:
         except KeyError:
             return 0
         ev.delete_needle(needle_id)
+        self.invalidate_needle(vid, needle_id)
         return max(size, 0)
 
     # -- vacuum -----------------------------------------------------------
@@ -255,6 +312,9 @@ class Store:
         if snapshot is None:
             raise ValueError(f"no compaction in progress for {vid}")
         commit_compact(v, snapshot)
+        # every offset (and the handle) changed wholesale
+        if self.needle_cache is not None:
+            self.needle_cache.drop_volume(vid)
 
     def cleanup_compact_volume(self, vid: int) -> None:
         v = self.find_volume(vid)
@@ -353,6 +413,8 @@ class Store:
                     if loc.ec_volumes.get(vid) is ev:
                         del loc.ec_volumes[vid]
                 ev.close()
+                if self.needle_cache is not None:
+                    self.needle_cache.drop_volume(vid)
 
     def delete_ec_shards(self, vid: int, collection: str,
                          shard_ids: list[int]) -> None:

@@ -15,15 +15,27 @@ and needle map still local.
 
 from __future__ import annotations
 
+import itertools
 import os
+import struct
 import threading
 import time
 
 from . import types as t
+from ..util import faultpoint
 from .backend import DiskFile, get_backend
 from .idx import IndexWriter, walk_index_file
 from .needle import Needle, actual_size, body_length
 from .needle_map import NeedleMap
+
+# chaos point inside the (unlocked) disk-read section of the needle read
+# path: lets tests prove two GETs on one volume overlap
+FP_DISK_READ = faultpoint.register("volume.disk.read")
+
+# global mutation-sequence source: values never repeat, even across a
+# vacuum's in-place re-__init__, so a cached sequence observed before
+# a swap can never collide with one issued after it
+_MUTATION_SEQ = itertools.count(1)
 
 # process-wide index kind (needle_map.go:13-19 NeedleMapKind): "memory"
 # (compact in-RAM map) or "disk" (sorted-file map with bounded RAM);
@@ -49,6 +61,9 @@ class Volume:
         self.disk_type = ""  # normalized; "" == hdd (set by DiskLocation)
         self.read_only = False
         self._lock = threading.RLock()
+        # bumped on every append/delete (and fresh on vacuum re-init):
+        # the needle cache's compare-before-put token (store.py)
+        self.write_seq = next(_MUTATION_SEQ)
         base = self.file_name()
         self.volume_info = load_volume_info(base + ".vif")
         remote = self._remote_dat_file()
@@ -148,6 +163,7 @@ class Volume:
             if old is None or old.offset < offset:
                 self.needle_map.put(n.id, offset, n.size)
                 self._idx.put(n.id, offset, n.size)
+            self.write_seq = next(_MUTATION_SEQ)
             return offset, n.size
 
     def delete_needle(self, needle_id: int,
@@ -171,21 +187,55 @@ class Volume:
             self.needle_map.delete(needle_id)
             self._idx.delete(needle_id, offset)
             self.last_modified_second = int(time.time())
+            self.write_seq = next(_MUTATION_SEQ)
             return max(existing.size, 0)
 
     # -- read path --------------------------------------------------------
 
     def read_needle(self, needle_id: int, expected_cookie: int | None = None) -> Needle:
+        """Lock-split read: the lock covers only the needle-map lookup and
+        the .dat handle snapshot; the disk read itself runs outside it via
+        a positioned pread, so concurrent GETs on one volume overlap
+        instead of serializing behind each other's I/O.
+
+        Safety: the .dat is append-only, so an offset published in the
+        needle map always names fully-written bytes in the snapshotted
+        handle; the only racer that can hurt is a handle SWAP (vacuum
+        commit / tier move), which closes the old fd — that read fails
+        with OSError/ValueError (or short-reads) and retries under the
+        lock against the fresh handle and a fresh map entry."""
         with self._lock:
             nv = self.needle_map.get(needle_id)
             if nv is None or t.size_is_deleted(nv.size):
                 raise KeyError(f"needle {needle_id:x} not found")
-            blob = self._dat.read_at(
-                nv.offset, actual_size(nv.size, self.version)
-            )
-        n = Needle.from_bytes(blob, self.version)
-        if n.size != nv.size:
-            raise IOError("size mismatch reading needle")
+            dat = self._dat
+            version = self.version
+        faultpoint.inject(FP_DISK_READ, ctx=str(self.volume_id))
+        n = None
+        try:
+            blob = dat.pread(nv.offset, actual_size(nv.size, version))
+            parsed = Needle.from_bytes(blob, version)
+            if parsed.size == nv.size:
+                n = parsed
+        except (OSError, ValueError, struct.error):
+            pass
+        if n is None:
+            # racing handle swap: a closed fd errors/short-reads, and a
+            # REUSED fd number can even hand back `want` bytes of the
+            # wrong file — any inconsistency (error, short read, parse
+            # failure, size mismatch) re-resolves everything under the
+            # lock, where the locked path's own errors are authoritative
+            with self._lock:
+                nv = self.needle_map.get(needle_id)
+                if nv is None or t.size_is_deleted(nv.size):
+                    raise KeyError(f"needle {needle_id:x} not found")
+                version = self.version
+                blob = self._dat.read_at(
+                    nv.offset, actual_size(nv.size, version)
+                )
+            n = Needle.from_bytes(blob, version)
+            if n.size != nv.size:
+                raise IOError("size mismatch reading needle")
         if expected_cookie is not None and n.cookie != expected_cookie:
             raise PermissionError("cookie mismatch")
         return n

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 from collections import deque
@@ -39,9 +40,15 @@ MAX_SPANS = int(os.environ.get("SEAWEEDFS_TPU_TRACE_BUFFER", "2048"))
 
 _ctx = threading.local()  # _ctx.stack: list[(trace_id, span_id)]
 
+# ids need uniqueness, not unpredictability: os.urandom costs a syscall
+# per call and every request opens a span (two ids) — a urandom-seeded
+# PRNG is plenty (getrandbits is a single atomic C call, thread-safe
+# under the GIL)
+_id_rng = random.Random(os.urandom(16))
+
 
 def _rand_hex(nbytes: int) -> str:
-    return os.urandom(nbytes).hex()
+    return f"{_id_rng.getrandbits(8 * nbytes):0{2 * nbytes}x}"
 
 
 @dataclass

@@ -141,6 +141,12 @@ class FaultRegistry:
     def inject(self, name: str, ctx: str = "",
                data: bytes | None = None) -> bytes | None:
         """Fire the point if armed; returns (possibly truncated) data."""
+        if not self._armed:
+            # fast path: hot-path call sites (every needle read/GET) must
+            # not pay a lock round trip while no fault is armed; a bare
+            # dict truthiness read is atomic under the GIL and arming is
+            # always followed by the locked re-check below
+            return data
         with self._lock:
             spec = self._armed.get(name)
             if spec is None:

@@ -140,6 +140,7 @@ class VolumeGrpcService:
             context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
         n = Needle.from_bytes(request.needle_blob, v.version, verify=False)
         v.append_needle(n)
+        self.store.invalidate_needle(request.volume_id, n.id)
         return vs.WriteNeedleBlobResponse()
 
     def ReadAllNeedles(self, request, context):
@@ -358,6 +359,7 @@ class VolumeGrpcService:
         if ev is None:
             context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not found")
         ev.delete_needle(request.file_key)
+        self.store.invalidate_needle(request.volume_id, request.file_key)
         return vs.VolumeEcBlobDeleteResponse()
 
     def VolumeEcShardsToVolume(self, request, context):
@@ -490,10 +492,12 @@ class VolumeGrpcService:
                     except Exception:  # unreadable local copy: replace it
                         pass
                 v.append_needle(full)
+                self.store.invalidate_needle(request.volume_id, n.id)
             else:
                 # carry the origin's tombstone timestamp — a local stamp
                 # would poison since_ns watermarks under clock skew
                 v.delete_needle(n.id, at_ns=full.append_at_ns)
+                self.store.invalidate_needle(request.volume_id, n.id)
         return vs.VolumeTailReceiverResponse()
 
     # -- remote tier -------------------------------------------------------

@@ -133,12 +133,12 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
         data = n.data
         # image GETs: EXIF orientation fix + ?width/?height/?mode resize
         # on read (volume_server_handlers_read.go -> images/resizing.go)
-        q = urllib.parse.parse_qs(path.query)
         ext = ""
         name = n.name.decode(errors="replace") if n.name else path.path
         if "." in name:
             ext = "." + name.rsplit(".", 1)[1].lower()
         if images.is_image(ext, mime):
+            q = urllib.parse.parse_qs(path.query)
             data = images.fix_orientation(bytes(data))
             try:
                 w = int(q.get("width", ["0"])[0] or 0)
@@ -173,7 +173,68 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
                 return self._send_json(416, {"error": "bad range"})
         self._send(200, data, mime, extra)
 
-    do_HEAD = do_GET
+    def do_HEAD(self):
+        """HEAD answers from needle metadata alone: no EXIF re-orientation,
+        no resize — the GET pipeline ran the full image transform only to
+        throw the body away.  Content-Length reflects the stored bytes
+        (a transformed GET body may differ; metadata-accurate beats
+        paying the transform per HEAD)."""
+        with http_request(self, "volumeServer", "get"):
+            self._do_head()
+
+    def _do_head(self):
+        path = urllib.parse.urlparse(self.path)
+        try:
+            fid = FileId.parse(path.path.lstrip("/"))
+        except ValueError:
+            # non-fid paths (/status, /ui, debug): same answers as GET,
+            # minus the body (_send skips it for HEAD)
+            return self._do_get()
+        if (
+            self.store.find_volume(fid.volume_id) is None
+            and self.store.find_ec_volume(fid.volume_id) is None
+        ):
+            target = self.volume_server.lookup_volume_url(fid.volume_id)
+            if target and target != f"{self.volume_server.ip}:{self.volume_server.port}":
+                return self._send(
+                    302, b"", "text/plain",
+                    {"Location": f"http://{target}{self.path}"},
+                )
+            return self._send_json(404, {"error": f"volume {fid.volume_id} not found"})
+        try:
+            n = self.store.read_needle(fid.volume_id, fid.key)
+        except KeyError:
+            return self._send_json(404, {"error": "not found"})
+        except IOError as e:
+            return self._send_json(500, {"error": str(e)})
+        if n.cookie != fid.cookie:
+            return self._send_json(404, {"error": "cookie mismatch"})
+        mime = n.mime.decode() if n.has(FLAG_HAS_MIME) and n.mime else "application/octet-stream"
+        extra = {
+            "Etag": f'"{n.checksum:x}"',
+            "Accept-Ranges": "bytes",
+        }
+        rng = self.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            # range semantics preserved (206 + Content-Range against the
+            # stored length) — only the image transforms are skipped
+            total = len(n.data)
+            try:
+                start_s, end_s = rng[len("bytes="):].split("-", 1)
+                if not start_s:
+                    start = max(0, total - int(end_s))
+                    end = total - 1
+                else:
+                    start = int(start_s)
+                    end = int(end_s) if end_s else total - 1
+                end = min(end, total - 1)
+                if start > end:
+                    raise ValueError
+                extra["Content-Range"] = f"bytes {start}-{end}/{total}"
+                return self._send(206, n.data[start : end + 1], mime, extra)
+            except ValueError:
+                return self._send_json(416, {"error": "bad range"})
+        self._send(200, n.data, mime, extra)
 
     # -- write ------------------------------------------------------------
 

@@ -6,9 +6,10 @@ The gRPC port is http_port + 10000 by convention, like the reference.
 
 from __future__ import annotations
 
+import concurrent.futures
 import threading
 import time
-import urllib.request
+import urllib.error
 
 import grpc
 
@@ -17,11 +18,12 @@ from ..pb import rpc as rpclib
 from ..security import Guard
 from ..stats.metrics import (
     DISK_SIZE_GAUGE,
+    REPLICATION_ERROR,
     VOLUME_GAUGE,
     serve_metrics,
 )
 from ..storage.store import Store
-from ..util import glog
+from ..util import connpool, glog
 from .grpc_handlers import VolumeGrpcService
 from .http_handlers import serve_http
 
@@ -94,6 +96,11 @@ class VolumeServer:
         self._metricsd = None
         self._grpc_server = None
         self._hb_thread: threading.Thread | None = None
+        # replica fan-out workers: writes/deletes post to every peer
+        # CONCURRENTLY on pooled connections, so the client's ack waits
+        # one slowest-peer RTT, not the sum over peers
+        self._replica_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="replica-fanout")
 
     # -- lifecycle --------------------------------------------------------
 
@@ -132,6 +139,7 @@ class VolumeServer:
             self._metricsd.server_close()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
+        self._replica_pool.shutdown(wait=False)
         self.store.close()
 
     def update_gauges(self) -> None:
@@ -345,49 +353,87 @@ class VolumeServer:
         return out
 
     def replicate_write(self, fid, path: str, body: bytes, headers) -> str | None:
+        """Fan the write out to every other replica CONCURRENTLY on
+        pooled keep-alive connections; returns the first error (in peer
+        order) or None.  Write-path semantics are unchanged — any peer
+        failure still fails the client's write — but the ack now waits
+        max(peer RTT) instead of sum(connect + POST) per peer."""
         v = self.store.find_volume(fid.volume_id)
         if v is None or v.super_block.replica_placement.copy_count() <= 1:
+            return None
+        peers = self.other_replica_locations(fid.volume_id)
+        if not peers:
             return None
         sep = "&" if "?" in path else "?"
         from ..telemetry import trace
         from ..util.http_util import trace_headers
 
-        for peer in self.other_replica_locations(fid.volume_id):
+        ct = headers.get("Content-Type")
+        auth = headers.get("Authorization")
+
+        def post(peer: str) -> str | None:
             url = f"http://{peer}{path}{sep}type=replicate"
             try:
                 with trace.child_span("volumeServer.replicate", peer=peer):
                     # traceparent captured inside the span so the peer's
                     # span parents to the replicate hop
-                    req = urllib.request.Request(
-                        url, data=body, method="POST",
-                        headers=trace_headers())
-                    ct = headers.get("Content-Type")
+                    hdrs = trace_headers()
                     if ct:
-                        req.add_header("Content-Type", ct)
-                    auth = headers.get("Authorization")
+                        hdrs["Content-Type"] = ct
                     if auth:  # write jwt travels with the replica fan-out
-                        req.add_header("Authorization", auth)
-                    with urllib.request.urlopen(req, timeout=10) as r:
+                        hdrs["Authorization"] = auth
+                    with connpool.request("POST", url, body=body,
+                                          headers=hdrs, timeout=10) as r:
+                        r.read()
                         if r.status >= 300:
                             return f"peer {peer} status {r.status}"
+            except urllib.error.HTTPError as e:
+                return f"peer {peer} status {e.code}"
             except OSError as e:
                 return f"peer {peer}: {e}"
+            return None
+
+        if len(peers) == 1:
+            results = [post(peers[0])]
+        else:
+            results = list(self._replica_pool.map(
+                trace.wrap_context(post), peers))
+        for err in results:
+            if err:
+                REPLICATION_ERROR.labels("write").inc()
+                return err
         return None
 
     def replicate_delete(self, fid, path: str, auth: str = "") -> None:
+        """Best-effort tombstone fan-out.  A failed peer no longer
+        disappears silently: it logs at warning and counts
+        seaweedfs_replication_error_total{op="delete"} so divergent
+        replicas are visible before a failover read trips over them."""
         v = self.store.find_volume(fid.volume_id)
         if v is None or v.super_block.replica_placement.copy_count() <= 1:
             return
+        peers = self.other_replica_locations(fid.volume_id)
+        if not peers:
+            return
         sep = "&" if "?" in path else "?"
+        from ..telemetry import trace
         from ..util.http_util import trace_headers
 
-        for peer in self.other_replica_locations(fid.volume_id):
+        def delete(peer: str) -> None:
             url = f"http://{peer}{path}{sep}type=replicate"
-            req = urllib.request.Request(
-                url, method="DELETE", headers=trace_headers())
+            hdrs = trace_headers()
             if auth:
-                req.add_header("Authorization", auth)
+                hdrs["Authorization"] = auth
             try:
-                urllib.request.urlopen(req, timeout=10)
-            except OSError:
-                pass
+                with connpool.request("DELETE", url, headers=hdrs,
+                                      timeout=10) as r:
+                    r.read()
+            except OSError as e:  # incl. HTTPError (4xx/5xx from the peer)
+                REPLICATION_ERROR.labels("delete").inc()
+                glog.warning("replicate delete %s to peer %s failed: %s",
+                             path, peer, e)
+
+        if len(peers) == 1:
+            delete(peers[0])
+        else:
+            list(self._replica_pool.map(trace.wrap_context(delete), peers))

@@ -1,0 +1,421 @@
+"""Small-file hot-path suite: keep-alive connection pool, hot-needle
+cache invalidation, lock-free concurrent needle reads, and the
+metadata-only HEAD path (ISSUE 3)."""
+
+import http.server
+import json
+import threading
+import time
+import urllib.error
+
+import pytest
+
+from helpers import free_port, make_volume
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.util import connpool, faultpoint
+from seaweedfs_tpu.util.chunk_cache import NeedleCache
+
+
+# ---------------------------------------------------------------------------
+# connection pool
+# ---------------------------------------------------------------------------
+
+
+class _CountingHandler(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def setup(self):
+        super().setup()
+        self.server.conn_count += 1
+        self.server.live_socks.append(self.connection)
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):
+        body = json.dumps({"path": self.path}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        payload = self.rfile.read(length)
+        body = json.dumps({"echo_len": len(payload)}).encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _counting_server(port: int, handler=_CountingHandler):
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", port), handler)
+    httpd.conn_count = 0
+    httpd.live_socks = []
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def _stop_server(httpd):
+    httpd.shutdown()
+    httpd.server_close()
+    for sock in httpd.live_socks:  # kill keep-alive conns, not just accept
+        try:
+            sock.shutdown(2)
+            sock.close()
+        except OSError:
+            pass
+
+
+def test_pool_reuses_one_socket_for_sequential_requests():
+    port = free_port()
+    httpd = _counting_server(port)
+    pool = connpool.ConnectionPool()
+    try:
+        for i in range(5):
+            with pool.request("GET", f"http://127.0.0.1:{port}/r{i}") as r:
+                assert r.status == 200
+                assert json.loads(r.read())["path"] == f"/r{i}"
+        # five sequential requests, ONE accepted TCP connection
+        assert httpd.conn_count == 1
+        assert pool.idle_count("127.0.0.1", port) == 1
+    finally:
+        pool.close_all()
+        _stop_server(httpd)
+
+
+def test_pool_interleaves_posts_and_bodies():
+    port = free_port()
+    httpd = _counting_server(port)
+    pool = connpool.ConnectionPool()
+    try:
+        for size in (0, 1, 4096):
+            with pool.request("POST", f"http://127.0.0.1:{port}/w",
+                              body=b"x" * size) as r:
+                assert json.loads(r.read())["echo_len"] == size
+        assert httpd.conn_count == 1
+    finally:
+        pool.close_all()
+        _stop_server(httpd)
+
+
+def test_pool_retries_stale_socket_once():
+    """A pooled keep-alive socket whose peer restarted is replayed once
+    on a fresh dial instead of failing the request."""
+    port = free_port()
+    httpd = _counting_server(port)
+    pool = connpool.ConnectionPool()
+    try:
+        with pool.request("GET", f"http://127.0.0.1:{port}/warm") as r:
+            r.read()
+        assert pool.idle_count("127.0.0.1", port) == 1
+        # the peer goes away and comes back: the pooled socket is now dead
+        _stop_server(httpd)
+        httpd = _counting_server(port)
+        with pool.request("GET", f"http://127.0.0.1:{port}/again") as r:
+            assert r.status == 200
+            r.read()
+        assert httpd.conn_count == 1  # the retry dialed the new server
+    finally:
+        pool.close_all()
+        _stop_server(httpd)
+
+
+def test_pool_fails_fast_on_fresh_connection_errors():
+    """Errors on a never-used connection are NOT retried by the pool —
+    retry policy belongs to failsafe at the call sites."""
+    pool = connpool.ConnectionPool()
+    port = free_port()  # nothing listening
+    with pytest.raises(OSError):
+        pool.request("GET", f"http://127.0.0.1:{port}/x", timeout=2)
+
+
+def test_pool_raises_httperror_like_urlopen():
+    port = free_port()
+
+    class _NotFound(_CountingHandler):
+        def do_GET(self):
+            body = b'{"error": "nope"}'
+            self.send_response(404)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = _counting_server(port, _NotFound)
+    pool = connpool.ConnectionPool()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            pool.request("GET", f"http://127.0.0.1:{port}/missing")
+        assert ei.value.code == 404
+        assert b"nope" in ei.value.read()
+        # the error response was drained: the socket is reusable
+        assert pool.idle_count("127.0.0.1", port) == 1
+    finally:
+        pool.close_all()
+        _stop_server(httpd)
+
+
+def test_pool_bounds_idle_connections():
+    port = free_port()
+    httpd = _counting_server(port)
+    pool = connpool.ConnectionPool(max_idle_per_host=2)
+    try:
+        # three conns held concurrently, all released: only two kept
+        rs = [pool.request("GET", f"http://127.0.0.1:{port}/c{i}")
+              for i in range(3)]
+        for r in rs:
+            r.read()
+        assert httpd.conn_count == 3
+        assert pool.idle_count("127.0.0.1", port) == 2
+    finally:
+        pool.close_all()
+        _stop_server(httpd)
+
+
+# ---------------------------------------------------------------------------
+# hot-needle cache
+# ---------------------------------------------------------------------------
+
+
+def _store(tmp_path, **kw) -> Store:
+    s = Store([str(tmp_path)], needle_cache_mb=kw.pop("needle_cache_mb", 8))
+    s.add_volume(1, "")
+    return s
+
+
+def _needle(nid: int, data: bytes, cookie: int = 0x1234) -> Needle:
+    return Needle(cookie=cookie, id=nid, data=data)
+
+
+def test_needle_cache_hit_and_write_invalidation(tmp_path):
+    s = _store(tmp_path)
+    try:
+        s.write_needle(1, _needle(7, b"v1"))
+        assert s.read_needle(1, 7).data == b"v1"  # miss, fills cache
+        assert len(s.needle_cache) == 1
+        assert s.read_needle(1, 7).data == b"v1"  # hit
+        # overwrite must invalidate: the next read sees v2, never v1
+        s.write_needle(1, _needle(7, b"v2"))
+        assert len(s.needle_cache) == 0
+        assert s.read_needle(1, 7).data == b"v2"
+    finally:
+        s.close()
+
+
+def test_needle_cache_delete_invalidation(tmp_path):
+    s = _store(tmp_path)
+    try:
+        s.write_needle(1, _needle(9, b"doomed"))
+        assert s.read_needle(1, 9).data == b"doomed"
+        s.delete_needle(1, 9)
+        with pytest.raises(KeyError):
+            s.read_needle(1, 9)
+    finally:
+        s.close()
+
+
+def test_needle_cache_vacuum_invalidation(tmp_path):
+    s = _store(tmp_path)
+    try:
+        for nid in (1, 2, 3):
+            s.write_needle(1, _needle(nid, f"blob-{nid}".encode() * 50))
+        s.delete_needle(1, 2)
+        for nid in (1, 3):
+            s.read_needle(1, nid)
+        assert len(s.needle_cache) == 2
+        s.compact_volume(1)
+        s.commit_compact_volume(1)
+        # vacuum rewrote every offset: the volume's entries are gone...
+        assert len(s.needle_cache) == 0
+        # ...and post-vacuum reads still serve the right bytes
+        assert s.read_needle(1, 1).data == b"blob-1" * 50
+        with pytest.raises(KeyError):
+            s.read_needle(1, 2)
+    finally:
+        s.close()
+
+
+def test_needle_cache_cookie_checked_on_hit(tmp_path):
+    s = _store(tmp_path)
+    try:
+        s.write_needle(1, _needle(5, b"secret", cookie=0xAA))
+        s.read_needle(1, 5)  # fill
+        with pytest.raises(PermissionError):
+            s.read_needle(1, 5, expected_cookie=0xBB)
+        assert s.read_needle(1, 5, expected_cookie=0xAA).data == b"secret"
+    finally:
+        s.close()
+
+
+def test_needle_cache_byte_bound_evicts():
+    cache = NeedleCache(limit_bytes=4096, max_entry_bytes=4096)
+    for nid in range(10):
+        cache.put(1, nid, _needle(nid, b"z" * 1024))
+    # (1024 + 64) per entry under a 4096 bound -> only 3 fit
+    assert len(cache) == 3
+    assert cache.get(1, 9) is not None  # newest survives
+    assert cache.get(1, 0) is None  # oldest evicted
+
+
+# ---------------------------------------------------------------------------
+# lock-free concurrent reads
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_reads_on_one_volume_overlap(tmp_path):
+    """Two GETs on one volume must overlap their disk I/O.  A 0.4s
+    faultpoint delay sits INSIDE the (unlocked) disk-read section; two
+    threads reading serially would take >= 0.8s, overlapped ~0.4s."""
+    vol = make_volume(str(tmp_path), n_needles=4)
+    try:
+        faultpoint.set_fault("volume.disk.read", "delay", delay=0.4, count=2)
+        results = {}
+
+        def read(nid: int) -> None:
+            results[nid] = vol.read_needle(nid)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=read, args=(nid,))
+                   for nid in (1, 2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        assert results[1].id == 1 and results[2].id == 2
+        assert elapsed < 0.7, (
+            f"reads serialized: {elapsed:.2f}s for two 0.4s-delayed reads")
+    finally:
+        faultpoint.clear_fault("volume.disk.read")
+        vol.close()
+
+
+def test_read_survives_racing_vacuum(tmp_path):
+    """A read that snapshots the .dat handle right before a vacuum swap
+    retries under the lock and still returns the right bytes."""
+    from seaweedfs_tpu.storage.vacuum import vacuum_volume
+
+    vol = make_volume(str(tmp_path), n_needles=30)
+    try:
+        stop = threading.Event()
+        errors = []
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    n = vol.read_needle(7)
+                    assert n.id == 7
+                except Exception as e:  # noqa: BLE001
+                    errors.append(e)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        for _ in range(3):
+            vacuum_volume(vol)
+        stop.set()
+        t.join()
+        assert not errors, errors[:3]
+    finally:
+        vol.close()
+
+
+# ---------------------------------------------------------------------------
+# HEAD from metadata (no image transforms)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def mini_cluster(tmp_path_factory):
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    master = MasterServer(ip="127.0.0.1", port=free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    vs_ = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("vol"))],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+        max_volume_count=8,
+    )
+    vs_.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and len(master.topo.nodes) < 1:
+        time.sleep(0.1)
+    assert master.topo.nodes
+    yield master, vs_
+    vs_.stop()
+    master.stop()
+
+
+def test_head_skips_image_pipeline(mini_cluster, monkeypatch):
+    from seaweedfs_tpu import images
+
+    master, vs_ = mini_cluster
+    with connpool.request(
+            "GET",
+            f"http://127.0.0.1:{master.port}/dir/assign") as r:
+        a = json.loads(r.read())
+    payload = b"\xff\xd8\xff\xe0 not really a jpeg \xff\xd9" * 40
+    body = (b"--bb\r\nContent-Disposition: form-data; name=\"file\"; "
+            b"filename=\"photo.jpg\"\r\n"
+            b"Content-Type: image/jpeg\r\n\r\n"
+            + payload + b"\r\n--bb--\r\n")
+    url = f"http://{a['url']}/{a['fid']}"
+    with connpool.request(
+            "POST", url, body=body,
+            headers={"Content-Type":
+                     "multipart/form-data; boundary=bb"}) as r:
+        assert r.status == 201
+
+    calls = []
+    orig = images.fix_orientation
+    monkeypatch.setattr(
+        images, "fix_orientation",
+        lambda data: calls.append(1) or orig(data))
+
+    with connpool.request("HEAD", url) as r:
+        assert r.status == 200
+        assert r.read() == b""
+        assert int(r.headers["Content-Length"]) == len(payload)
+        assert r.headers["Etag"]
+        assert r.headers["Content-Type"] == "image/jpeg"
+    assert calls == [], "HEAD ran the image transform pipeline"
+
+    with connpool.request("GET", url) as r:
+        assert r.status == 200
+        assert r.read() == payload
+    assert calls, "GET should still run the image pipeline"
+
+    # range semantics survive the metadata-only HEAD path
+    with connpool.request("HEAD", url,
+                          headers={"Range": "bytes=0-99"}) as r:
+        assert r.status == 206
+        assert r.read() == b""
+        assert r.headers["Content-Range"] == f"bytes 0-99/{len(payload)}"
+        assert int(r.headers["Content-Length"]) == 100
+
+
+# ---------------------------------------------------------------------------
+# _writev_all index bookkeeping
+# ---------------------------------------------------------------------------
+
+
+def test_writev_all_chunks_past_iov_max(tmp_path, monkeypatch):
+    from seaweedfs_tpu.storage.ec import encoder
+
+    monkeypatch.setattr(encoder, "_IOV_MAX", 4)
+    bufs = [bytes([i % 251]) * (i % 7 + 1) for i in range(100)]
+    want = b"".join(bufs)
+    path = tmp_path / "iov.bin"
+    import os
+
+    fd = os.open(str(path), os.O_WRONLY | os.O_CREAT)
+    try:
+        encoder._writev_all(fd, list(bufs))
+    finally:
+        os.close(fd)
+    assert path.read_bytes() == want
