@@ -62,7 +62,7 @@ class InstrumentedCodec:
     """
 
     _TIMED = frozenset({
-        "encode", "parity_of", "parity_into",
+        "encode", "parity_of", "parity_into", "apply_rows",
         "reconstruct", "reconstruct_data", "reconstruct_one", "verify",
     })
 
@@ -85,7 +85,10 @@ class InstrumentedCodec:
         perf_counter = time.perf_counter
 
         def timed(*args, **kwargs):
-            nbytes = _arg_bytes(args[0]) if args else 0
+            # max over the first two args: apply_rows leads with the tiny
+            # plan matrix, every other op leads with the shard payload
+            nbytes = max(
+                (_arg_bytes(a) for a in args[:2]), default=0) if args else 0
             t0 = perf_counter()
             try:
                 # metrics always; spans only inside an active trace — a

@@ -19,6 +19,8 @@ therefore cached per survivor set.
 from __future__ import annotations
 
 import functools
+import threading
+from collections import OrderedDict
 
 import numpy as np
 
@@ -186,27 +188,88 @@ def decode_matrix_for(
     matrix that maps the first `data_shards` present shards back to the data
     shards.  Rows of `matrix` correspond to shard ids.
 
-    Cached per (matrix, survivor set): a degraded-read storm reconstructs
-    thousands of intervals against the SAME missing shards, and the 10x10
-    GF inversion was the hottest single function in that profile."""
+    A thin view over decode_plan_for (wanted = every data shard), so the
+    inversion is shared with every other consumer of the plan cache."""
+    return decode_plan_for(
+        matrix, data_shards, present, tuple(range(data_shards)))
+
+
+def decode_plan_for(
+    matrix: np.ndarray,
+    data_shards: int,
+    present: "list[int] | tuple[int, ...]",
+    wanted: "list[int] | tuple[int, ...]",
+) -> np.ndarray:
+    """The (len(wanted) x data_shards) GF matrix mapping the FIRST
+    `data_shards` present shards to the `wanted` shard ids — the whole
+    decode program for one survivor set, inversion and parity-row
+    composition included.
+
+    Cached per (matrix, survivor set, wanted set) behind one lock: a
+    degraded-read storm reconstructs thousands of intervals against the
+    SAME missing shards, and the 10x10 GF inversion (plus, for parity
+    targets, a GF row-by-matrix product per call) was the hottest single
+    function in that profile.  The cache is a bounded LRU — the full
+    RS(10,4) space is C(14,10) survivor sets x a handful of wanted sets,
+    so steady state is all hits; rs_cpu, rs_jax and the rebuild pipeline
+    all share it.  Hit/miss rates are exported as
+    seaweedfs_ec_decode_plan_total{result}.
+    """
     if len(present) < data_shards:
         raise ValueError(
             f"need {data_shards} shards to decode, have {len(present)}"
         )
-    key = (matrix.shape, matrix.tobytes(),
-           tuple(present[:data_shards]))
-    cached = _DECODE_CACHE.get(key)
-    if cached is None:
-        rows = matrix[np.asarray(present[:data_shards], dtype=np.int64)]
-        cached = mat_inv(rows)
-        cached.setflags(write=False)
-        if len(_DECODE_CACHE) > 256:  # plenty for every survivor set seen
-            _DECODE_CACHE.clear()
-        _DECODE_CACHE[key] = cached
-    return cached
+    sources = tuple(present[:data_shards])
+    key = (matrix.shape, matrix.tobytes(), sources, tuple(wanted))
+    with _PLAN_LOCK:
+        cached = _PLAN_CACHE.get(key)
+        if cached is not None:
+            _PLAN_CACHE.move_to_end(key)
+            _plan_metric("hit")
+            return cached
+    _plan_metric("miss")
+    rows = matrix[np.asarray(sources, dtype=np.int64)]
+    dec = mat_inv(rows)
+    plan = np.empty((len(wanted), data_shards), dtype=np.uint8)
+    for i, w in enumerate(wanted):
+        if w < data_shards:
+            plan[i] = dec[w]
+        else:
+            # parity row composed through the decode matrix (GF product)
+            plan[i] = mat_mul(matrix[w:w + 1, :data_shards], dec)[0]
+    plan.setflags(write=False)
+    with _PLAN_LOCK:
+        _PLAN_CACHE[key] = plan
+        while len(_PLAN_CACHE) > _PLAN_CACHE_MAX:
+            _PLAN_CACHE.popitem(last=False)
+    return plan
 
 
-_DECODE_CACHE: dict = {}
+# >= C(14,10)=1001 survivor sets x the few wanted-sets each sees in
+# practice; LRU so a long-lived server with exotic shard geometries can
+# never grow without bound
+_PLAN_CACHE_MAX = 4096
+_PLAN_CACHE: OrderedDict[tuple, np.ndarray] = OrderedDict()
+_PLAN_LOCK = threading.Lock()
+
+
+def _plan_metric(result: str) -> None:
+    # lazy: keeps gf256 importable (and the tables usable) even if the
+    # stats package is mid-import on some exotic path
+    global _PLAN_HIT, _PLAN_MISS
+    if _PLAN_HIT is None:
+        try:
+            from ..stats.metrics import EC_DECODE_PLAN
+
+            _PLAN_HIT = EC_DECODE_PLAN.labels("hit")
+            _PLAN_MISS = EC_DECODE_PLAN.labels("miss")
+        except ImportError:  # pragma: no cover
+            return
+    (_PLAN_HIT if result == "hit" else _PLAN_MISS).inc()
+
+
+_PLAN_HIT = None
+_PLAN_MISS = None
 
 
 def bit_matrix(matrix: np.ndarray) -> np.ndarray:

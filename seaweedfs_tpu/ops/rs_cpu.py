@@ -70,6 +70,13 @@ class ReedSolomon:
 
     # -- public API -------------------------------------------------------
 
+    def apply_rows(self, rows: np.ndarray,
+                   inputs: list[np.ndarray]) -> list[np.ndarray]:
+        """Arbitrary GF matrix application over equal-length byte rows —
+        the decode-plan entry used by the pipelined rebuild (same native
+        SIMD kernel as encode parity)."""
+        return self._apply(rows, inputs)
+
     def parity_into(self, inputs: list[np.ndarray],
                     outs: list[np.ndarray]) -> None:
         """Parity from arbitrary equal-length contiguous 1-D row buffers
@@ -143,13 +150,10 @@ class ReedSolomon:
             raise ValueError("too few shards to reconstruct")
         sub = present[: self.data_shards]
         sub_shards = [np.asarray(shards[i], dtype=np.uint8) for i in sub]
-        dec = gf256.decode_matrix_for(self.matrix, self.data_shards, present)
-        if shard_id < self.data_shards:
-            row = dec[shard_id:shard_id + 1]
-        else:
-            # parity row composed through the decode matrix (GF product)
-            row = gf256.mat_mul(
-                self.matrix[shard_id:shard_id + 1, : self.data_shards], dec)
+        # one cached plan row per (survivor set, shard): the inversion AND
+        # the parity-row composition both come out of the shared cache
+        row = gf256.decode_plan_for(
+            self.matrix, self.data_shards, present, (shard_id,))
         return self._apply(row, sub_shards)[0]
 
     def reconstruct_data(self, shards: list[np.ndarray | None]) -> list[np.ndarray]:
@@ -175,8 +179,8 @@ class ReedSolomon:
         out = list(shards)
 
         if missing_data:
-            dec = gf256.decode_matrix_for(self.matrix, self.data_shards, present)
-            rows = dec[np.asarray(missing_data)]
+            rows = gf256.decode_plan_for(
+                self.matrix, self.data_shards, present, tuple(missing_data))
             recovered = self._apply(rows, sub_shards)
             for i, r in zip(missing_data, recovered):
                 out[i] = r

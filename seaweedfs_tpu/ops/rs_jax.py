@@ -223,8 +223,8 @@ class ReedSolomonTPU:
         out = list(shards)
         missing_data = [i for i in range(self.data_shards) if shards[i] is None]
         if missing_data:
-            dec = gf256.decode_matrix_for(self.matrix, self.data_shards, present)
-            rows = dec[np.asarray(missing_data)]
+            rows = gf256.decode_plan_for(
+                self.matrix, self.data_shards, present, tuple(missing_data))
             with trace.child_span("ec.device_compute", impl=self.impl):
                 dev = jax.block_until_ready(
                     self.apply_rows_device(rows, inputs))

@@ -216,6 +216,8 @@ def do_ec_encode(env: CommandEnv, topo, vid: int, collection: str,
 
 @register("ec.rebuild")
 def ec_rebuild(env: CommandEnv, args: list[str]) -> str:
+    flags = _parse_flags(args)
+    codec = flags.get("codec", "")
     topo = env.topology()
     # vid -> {node_id: bits}
     holdings: dict[int, dict[str, ShardBits]] = {}
@@ -236,12 +238,14 @@ def ec_rebuild(env: CommandEnv, args: list[str]) -> str:
         if count < 10:
             out.append(f"ec.rebuild {vid}: unrepairable ({count} shards)")
             continue
-        out.append(_rebuild_one(env, vid, collections.get(vid, ""), by_node, have))
+        out.append(_rebuild_one(
+            env, vid, collections.get(vid, ""), by_node, have, codec))
     return "\n".join(out) if out else "ec.rebuild: nothing to do"
 
 
 def _rebuild_one(env: CommandEnv, vid: int, collection: str,
-                 by_node: dict[str, ShardBits], have: ShardBits) -> str:
+                 by_node: dict[str, ShardBits], have: ShardBits,
+                 codec: str = "") -> str:
     # rebuilder = node already holding the most shards
     rebuilder = max(by_node, key=lambda n: by_node[n].count())
     stub = env.volume_server(_node_grpc(rebuilder))
@@ -262,7 +266,8 @@ def _rebuild_one(env: CommandEnv, vid: int, collection: str,
         for s in need:
             local = local.add(s)
     resp = stub.VolumeEcShardsRebuild(
-        vs.VolumeEcShardsRebuildRequest(volume_id=vid, collection=collection)
+        vs.VolumeEcShardsRebuildRequest(
+            volume_id=vid, collection=collection, codec=codec)
     )
     rebuilt = list(resp.rebuilt_shard_ids)
     if rebuilt:

@@ -305,6 +305,47 @@ EC_BYTES_HISTOGRAM = REGISTRY.histogram(
     labels=("op", "impl"), buckets=_EC_BYTE_BUCKETS,
 )
 
+# EC repair data plane: shard rebuilds (pipelined read->decode->write in
+# storage/ec/encoder.rebuild_ec_files) and the degraded-read caches.
+# Rebuild traffic dominating cluster I/O is the classic EC failure mode,
+# so its cost and its cache effectiveness are first-class families.
+EC_REBUILD_SECONDS = REGISTRY.histogram(
+    "seaweedfs_ec_rebuild_seconds", "wall time per EC shard rebuild",
+    labels=("impl",), buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0),
+)
+EC_REBUILD_BYTES = REGISTRY.counter(
+    "seaweedfs_ec_rebuild_bytes_total",
+    "source bytes consumed by EC shard rebuilds, by origin",
+    labels=("source",),  # local | remote
+)
+EC_REBUILD_SHARDS = REGISTRY.counter(
+    "seaweedfs_ec_rebuild_shards_total", "shard files reconstructed",
+)
+EC_REBUILD_RESULT = REGISTRY.counter(
+    "seaweedfs_ec_rebuild_total", "rebuild attempts by outcome",
+    labels=("result",),  # ok | error
+)
+
+# decode-plan cache (ops/gf256.decode_plan_for): one GF matrix inversion
+# per survivor set instead of one per slice / per degraded read
+EC_DECODE_PLAN = REGISTRY.counter(
+    "seaweedfs_ec_decode_plan_total", "decode-plan cache lookups by result",
+    labels=("result",),  # hit | miss
+)
+
+# reconstructed-interval LRU + single-flight coalescing on the degraded
+# read path (storage/ec/volume.py)
+EC_INTERVAL_CACHE = REGISTRY.counter(
+    "seaweedfs_ec_interval_cache_total",
+    "reconstructed-interval cache lookups and evictions by result",
+    labels=("result",),  # hit | miss | evict
+)
+EC_SINGLEFLIGHT = REGISTRY.counter(
+    "seaweedfs_ec_singleflight_total",
+    "degraded-read interval reconstructions by single-flight role",
+    labels=("result",),  # leader | coalesced
+)
+
 
 def serve_metrics(port: int, registry: Registry = REGISTRY,
                   host: str = "0.0.0.0") -> ThreadingHTTPServer:

@@ -74,33 +74,45 @@ def find_dat_file_size(data_base_name: str, index_base_name: str) -> int:
     return dat_size
 
 
+# readinto block for the shard->dat stream: 8MB quarters the syscall
+# count vs the old 1MB read()+write() pairs and the reused buffer drops
+# the per-chunk bytes allocation entirely
+_COPY_BLOCK = 8 << 20
+
+
 def write_dat_file(base_name: str, dat_file_size: int) -> None:
     """Assemble .dat from .ec00–.ec09 by walking the stripe layout."""
     ins = [open(base_name + to_ext(i), "rb") for i in range(DATA_SHARDS)]
+    buf = memoryview(bytearray(min(max(dat_file_size, 1), _COPY_BLOCK)))
     try:
         with open(base_name + ".dat", "wb") as out:
             remaining = dat_file_size
             # mirror the encoder's strict-greater large-row loop
             while remaining > DATA_SHARDS * LARGE_BLOCK_SIZE:
                 for f in ins:
-                    _copy(f, out, LARGE_BLOCK_SIZE)
+                    _copy(f, out, LARGE_BLOCK_SIZE, buf)
                 remaining -= DATA_SHARDS * LARGE_BLOCK_SIZE
             while remaining > 0:
                 for f in ins:
                     to_read = min(remaining, SMALL_BLOCK_SIZE)
                     if to_read <= 0:
                         break
-                    _copy(f, out, to_read)
+                    _copy(f, out, to_read, buf)
                     remaining -= to_read
     finally:
         for f in ins:
             f.close()
 
 
-def _copy(src, dst, n: int) -> None:
+def _copy(src, dst, n: int, buf: memoryview | None = None) -> None:
+    """Stream n bytes src->dst through a reused buffer (readinto: no
+    per-chunk bytes object, bigger blocks, fewer syscalls)."""
+    if buf is None:
+        buf = memoryview(bytearray(min(n, _COPY_BLOCK)))
     while n > 0:
-        chunk = src.read(min(n, 1 << 20))
-        if not chunk:
+        want = min(n, len(buf))
+        got = src.readinto(buf[:want])
+        if not got:
             raise IOError("unexpected EOF in shard file")
-        dst.write(chunk)
-        n -= len(chunk)
+        dst.write(buf[:got])
+        n -= got

@@ -16,10 +16,19 @@ because parity is columnwise.
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
+from ...ops import gf256
 from ...ops.codec import get_codec
+from ...stats.metrics import (
+    EC_REBUILD_BYTES,
+    EC_REBUILD_RESULT,
+    EC_REBUILD_SECONDS,
+    EC_REBUILD_SHARDS,
+)
+from ...util import faultpoint
 from ..needle_map import NeedleMap
 from .constants import (
     DATA_SHARDS,
@@ -459,42 +468,296 @@ def _read_into(f, offset: int, dest: memoryview) -> None:
         dest[n:] = bytes(len(dest) - n)
 
 
+# fires once per rebuilt slice, before the source reads — chaos tests
+# kill a rebuild mid-stream here and assert the clean-error contract
+# (partial .ecNN outputs removed, retry succeeds)
+FP_REBUILD_READ = faultpoint.register("ec.rebuild.read")
+
+
+def _pread_into(fd: int, dest, offset: int) -> None:
+    """Positioned read straight into a writable buffer (numpy row), no
+    intermediate bytes object; loops short reads and raises on EOF
+    (shard files have a fixed extent, so a short tail means a racing
+    truncate/re-copy)."""
+    got = 0
+    length = len(dest)
+    while got < length:
+        n = os.preadv(fd, [dest[got:]], offset + got)
+        if n <= 0:
+            raise IOError(f"short shard read at {offset + got}")
+        got += n
+
+
+def _pick_rebuild_sources(
+    base_name: str, local: list[int], remote_fetch
+) -> tuple[list[int], set[int], set[int]]:
+    """-> (DATA_SHARDS source ids local-first, the remote subset of those,
+    ALL remotely-available shard ids).
+
+    Remote availability is probed with a 1-byte interval read through
+    the same fetch hook the streaming loop uses.  Every non-local shard
+    is probed (14 tiny reads worst case) so the caller can limit the
+    rebuild to GLOBALLY missing shards — regenerating a local copy of a
+    shard that is healthy on a peer would double the repair traffic and
+    register duplicate holders with the master."""
+    sources = list(local[:DATA_SHARDS])
+    remote: set[int] = set()
+    remote_available: set[int] = set()
+    if remote_fetch is not None:
+        for sid in range(TOTAL_SHARDS):
+            if sid in local:
+                continue
+            try:
+                probe = remote_fetch(sid, 0, 1)
+            except Exception:
+                probe = None
+            if probe:
+                remote_available.add(sid)
+                if len(sources) < DATA_SHARDS:
+                    sources.append(sid)
+                    remote.add(sid)
+    if len(sources) < DATA_SHARDS:
+        raise ValueError(
+            f"cannot rebuild: only {len(sources)} of {TOTAL_SHARDS} shards "
+            f"reachable ({len(local)} local)"
+        )
+    return sources, remote, remote_available
+
+
 def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
                      slice_size: int = DEFAULT_SLICE,
-                     progress=None) -> list[int]:
+                     progress=None, remote_fetch=None,
+                     shard_size: int | None = None) -> list[int]:
     """Regenerate whichever .ecNN files are missing (ec_encoder.go:61-62).
 
-    Requires >= DATA_SHARDS present shards; streams column slices, runs the
-    decode matmul, writes only the missing shards.  Returns rebuilt ids.
-    `progress(shard_bytes_done)` fires after each reconstructed slice.
+    Runs the same three-stage pipeline as the encode path: a prefetch
+    thread preads the DATA_SHARDS source shards IN PARALLEL into pooled
+    slice buffers, the main thread applies the cached decode plan (async
+    device dispatch for device codecs, one slice always in flight; host
+    codecs compute inline on the SIMD kernel), and a writer thread
+    appends the reconstructed shards — so the rebuild runs at
+    max(read, decode, write) instead of their sum, and reads exactly
+    DATA_SHARDS sources instead of every present shard.
+
+    `remote_fetch(shard_id, offset, length) -> bytes|None` (the same
+    contract as EcVolume.remote_fetch) lets a node holding fewer than
+    DATA_SHARDS local shards stream missing source intervals from peers
+    instead of failing; `shard_size` must be given when no local shard
+    exists to size the stream from.
+
+    On any error the partial .ecNN outputs are REMOVED — a failed
+    rebuild leaves no truncated shard for a later mount to trust.
+    Returns rebuilt ids; `progress(shard_bytes_done)` fires after each
+    reconstructed slice hits the output files.
     """
+    import queue
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
     codec = get_codec(codec_name)
-    present = [i for i in range(TOTAL_SHARDS) if os.path.exists(base_name + to_ext(i))]
-    missing = [i for i in range(TOTAL_SHARDS) if i not in present]
+    impl = getattr(codec, "_impl", codec_name)
+    local = [i for i in range(TOTAL_SHARDS)
+             if os.path.exists(base_name + to_ext(i))]
+    if len(local) == TOTAL_SHARDS:
+        return []
+    sources, remote, remote_available = _pick_rebuild_sources(
+        base_name, local, remote_fetch)
+    # rebuild only GLOBALLY missing shards: a shard healthy on a peer
+    # needs a copy rpc, not a decode (see _pick_rebuild_sources)
+    missing = [i for i in range(TOTAL_SHARDS)
+               if i not in local and i not in remote_available]
     if not missing:
         return []
-    if len(present) < DATA_SHARDS:
+    if local:
+        shard_size = os.path.getsize(base_name + to_ext(local[0]))
+    elif shard_size is None:
         raise ValueError(
-            f"cannot rebuild: only {len(present)} of {TOTAL_SHARDS} shards present"
-        )
-    shard_size = os.path.getsize(base_name + to_ext(present[0]))
-    ins = {i: open(base_name + to_ext(i), "rb") for i in present}
-    outs = {i: open(base_name + to_ext(i), "wb") for i in missing}
+            "cannot rebuild: no local shard and no shard_size given")
+
+    # the whole decode program for this loss pattern, from the shared
+    # plan cache: one 10x10 inversion per survivor set, not per slice
+    rows = gf256.decode_plan_for(
+        codec.matrix, DATA_SHARDS, sources, tuple(missing))
+    is_device_codec = hasattr(codec, "apply_rows_device") and hasattr(
+        codec, "encode_device")
+    if is_device_codec:
+        import jax.numpy as jnp
+
+    # everything that creates on-disk or OS state is populated INSIDE the
+    # guarded try below: the finally owns closing handles and removing
+    # partial outputs, so no setup failure (buffer MemoryError, thread
+    # spawn refusal) can leave a zero-length .ecNN for a mount to trust
+    ins: dict[int, object] = {}
+    outs: dict[int, object] = {}
+    t_start = time.perf_counter()
+
+    pool: queue.Queue = queue.Queue()
+    q: queue.Queue = queue.Queue(maxsize=2)
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _read_source(sid: int, off: int, dest: np.ndarray) -> int:
+        """Fill one source row; returns the bytes fetched remotely."""
+        width = len(dest)
+        if sid in remote:
+            buf = remote_fetch(sid, off, width)
+            if buf is None or len(buf) != width:
+                raise IOError(
+                    f"remote shard {sid} unavailable during rebuild")
+            dest[:] = np.frombuffer(buf, dtype=np.uint8)
+            return width
+        _pread_into(ins[sid].fileno(), dest, off)
+        return 0
+
+    def _get_buffer():
+        """Stop-aware pool.get: a failed writer stops recycling buffers,
+        so a bare blocking get could strand this thread forever and wedge
+        the finally's join."""
+        while not stop.is_set():
+            try:
+                return pool.get(timeout=0.1)
+            except queue.Empty:
+                continue
+        return None
+
+    def reader(fetch_pool: ThreadPoolExecutor) -> None:
+        try:
+            for off in range(0, shard_size, slice_size):
+                width = min(slice_size, shard_size - off)
+                faultpoint.inject(FP_REBUILD_READ, ctx=base_name)
+                buf = _get_buffer()
+                if buf is None:
+                    return
+                view = buf[:, :width]
+                remote_bytes = sum(fetch_pool.map(
+                    lambda j: _read_source(sources[j], off, view[j]),
+                    range(DATA_SHARDS)))
+                if remote_bytes:
+                    EC_REBUILD_BYTES.labels("remote").inc(remote_bytes)
+                EC_REBUILD_BYTES.labels("local").inc(
+                    DATA_SHARDS * width - remote_bytes)
+                if not _put((buf, view, off, width)):
+                    return
+        except Exception as e:  # surfaced by the consumer
+            _put(e)
+            return
+        _put(None)
+
+    wq: queue.Queue = queue.Queue(maxsize=2)
+    write_err: list[Exception] = []
+
+    def writer() -> None:
+        while True:
+            pending = wq.get()
+            if pending is None:
+                return
+            if write_err:
+                continue  # drain so producers never block
+            try:
+                buf, rebuilt, off, width = pending
+                for row, sid in zip(rebuilt, missing):
+                    outs[sid].write(row)
+                pool.put(buf)  # source slice fully consumed: recycle
+                if progress is not None:
+                    progress(off + width)
+            except Exception as e:  # surfaced by the main thread
+                write_err.append(e)
+
+    fetch_pool: "ThreadPoolExecutor | None" = None
+    rt = threading.Thread(target=lambda: reader(fetch_pool),
+                          name="ec-rebuild-prefetch", daemon=True)
+    wt = threading.Thread(target=writer, name="ec-rebuild-writer",
+                          daemon=True)
+
+    def drain(pending) -> None:
+        buf, dev, off, width = pending
+        rebuilt = np.ascontiguousarray(np.asarray(dev, dtype=np.uint8))
+        wq.put((buf, rebuilt, off, width))
+        if write_err:
+            raise write_err[0]
+
+    ok = False
+    pending = None
     try:
-        for off in range(0, shard_size, slice_size):
-            width = min(slice_size, shard_size - off)
-            shards: list[np.ndarray | None] = [None] * TOTAL_SHARDS
-            for i in present:
-                shards[i] = _read_at(ins[i], off, width)
-            rebuilt = codec.reconstruct(shards)
-            for i in missing:
-                outs[i].write(np.ascontiguousarray(
-                    np.asarray(rebuilt[i], dtype=np.uint8)))
-            if progress is not None:
-                progress(off + width)
+        for i in sources:
+            if i not in remote:
+                ins[i] = open(base_name + to_ext(i), "rb")
+        for i in missing:
+            outs[i] = open(base_name + to_ext(i), "wb")
+        # pooled slice buffers: 3 covers one in prefetch, one in compute,
+        # one in the writer, with no per-slice (10, W) allocation churn
+        for _ in range(3):
+            pool.put(np.empty((DATA_SHARDS, slice_size), dtype=np.uint8))
+        fetch_pool = ThreadPoolExecutor(
+            max_workers=DATA_SHARDS, thread_name_prefix="ec-rebuild-read")
+        rt.start()
+        wt.start()
+        while True:
+            item = q.get()
+            if isinstance(item, Exception):
+                raise item
+            if item is None:
+                break
+            buf, view, off, width = item
+            if not is_device_codec:
+                # host codec: SIMD decode inline, overlap only the I/O
+                rebuilt = codec.apply_rows(rows, list(view))
+                wq.put((buf, rebuilt, off, width))
+                if write_err:
+                    raise write_err[0]
+                continue
+            dev = codec.apply_rows_device(rows, jnp.asarray(view))
+            if pending is not None:
+                drain(pending)  # slice k reads back while k+1 computes
+            pending = (buf, dev, off, width)
+        if pending is not None:
+            drain(pending)
+        wq.put(None)
+        wt.join()
+        if write_err:
+            raise write_err[0]
+        ok = True
     finally:
+        stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        if rt.ident is not None:  # never-started threads cannot be joined
+            rt.join()
+        if wt.ident is not None and wt.is_alive():
+            while True:
+                try:
+                    wq.get_nowait()
+                except queue.Empty:
+                    break
+            wq.put(None)
+            wt.join()
+        if fetch_pool is not None:
+            fetch_pool.shutdown(wait=False)
         for h in ins.values():
             h.close()
         for h in outs.values():
             h.close()
+        EC_REBUILD_SECONDS.labels(impl).observe(time.perf_counter() - t_start)
+        EC_REBUILD_RESULT.labels("ok" if ok else "error").inc()
+        if ok:
+            EC_REBUILD_SHARDS.inc(len(missing))
+        else:
+            # clean-error contract: no truncated shard file survives a
+            # failed rebuild for a later mount to trust
+            for sid in missing:
+                try:
+                    os.remove(base_name + to_ext(sid))
+                except FileNotFoundError:
+                    pass
     return missing

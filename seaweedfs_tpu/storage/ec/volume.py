@@ -17,6 +17,8 @@ from typing import Callable
 import numpy as np
 
 from ...ops.codec import get_codec
+from ...stats.metrics import EC_SINGLEFLIGHT
+from ...util.chunk_cache import IntervalCache
 from .. import idx as idx_mod
 from .. import types as t
 from ..needle import Needle, actual_size
@@ -62,6 +64,45 @@ class EcVolumeShard:
 # fetch_fn(shard_id, offset, length) -> bytes | None  (e.g. a gRPC client)
 FetchFn = Callable[[int, int, int], "bytes | None"]
 
+_SF_LEADER = EC_SINGLEFLIGHT.labels("leader")
+_SF_COALESCED = EC_SINGLEFLIGHT.labels("coalesced")
+
+# one bounded process-wide executor for degraded-read remote fetches:
+# the old per-call ThreadPoolExecutor paid thread spawn+teardown on
+# EVERY reconstructed interval (observed as the top non-I/O cost of a
+# degraded-read storm) and put no ceiling on total fetch threads
+_FETCH_POOL = None
+_FETCH_POOL_LOCK = threading.Lock()
+
+
+def _fetch_pool():
+    global _FETCH_POOL
+    if _FETCH_POOL is None:
+        with _FETCH_POOL_LOCK:
+            if _FETCH_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                workers = int(os.environ.get(
+                    "SEAWEEDFS_TPU_EC_FETCH_WORKERS", "16"))
+                _FETCH_POOL = ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="ec-fetch")
+    return _FETCH_POOL
+
+
+class _SingleFlight:
+    """One in-flight gather+decode; followers wait on the event.  The
+    leader records the invalidation token its gather was captured under
+    so followers can reject a result made stale by a racing
+    mount/unmount/delete."""
+
+    __slots__ = ("done", "result", "err", "token")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.result: bytes | None = None
+        self.err: Exception | None = None
+        self.token: "tuple[int, int] | None" = None
+
 
 class EcVolume:
     """An erasure-coded volume: local shards + .ecx index + .ecj journal."""
@@ -92,7 +133,20 @@ class EcVolume:
         # bumped on every tombstone: the needle cache's compare-before-put
         # token (EC volumes never append, so deletes are the only writers)
         self.delete_seq = 0
+        # bumped on every shard mount/unmount: re-copies swap shard file
+        # contents wholesale, so reconstructed intervals captured under an
+        # older layout must never be served
+        self.mount_seq = 0
         self.remote_fetch: FetchFn | None = None
+        # single-flight state + reconstructed-interval LRU for degraded
+        # reads (0 MB disables the cache; single-flight always on)
+        self._sf_lock = threading.Lock()
+        self._sf_calls: dict[tuple, _SingleFlight] = {}
+        cache_mb = int(os.environ.get(
+            "SEAWEEDFS_TPU_EC_INTERVAL_CACHE_MB", "32"))
+        self._interval_cache = (
+            IntervalCache(cache_mb << 20) if cache_mb > 0 else None
+        )
         for sid in range(TOTAL_SHARDS):
             p = base_name + to_ext(sid)
             if os.path.exists(p):
@@ -100,17 +154,24 @@ class EcVolume:
 
     # -- shard management -------------------------------------------------
 
+    def _invalidate_intervals(self) -> None:
+        self.mount_seq += 1
+        if self._interval_cache is not None:
+            self._interval_cache.clear()
+
     def add_shard(self, shard_id: int) -> bool:
         if shard_id in self.shards:
             return False
         p = self.base_name + to_ext(shard_id)
         self.shards[shard_id] = EcVolumeShard(self.volume_id, shard_id, p)
+        self._invalidate_intervals()
         return True
 
     def delete_shard(self, shard_id: int) -> None:
         sh = self.shards.pop(shard_id, None)
         if sh:
             sh.close()
+            self._invalidate_intervals()
 
     @property
     def shard_size(self) -> int:
@@ -316,14 +377,81 @@ class EcVolume:
         # 3. degraded: reconstruct from any DATA_SHARDS other shards
         return self._reconstruct_interval(shard_id, offset, length)
 
+    def _cache_token(self) -> tuple[int, int]:
+        """Invalidation token for reconstructed intervals: any shard
+        mount/unmount or needle delete makes older captures unservable."""
+        return (self.mount_seq, self.delete_seq)
+
     def _reconstruct_interval(self, shard_id: int, offset: int, length: int) -> bytes:
-        """Gather >= DATA_SHARDS sibling intervals and decode the missing one.
+        """Reconstruct one lost interval, coalesced and cached.
+
+        Single-flight: N concurrent readers of the SAME lost interval
+        trigger ONE gather+decode; the rest wait on the leader's result
+        (seaweedfs_ec_singleflight_total{result}).  Results land in a
+        bounded interval LRU keyed by the volume's (mount_seq,
+        delete_seq) token — compare-before-publish, so a racing shard
+        mount/unmount or delete can never publish a stale interval.
+        """
+        cache = self._interval_cache
+        key = (shard_id, offset, length)
+        if cache is not None:
+            data = cache.get(key, self._cache_token())
+            if data is not None:
+                return data
+        with self._sf_lock:
+            call = self._sf_calls.get(key)
+            leader = call is None
+            if leader:
+                call = _SingleFlight()
+                self._sf_calls[key] = call
+        if not leader:
+            _SF_COALESCED.inc()
+            # generous bound: a wedged leader (remote fetch hang) must not
+            # strand followers forever — they fall back to their own gather
+            if call.done.wait(timeout=60.0):
+                if call.err is not None:
+                    raise call.err
+                # same staleness discipline as the cache: a shard swap or
+                # delete since the leader's capture voids the hand-off
+                if call.token == self._cache_token():
+                    return call.result
+            return self._gather_and_decode(shard_id, offset, length)[0]
+        _SF_LEADER.inc()
+        try:
+            data, token = self._gather_and_decode(shard_id, offset, length)
+            call.result = data
+            call.token = token
+            if cache is not None:
+                # publish under the journal lock: delete_seq bumps happen
+                # under the same lock, so a tombstone that raced the
+                # gather either changed the token (no publish) or is
+                # ordered after this put and clears via the token check
+                with self._ecj_lock:
+                    if token == self._cache_token():
+                        cache.put(key, data, token)
+            return data
+        except Exception as e:
+            call.err = e
+            raise
+        finally:
+            with self._sf_lock:
+                self._sf_calls.pop(key, None)
+            call.done.set()
+
+    def _gather_and_decode(
+        self, shard_id: int, offset: int, length: int
+    ) -> tuple[bytes, tuple[int, int]]:
+        """Gather >= DATA_SHARDS sibling intervals and decode the missing
+        one; returns (bytes, invalidation token captured BEFORE the reads).
 
         Local shards are read inline (microseconds); the remote fetches go
-        out CONCURRENTLY so worst-case degraded latency is ~1 RTT, not 10
-        sequential RTTs (reference: store_ec.go:324-378 fans out one
-        goroutine per source shard and joins them).
+        out CONCURRENTLY on the shared bounded executor so worst-case
+        degraded latency is ~1 RTT, not 10 sequential RTTs (reference:
+        store_ec.go:324-378 fans out one goroutine per source shard and
+        joins them) — and a degraded-read storm no longer spawns a fresh
+        thread pool per interval.
         """
+        token = self._cache_token()
         shards: list[np.ndarray | None] = [None] * TOTAL_SHARDS
         have = 0
         # snapshot in one C-level call: mount/unmount rpcs mutate
@@ -346,19 +474,19 @@ class EcVolume:
             if sid != shard_id and shards[sid] is None
         ]
         if have < DATA_SHARDS and self.remote_fetch is not None and missing:
-            from concurrent.futures import ThreadPoolExecutor
-
             def fetch(sid: int) -> "bytes | None":
                 try:
                     return self.remote_fetch(sid, offset, length)
                 except Exception:
                     return None
 
-            with ThreadPoolExecutor(max_workers=len(missing)) as pool:
-                for sid, buf in zip(missing, pool.map(fetch, missing)):
-                    if buf is not None and len(buf) == length:
-                        shards[sid] = np.frombuffer(buf, dtype=np.uint8)
-                        have += 1
+            futs = [(sid, _fetch_pool().submit(fetch, sid))
+                    for sid in missing]
+            for sid, fut in futs:
+                buf = fut.result()
+                if buf is not None and len(buf) == length:
+                    shards[sid] = np.frombuffer(buf, dtype=np.uint8)
+                    have += 1
         if have < DATA_SHARDS:
             raise IOError(
                 f"shard {shard_id} interval unreadable: only {have} shards available"
@@ -367,6 +495,6 @@ class EcVolume:
             # latency path: decode only the wanted row, not all lost shards
             return np.asarray(
                 self.codec.reconstruct_one(shards, shard_id),
-                dtype=np.uint8).tobytes()
+                dtype=np.uint8).tobytes(), token
         rebuilt = self.codec.reconstruct(shards)
-        return np.asarray(rebuilt[shard_id], dtype=np.uint8).tobytes()
+        return np.asarray(rebuilt[shard_id], dtype=np.uint8).tobytes(), token

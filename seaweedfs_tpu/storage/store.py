@@ -345,8 +345,27 @@ class Store:
 
     def rebuild_ec_shards(self, vid: int, collection: str,
                           codec_name: str | None = None) -> list[int]:
+        """Rebuild locally-missing shard files.  A node holding fewer
+        than DATA_SHARDS local shards streams the missing SOURCE
+        intervals from peers through the same gRPC shard-read fetcher
+        the degraded-read path uses, instead of failing (the shell's
+        gather-copies-first flow still works and simply never needs the
+        hook)."""
         base = self._ec_base(vid, collection)
-        return rebuild_ec_files(base, codec_name=codec_name or self.codec_name)
+        remote_fetch = None
+        shard_size = None
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            remote_fetch = ev.remote_fetch
+            try:
+                shard_size = ev.shard_size or None
+            except (OSError, IOError):
+                shard_size = None
+        elif self.ec_fetcher_factory is not None:
+            remote_fetch = self.ec_fetcher_factory(vid)
+        return rebuild_ec_files(
+            base, codec_name=codec_name or self.codec_name,
+            remote_fetch=remote_fetch, shard_size=shard_size)
 
     def _ec_base(self, vid: int, collection: str = "") -> str:
         for loc in self.locations:

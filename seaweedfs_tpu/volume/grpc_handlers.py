@@ -276,11 +276,21 @@ class VolumeGrpcService:
         return vs.VolumeEcShardsGenerateResponse()
 
     def VolumeEcShardsRebuild(self, request, context):
-        rebuilt = self.store.rebuild_ec_shards(
-            request.volume_id,
-            request.collection,
-            codec_name=request.codec or None,
-        )
+        try:
+            rebuilt = self.store.rebuild_ec_shards(
+                request.volume_id,
+                request.collection,
+                codec_name=request.codec or None,
+            )
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except ValueError as e:
+            # too few reachable source shards: a precondition, not a crash
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        except OSError as e:
+            # a source died mid-rebuild; partial outputs were removed, so
+            # the caller can safely retry against surviving holders
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         return vs.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
 
     def VolumeEcShardsCopy(self, request, context):

@@ -455,6 +455,61 @@ def test_chaos_breaker_opens_and_recovers(chaos_cluster):
 
 
 @pytest.mark.chaos
+def test_chaos_rebuild_source_dies_midstream(tmp_path):
+    """Rebuild smoke for the pipelined repair plane: a source failing
+    mid-rebuild (the `ec.rebuild.read` faultpoint, armed to fire once a
+    few slices in) must surface a clean error with every partial .ecNN
+    output removed — and the retry, with the fault exhausted, must
+    rebuild byte-identical shards.  Exercises the pipeline's
+    error/drain paths (prefetch bail-out, writer drain, output cleanup)."""
+    import os
+
+    from helpers import make_volume
+
+    from seaweedfs_tpu.storage.ec import constants as ecc
+    from seaweedfs_tpu.storage.ec.encoder import generate_ec_files, \
+        rebuild_ec_files
+    from seaweedfs_tpu.util import faultpoint
+
+    vol = make_volume(str(tmp_path), n_needles=80, seed=23, max_size=4000)
+    base = vol.file_name()
+    vol.close()
+    generate_ec_files(base, large_block_size=10000, small_block_size=100,
+                      codec_name="cpu", slice_size=1 << 20)
+    originals = {}
+    for sid in (0, 1, 12, 13):
+        p = base + ecc.to_ext(sid)
+        originals[sid] = open(p, "rb").read()
+        os.remove(p)
+
+    threads_before = threading.active_count()
+    fired_before = faultpoint.FAULT_COUNTER.labels("ec.rebuild.read").value
+    # error once: fires while the output files are already open, so the
+    # cleanup contract is exercised (test_ec_repair.py additionally
+    # kills a remote source several slices in)
+    faultpoint.set_fault("ec.rebuild.read", "error", count=1)
+    try:
+        with pytest.raises(IOError):
+            rebuild_ec_files(base, codec_name="cpu", slice_size=1000)
+    finally:
+        faultpoint.clear_fault("ec.rebuild.read")
+    assert faultpoint.FAULT_COUNTER.labels("ec.rebuild.read").value \
+        > fired_before
+    for sid in originals:
+        assert not os.path.exists(base + ecc.to_ext(sid)), \
+            f"partial shard {sid} must not survive a failed rebuild"
+
+    # retry with the fault cleared: clean success, byte-identical
+    rebuilt = rebuild_ec_files(base, codec_name="cpu", slice_size=1000)
+    assert sorted(rebuilt) == sorted(originals)
+    for sid, want in originals.items():
+        assert open(base + ecc.to_ext(sid), "rb").read() == want
+    # the pipeline's prefetch/writer threads drained on BOTH paths
+    time.sleep(0.2)
+    assert threading.active_count() <= threads_before + 1
+
+
+@pytest.mark.chaos
 def test_chaos_read_falls_back_to_ec_rebuild(chaos_cluster):
     """After the chunk volume is erasure-coded away (original replicas
     deleted), a filer read must still produce byte-identical content by
