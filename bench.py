@@ -510,8 +510,67 @@ def _degraded_read_rate(n_needles: int = 600, needle_kb: int = 64,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _scrape_metrics(url: str) -> dict:
+    """-> {sample_name_with_labels: float} for counter/gauge samples."""
+    import urllib.request
+
+    from seaweedfs_tpu.telemetry.federation import parse_exposition
+
+    with urllib.request.urlopen(url, timeout=10) as r:
+        families, samples = parse_exposition(r.read().decode())
+    out = {}
+    for family, sample_name, value in samples:
+        if families.get(family, ("",))[0] in ("counter", "gauge"):
+            try:
+                out[sample_name] = float(value)
+            except ValueError:
+                continue
+    return out
+
+
+# counter families worth folding into bench JSON: cache effectiveness,
+# connection reuse, and retry pressure explain a rate delta between runs
+_SNAPSHOT_PREFIXES = (
+    "seaweedfs_needle_cache_", "seaweedfs_chunk_cache_total",
+    "seaweedfs_connpool_reuse_total", "seaweedfs_connpool_dial_total",
+    "seaweedfs_connpool_evict_total", "seaweedfs_retry_total",
+    "seaweedfs_replication_error_total", "seaweedfs_request_total",
+)
+
+
+def _metrics_delta(before: dict, after: dict) -> dict:
+    """Counter deltas over a bench run, filtered to the families above,
+    zero deltas dropped; plus derived hit/reuse rates."""
+    delta = {}
+    for name, v in after.items():
+        if not name.startswith(_SNAPSHOT_PREFIXES):
+            continue
+        d = v - before.get(name, 0.0)
+        if d:
+            delta[name] = round(d, 3)
+
+    def d(name: str) -> float:
+        return delta.get(name, 0.0)
+
+    out = {"metrics_delta": delta}
+    hits, misses = d("seaweedfs_needle_cache_hit_total"), d(
+        "seaweedfs_needle_cache_miss_total")
+    if hits + misses > 0:
+        out["needle_cache_hit_rate"] = round(hits / (hits + misses), 4)
+    reuse, dial = d("seaweedfs_connpool_reuse_total"), d(
+        "seaweedfs_connpool_dial_total")
+    if reuse + dial > 0:
+        out["connpool_reuse_rate"] = round(reuse / (reuse + dial), 4)
+    retries = sum(v for k, v in delta.items()
+                  if k.startswith("seaweedfs_retry_total"))
+    if retries:
+        out["retries_during_run"] = round(retries, 1)
+    return out
+
+
 def _smallfile_rates(n: int = 20000, concurrency: int = 16,
-                     payload_bytes: int = 1024) -> dict:
+                     payload_bytes: int = 1024,
+                     metrics_snapshot: bool = False) -> dict:
     """The reference's ONLY published benchmark: random write then read
     of 1KB files at c=16 through the full HTTP data path (README.md:
     514-567, `weed benchmark` defaults benchmark.go:57-59).  Runs an
@@ -560,6 +619,11 @@ def _smallfile_rates(n: int = 20000, concurrency: int = 16,
         deadline = time.time() + 15
         while time.time() < deadline and len(master.topo.nodes) < 1:
             time.sleep(0.1)
+        # --metrics-snapshot: counter state before the run; the delta at
+        # the end explains the measured rates (cache hit rates, connpool
+        # reuse vs dial, retry pressure) in the emitted JSON
+        m_before = (_scrape_metrics(f"http://127.0.0.1:{vs_.port}/metrics")
+                    if metrics_snapshot else None)
         # pre-assign fids in bulk through the master (the reference
         # assigns per write; bulk keeps the master out of the hot loop
         # measurement the same way its writeBenchmark reuses assigns)
@@ -665,6 +729,10 @@ def _smallfile_rates(n: int = 20000, concurrency: int = 16,
                 lat[int(len(lat) * 0.99) - 1] * 1000, 2) if lat else None,
             "smallfile_read_failed": n - len(lat),
         })
+        if m_before is not None:
+            out.update(_metrics_delta(
+                m_before,
+                _scrape_metrics(f"http://127.0.0.1:{vs_.port}/metrics")))
         return out
     finally:
         vs_.stop()
@@ -836,7 +904,8 @@ def main() -> None:
         return
     if "--smallfile-only" in sys.argv:
         try:
-            print(json.dumps(_smallfile_rates()))
+            print(json.dumps(_smallfile_rates(
+                metrics_snapshot="--metrics-snapshot" in sys.argv)))
         except Exception as exc:  # noqa: BLE001
             print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
         return
@@ -958,7 +1027,10 @@ def main() -> None:
     # the reference's ONLY published numbers: 1KB files at c=16 through
     # the full HTTP path (README.md:514-567) — measured on the same host
     try:
-        out.update(_smallfile_rates())
+        import sys as _sys
+
+        out.update(_smallfile_rates(
+            metrics_snapshot="--metrics-snapshot" in _sys.argv))
     except Exception as exc:  # noqa: BLE001
         out["smallfile_error"] = f"{type(exc).__name__}: {exc}"[:300]
     print(json.dumps(out))

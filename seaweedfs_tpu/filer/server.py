@@ -9,7 +9,6 @@ volume-server upload, then one CreateEntry records the chunk list
 
 from __future__ import annotations
 
-import concurrent.futures
 import os
 import random
 import struct
@@ -23,6 +22,7 @@ from ..operation.assign import AssignResult, assign_any
 from ..pb import filer_pb2
 from ..pb import rpc as rpclib
 from ..util.chunk_cache import TieredChunkCache
+from ..util.executors import MeteredThreadPoolExecutor
 from ..wdclient import MasterClient
 from . import filechunk_manifest, filechunks
 from .filer import Filer, split_path
@@ -76,7 +76,9 @@ class FilerServer:
                 raise ValueError(
                     f"filer peer {p!r} must be host:port (http address)")
         self.metrics_port = metrics_port
-        self.master_client = MasterClient(f"filer@{ip}:{port}", self.masters)
+        self.master_client = MasterClient(
+            f"filer@{ip}:{port}", self.masters,
+            client_type="filer", http_address=f"{ip}:{port}")
         opts = dict(store_options or {})
         if store == "memory":
             self.filer = Filer(make_store("memory"), self._delete_chunks,
@@ -109,7 +111,10 @@ class FilerServer:
         self._brokers: dict[str, list[str]] = {}
         self._grpc_server = None
         self._httpd = None
-        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=8)
+        # chunk fan-out (parallel chunk uploads + chunk-view reads):
+        # saturation visible as seaweedfs_executor_*{executor="filer_chunk"}
+        self._pool = MeteredThreadPoolExecutor(
+            max_workers=8, name="filer_chunk")
         # tiered read cache + manifest batching (reader_at.go:88-104,
         # filechunk_manifest.go)
         self.chunk_cache = TieredChunkCache(

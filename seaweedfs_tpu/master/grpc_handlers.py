@@ -80,6 +80,12 @@ class MasterGrpcService:
                     new_vids += [m.id for m in hb.new_volumes]
                     deleted_vids += [m.id for m in hb.deleted_volumes]
                 node.last_seen = time.monotonic()
+                if hb.HasField("stats"):
+                    # federation fallback: keep the node's last stats
+                    # snapshot for /cluster/metrics when a live scrape
+                    # can't reach it
+                    self.master.record_stats_snapshot(
+                        node.id, "volume", hb.stats)
                 if deleted_vids:
                     # vids gone from this node must leave the writable
                     # sets too — rebuild_layouts only ever registers, so
@@ -109,9 +115,22 @@ class MasterGrpcService:
             return
         q: queue.Queue = queue.Queue()
         self.master.subscribe(q)
+        registered_name, registration = "", None
         try:
-            first = next(iter(request_iterator), None)
-            _ = first
+            req_iter = iter(request_iterator)
+            first = next(req_iter, None)
+            if first is not None and first.client_type:
+                # federation registration: a filer (or other scrapeable
+                # client) announces its HTTP address; later requests on
+                # the same stream refresh its stats snapshot
+                registered_name = first.name
+                registration = self.master.register_client(
+                    first.name, first.client_type, first.http_address)
+                self._ingest_client_stats(first)
+                threading.Thread(
+                    target=self._drain_client_stream,
+                    args=(req_iter,), daemon=True,
+                    name="keepconnected-stats").start()
             # initial snapshot: all known volume locations
             with self.topo.lock:
                 for n in self.topo.nodes.values():
@@ -137,6 +156,26 @@ class MasterGrpcService:
                 yield loc
         finally:
             self.master.unsubscribe(q)
+            if registered_name:
+                # token-guarded: only removes OUR registration, never a
+                # reconnected stream's fresher one
+                self.master.unregister_client(registered_name, registration)
+
+    def _ingest_client_stats(self, req) -> None:
+        if req.HasField("stats") and req.http_address:
+            self.master.record_stats_snapshot(
+                req.http_address, req.client_type or "client", req.stats)
+
+    def _drain_client_stream(self, req_iter) -> None:
+        """Consume a registered client's stats refreshes (the stream
+        otherwise only matters at open time)."""
+        try:
+            for req in req_iter:
+                if req.client_type:
+                    self.master.touch_client(req.name)
+                    self._ingest_client_stats(req)
+        except Exception:  # noqa: BLE001 — stream teardown races are fine
+            pass
 
     # -- assign / lookup --------------------------------------------------
 

@@ -81,6 +81,18 @@ class MasterServer:
         self._httpd = None
         self._metricsd = None
         self.metrics_port = metrics_port
+        # observability plane: registered non-volume clients (filers via
+        # KeepConnected), last-heartbeat stats snapshots per instance,
+        # and the bounded fan-out pool /cluster/{metrics,traces} scrape on
+        self.clients: dict[str, dict] = {}
+        self._clients_lock = threading.Lock()
+        self.stats_snapshots: dict[str, dict] = {}
+        self._snapshots_lock = threading.Lock()
+        from ..util.executors import MeteredThreadPoolExecutor
+
+        self.federation_pool = MeteredThreadPoolExecutor(
+            max_workers=8, name="federation",
+            thread_name_prefix="federation")
         self.jwt_signing_key = (
             jwt_signing_key.encode() if isinstance(jwt_signing_key, str)
             else jwt_signing_key
@@ -140,6 +152,7 @@ class MasterServer:
             self._metricsd.server_close()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
+        self.federation_pool.shutdown(wait=False)
 
     # -- raft plumbing ----------------------------------------------------
 
@@ -502,6 +515,69 @@ class MasterServer:
             if self._admin_locks.get(lock_name) == token:
                 del self._admin_locks[lock_name]
 
+    # -- observability plane ----------------------------------------------
+
+    MAX_STATS_SNAPSHOTS = 256
+
+    def record_stats_snapshot(self, instance: str, node_type: str,
+                              snapshot) -> None:
+        """Keep a node's heartbeat stats snapshot (pb StatsSnapshot) as
+        the /cluster/metrics fallback when a live scrape can't reach it.
+        Survives the node leaving the topology — that is the whole point."""
+        if not snapshot.samples:
+            return
+        with self._snapshots_lock:
+            # pop-then-reinsert keeps the dict ordered by receive time,
+            # so the bound evicts the stalest entry in O(1) — this runs
+            # on every full heartbeat of every volume server
+            self.stats_snapshots.pop(instance, None)
+            self.stats_snapshots[instance] = {
+                "type": node_type,
+                "samples": [(s.name, s.value) for s in snapshot.samples],
+                "captured_at_ms": snapshot.captured_at_ms,
+                "received": time.monotonic(),
+            }
+            if len(self.stats_snapshots) > self.MAX_STATS_SNAPSHOTS:
+                del self.stats_snapshots[next(iter(self.stats_snapshots))]
+
+    def stats_snapshots_snapshot(self) -> dict:
+        with self._snapshots_lock:
+            return dict(self.stats_snapshots)
+
+    def register_client(self, name: str, client_type: str,
+                        http_address: str) -> object:
+        """-> registration token.  Unregistration requires the token: a
+        reconnecting client registers on its new stream BEFORE the old
+        stream's handler notices the break (up to its poll interval), so
+        an unconditional pop would deregister the fresh registration and
+        the client would vanish from the federation plane until its next
+        reconnect."""
+        token = object()
+        with self._clients_lock:
+            self.clients[name] = {
+                "type": client_type,
+                "http_address": http_address,
+                "last_seen": time.monotonic(),
+                "token": token,
+            }
+        return token
+
+    def touch_client(self, name: str) -> None:
+        with self._clients_lock:
+            info = self.clients.get(name)
+            if info is not None:
+                info["last_seen"] = time.monotonic()
+
+    def unregister_client(self, name: str, token: object) -> None:
+        with self._clients_lock:
+            info = self.clients.get(name)
+            if info is not None and info["token"] is token:
+                del self.clients[name]
+
+    def clients_snapshot(self) -> dict:
+        with self._clients_lock:
+            return {k: dict(v) for k, v in self.clients.items()}
+
 
 # ---------------------------------------------------------------------------
 # HTTP API (/dir/assign, /dir/lookup, /cluster/status, /vol/vacuum)
@@ -519,6 +595,8 @@ _MASTER_OPS = {
     "/dir/status": "cluster.status", "/cluster/status": "cluster.status",
     "/cluster/healthz": "cluster.healthz", "/stats/health": "cluster.healthz",
     "/cluster/raft": "cluster.raft",
+    "/cluster/metrics": "cluster.metrics",
+    "/cluster/traces": "cluster.traces",
     "/vol/vacuum": "vol.vacuum", "/vol/grow": "vol.grow",
     "/vol/status": "vol.status", "/col/delete": "col.delete",
     "/submit": "submit", "/debug/profile": "debug.profile",
@@ -685,6 +763,31 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         if serve_debug_http(self, u.path):
             return
 
+        if u.path == "/cluster/metrics":
+            from . import observability
+
+            body = observability.cluster_metrics(self.master).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if u.path == "/cluster/traces":
+            from ..telemetry import parse_trace_query
+            from . import observability
+
+            try:
+                trace_id, limit = parse_trace_query(q)
+            except ValueError as e:
+                return self._json(400, {"error": str(e)})
+            if trace_id is None:
+                return self._json(400, {
+                    "error": "trace=<32-hex trace id> is required "
+                             "(per-node rings are at /debug/traces)"})
+            return self._json(200, observability.cluster_traces(
+                self.master, trace_id, limit))
+
         if (((u.path.startswith("/dir/") and u.path != "/dir/status")
                 or u.path in ("/vol/grow", "/vol/status"))
                 and not self.master.is_leader()):
@@ -727,10 +830,6 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
                     for url, public_url in locations
                 ],
             })
-        if u.path == "/debug/profile":
-            from ..util.grace import profile_status
-
-            return self._json(200, profile_status())
         if u.path in ("/ui", "/ui/", "/ui/index.html"):
             from ..util.ui import render_status_page
 
@@ -761,25 +860,9 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
             self.wfile.write(page)
             return
         if u.path in ("/cluster/status", "/dir/status"):
-            with self.master.topo.lock:
-                return self._json(200, {
-                    "IsLeader": self.master.is_leader(),
-                    "Leader": self.master.leader(),
-                    "MaxVolumeId": self.master.topo.max_volume_id,
-                    "DataNodes": {
-                        n.id: {
-                            "publicUrl": n.public_url,
-                            "volumes": sorted(n.volumes),
-                            "ecShards": {
-                                str(vid): bits.shard_ids()
-                                for vid, bits in n.ec_shards.items()
-                            },
-                            "dataCenter": n.data_center,
-                            "rack": n.rack,
-                        }
-                        for n in self.master.topo.nodes.values()
-                    },
-                })
+            from . import observability
+
+            return self._json(200, observability.cluster_status(self.master))
         if u.path == "/vol/vacuum":
             vacuumed = self.master.vacuum(
                 float(qget("garbageThreshold", "0") or 0) or None

@@ -1,0 +1,65 @@
+"""Cluster observability shell commands.
+
+`cluster.status` renders the master's /cluster/status JSON — topology,
+filer registrations, heartbeat/snapshot ages — as the operator-facing
+one-screen answer to "what does the master think the cluster looks like".
+"""
+
+from __future__ import annotations
+
+import json
+
+from ..util import connpool
+from .commands import CommandEnv, register
+
+
+def _master_http(env: CommandEnv) -> str:
+    """The master's HTTP address, derived from the gRPC one (port-10000
+    convention, the inverse of CommandEnv's construction)."""
+    host, _, port = env.master_grpc.partition(":")
+    return f"{host}:{int(port) - 10000}"
+
+
+@register("cluster.status")
+def cluster_status(env: CommandEnv, args: list[str]) -> str:
+    """cluster.status [-json]  — nodes, filers, liveness, snapshot ages."""
+    addr = _master_http(env)
+    with connpool.request(
+            "GET", f"http://{addr}/cluster/status", timeout=10) as r:
+        doc = json.loads(r.read())
+    if "-json" in args:
+        return json.dumps(doc, indent=2, sort_keys=True)
+    lines = [
+        f"master {addr} leader={doc.get('Leader', '?')} "
+        f"isLeader={doc.get('IsLeader')} "
+        f"maxVolumeId={doc.get('MaxVolumeId')}",
+    ]
+    nodes = doc.get("DataNodes", {})
+    lines.append(f"volume servers ({len(nodes)}):")
+    for nid in sorted(nodes):
+        n = nodes[nid]
+        lines.append(
+            f"  {nid} dc={n.get('dataCenter')} rack={n.get('rack')} "
+            f"volumes={len(n.get('volumes', ()))} "
+            f"ecVolumes={len(n.get('ecShards', {}))} "
+            f"lastBeat={n.get('secondsSinceLastBeat', '?')}s ago")
+    filers = doc.get("Filers", {})
+    lines.append(f"filers ({len(filers)}):")
+    for name in sorted(filers):
+        f = filers[name]
+        lines.append(
+            f"  {name} http={f.get('httpAddress')} "
+            f"lastSeen={f.get('secondsSinceLastSeen', '?')}s ago")
+    snaps = doc.get("StatsSnapshots", {})
+    if snaps:
+        lines.append(f"stats snapshots ({len(snaps)}):")
+        for inst in sorted(snaps):
+            s = snaps[inst]
+            lines.append(
+                f"  {inst} type={s.get('type')} "
+                f"samples={s.get('samples')} "
+                f"age={s.get('ageSeconds', '?')}s")
+    lines.append(
+        f"federated scrape: http://{addr}/cluster/metrics ; "
+        f"stitched traces: http://{addr}/cluster/traces?trace=<id>")
+    return "\n".join(lines)

@@ -120,6 +120,7 @@ def run_maintenance(env: CommandEnv, script=None) -> list[str]:
 
 
 # import command modules for registration side effects
+from . import cluster_commands  # noqa: E402,F401
 from . import ec_commands  # noqa: E402,F401
 from . import fs_commands  # noqa: E402,F401
 from . import volume_commands  # noqa: E402,F401

@@ -216,6 +216,9 @@ class Registry:
             if m is None:
                 m = Histogram(name, help_, tuple(labels), buckets)
                 self._metrics[name] = m
+            elif (type(m) is not Histogram
+                  or m.label_names != tuple(labels)):
+                raise ValueError(self._conflict(name, m))
             return m
 
     def _get_or_make(self, cls, name, help_, labels):
@@ -224,7 +227,31 @@ class Registry:
             if m is None:
                 m = cls(name, help_, labels)
                 self._metrics[name] = m
+            elif type(m) is not cls or m.label_names != labels:
+                # two call sites disagreeing about a family is a bug that
+                # silently corrupts one of them — fail at import, loudly
+                raise ValueError(self._conflict(name, m))
             return m
+
+    @staticmethod
+    def _conflict(name: str, existing: Metric) -> str:
+        return (f"metric family {name!r} already registered as "
+                f"{existing.kind} with labels {existing.label_names}; "
+                "register every family exactly once (stats/metrics.py)")
+
+    def family(self, name: str) -> "Metric | None":
+        """The registered family, trying histogram base names too (so
+        `foo_seconds_bucket` resolves to the `foo_seconds` histogram)."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                return m
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix):
+                    m = self._metrics.get(name[: -len(suffix)])
+                    if m is not None and m.kind == "histogram":
+                        return m
+        return None
 
     def render(self) -> str:
         with self._lock:
@@ -233,6 +260,28 @@ class Registry:
         for m in metrics:
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
+
+    def snapshot_samples(self, max_samples: int = 512) -> list:
+        """-> [(exposition sample name incl. labels, float value)] for
+        every counter and gauge child — the compact stats snapshot a
+        heartbeat carries to the master (federation's fallback for nodes
+        a live scrape cannot reach).  Histograms are skipped: their
+        bucket fan-out would dwarf the beat for tail-latency data the
+        live scrape serves better."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out = []
+        for m in metrics:
+            if m.kind not in ("counter", "gauge"):
+                continue
+            with m._lock:
+                items = list(m._children.items())
+            for key, child in items:
+                out.append((f"{m.name}{m._label_str(key)}",
+                            float(child.value)))
+                if len(out) >= max_samples:
+                    return out
+        return out
 
 
 REGISTRY = Registry()
@@ -344,6 +393,75 @@ EC_SINGLEFLIGHT = REGISTRY.counter(
     "seaweedfs_ec_singleflight_total",
     "degraded-read interval reconstructions by single-flight role",
     labels=("result",),  # leader | coalesced
+)
+
+# fault-tolerance layer (util/failsafe.py, util/faultpoint.py) — declared
+# HERE so the metric-family lint can hold one file to "every family
+# registered exactly once"; the consumers import these bindings
+RETRY_COUNTER = REGISTRY.counter(
+    "seaweedfs_retry_total",
+    "retried failures by caller type, operation and failure reason",
+    labels=("type", "op", "reason"),
+)
+CIRCUIT_STATE = REGISTRY.gauge(
+    "seaweedfs_circuit_state",
+    "per-peer circuit breaker state (0 closed, 1 open, 2 half-open)",
+    labels=("peer",),
+)
+CIRCUIT_TRANSITIONS = REGISTRY.counter(
+    "seaweedfs_circuit_transitions_total",
+    "circuit breaker state transitions by peer and target state",
+    labels=("peer", "to"),
+)
+FAULT_COUNTER = REGISTRY.counter(
+    "seaweedfs_fault_injected_total",
+    "faults injected by point name",
+    labels=("point",),
+)
+
+# -- saturation telemetry (ISSUE 5 leg 3) -----------------------------------
+# a stalled pool is invisible in throughput counters until the damage is
+# done; queue depth + active workers make "which stage is the bottleneck"
+# a PromQL query.  `executor` ∈ replica_fanout | ec_fetch | filer_chunk |
+# ec_rebuild_read | federation (see util/executors.py call sites).
+
+EXECUTOR_QUEUE_DEPTH = REGISTRY.gauge(
+    "seaweedfs_executor_queue_depth",
+    "tasks submitted to a pool but not yet started",
+    labels=("executor",),
+)
+EXECUTOR_ACTIVE = REGISTRY.gauge(
+    "seaweedfs_executor_active_workers",
+    "pool tasks currently executing",
+    labels=("executor",),
+)
+EXECUTOR_MAX = REGISTRY.gauge(
+    "seaweedfs_executor_max_workers",
+    "pool worker capacity (saturation = active / max)",
+    labels=("executor",),
+)
+
+# per-peer connection accounting for the keep-alive pool: in_use counts
+# sockets checked out to in-flight requests, idle counts sockets parked
+# in the pool.  in_use pinned at its ceiling = the peer is saturated.
+CONNPOOL_IN_USE = REGISTRY.gauge(
+    "seaweedfs_connpool_in_use",
+    "pooled connections checked out to in-flight requests, per peer",
+    labels=("peer",),
+)
+CONNPOOL_IDLE = REGISTRY.gauge(
+    "seaweedfs_connpool_idle",
+    "idle pooled connections, per peer",
+    labels=("peer",),
+)
+
+# per-stage wall time inside the pipelined EC encode/rebuild (prefetch /
+# decode / write threads): the pipeline runs at max(stages), so the
+# widest histogram names the bottleneck
+EC_PIPELINE_STAGE = REGISTRY.histogram(
+    "seaweedfs_ec_pipeline_stage_seconds",
+    "per-slice wall time in each EC encode/rebuild pipeline stage",
+    labels=("stage",),  # prefetch | decode | write
 )
 
 

@@ -23,6 +23,7 @@ import numpy as np
 from ...ops import gf256
 from ...ops.codec import get_codec
 from ...stats.metrics import (
+    EC_PIPELINE_STAGE,
     EC_REBUILD_BYTES,
     EC_REBUILD_RESULT,
     EC_REBUILD_SECONDS,
@@ -40,6 +41,13 @@ from .constants import (
 
 # Device batch: bytes per shard per codec call (64 x 256KB reference batches)
 DEFAULT_SLICE = 16 * 1024 * 1024
+
+# per-slice stage timings for the pipelined encode/rebuild: the pipeline
+# runs at max(stage), so bottleneck attribution = the widest histogram
+# (children resolved once — these observe on every slice)
+_STAGE_PREFETCH = EC_PIPELINE_STAGE.labels("prefetch")
+_STAGE_DECODE = EC_PIPELINE_STAGE.labels("decode")
+_STAGE_WRITE = EC_PIPELINE_STAGE.labels("write")
 
 
 def write_sorted_file_from_idx(base_name: str, ext: str = ".ecx") -> None:
@@ -306,8 +314,9 @@ def _encode_stream_pipelined(
         try:
             for batch in _slice_tasks(dat_size, large, small, slice_size):
                 total = sum(seg[3] for seg in batch)
-                data = np.empty((DATA_SHARDS, total), dtype=np.uint8)
-                fill_stripe_rows(f, batch, data)
+                with _STAGE_PREFETCH.time():
+                    data = np.empty((DATA_SHARDS, total), dtype=np.uint8)
+                    fill_stripe_rows(f, batch, data)
                 if not _put(data):
                     return
         except Exception as e:  # surfaced by the consumer
@@ -368,10 +377,11 @@ def _encode_stream_pipelined(
                 continue  # drain the queue so producers never block
             try:  # EVERYTHING must land in write_err, or drain() deadlocks
                 data, parity = pending
-                for i in range(DATA_SHARDS):
-                    outs[i].write(data[i])  # buffer-protocol, no copy
-                for i in range(parity.shape[0]):
-                    outs[DATA_SHARDS + i].write(parity[i])
+                with _STAGE_WRITE.time():
+                    for i in range(DATA_SHARDS):
+                        outs[i].write(data[i])  # buffer-protocol, no copy
+                    for i in range(parity.shape[0]):
+                        outs[DATA_SHARDS + i].write(parity[i])
                 done += data.shape[1] * DATA_SHARDS
                 if progress is not None:
                     progress(min(done, dat_size))
@@ -383,7 +393,11 @@ def _encode_stream_pipelined(
 
     def drain(pending) -> None:
         data, parity_dev, packed = pending
-        parity = np.ascontiguousarray(np.asarray(parity_dev))
+        if isinstance(parity_dev, np.ndarray):  # host codec: timed at dispatch
+            parity = np.ascontiguousarray(parity_dev)
+        else:
+            with _STAGE_DECODE.time():  # device readback = compute completion
+                parity = np.ascontiguousarray(np.asarray(parity_dev))
         if packed:
             parity = parity.view(np.uint8).reshape(parity.shape[0], -1)
         wq.put((data, parity))
@@ -400,7 +414,9 @@ def _encode_stream_pipelined(
                 break
             if not is_device_codec:
                 # synchronous codec: compute here, overlap only the writes
-                drain((item, *dispatch(item)))
+                with _STAGE_DECODE.time():
+                    parity, packed = dispatch(item)
+                drain((item, parity, packed))
                 continue
             parity_dev, packed = dispatch(item)
             if pending is not None:
@@ -554,6 +570,8 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
     import threading
     from concurrent.futures import ThreadPoolExecutor
 
+    from ...util.executors import MeteredThreadPoolExecutor
+
     codec = get_codec(codec_name)
     impl = getattr(codec, "_impl", codec_name)
     local = [i for i in range(TOTAL_SHARDS)
@@ -637,9 +655,10 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
                 if buf is None:
                     return
                 view = buf[:, :width]
-                remote_bytes = sum(fetch_pool.map(
-                    lambda j: _read_source(sources[j], off, view[j]),
-                    range(DATA_SHARDS)))
+                with _STAGE_PREFETCH.time():
+                    remote_bytes = sum(fetch_pool.map(
+                        lambda j: _read_source(sources[j], off, view[j]),
+                        range(DATA_SHARDS)))
                 if remote_bytes:
                     EC_REBUILD_BYTES.labels("remote").inc(remote_bytes)
                 EC_REBUILD_BYTES.labels("local").inc(
@@ -663,8 +682,9 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
                 continue  # drain so producers never block
             try:
                 buf, rebuilt, off, width = pending
-                for row, sid in zip(rebuilt, missing):
-                    outs[sid].write(row)
+                with _STAGE_WRITE.time():
+                    for row, sid in zip(rebuilt, missing):
+                        outs[sid].write(row)
                 pool.put(buf)  # source slice fully consumed: recycle
                 if progress is not None:
                     progress(off + width)
@@ -679,7 +699,8 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
 
     def drain(pending) -> None:
         buf, dev, off, width = pending
-        rebuilt = np.ascontiguousarray(np.asarray(dev, dtype=np.uint8))
+        with _STAGE_DECODE.time():  # device readback = decode completion
+            rebuilt = np.ascontiguousarray(np.asarray(dev, dtype=np.uint8))
         wq.put((buf, rebuilt, off, width))
         if write_err:
             raise write_err[0]
@@ -696,8 +717,9 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
         # one in the writer, with no per-slice (10, W) allocation churn
         for _ in range(3):
             pool.put(np.empty((DATA_SHARDS, slice_size), dtype=np.uint8))
-        fetch_pool = ThreadPoolExecutor(
-            max_workers=DATA_SHARDS, thread_name_prefix="ec-rebuild-read")
+        fetch_pool = MeteredThreadPoolExecutor(
+            max_workers=DATA_SHARDS, name="ec_rebuild_read",
+            thread_name_prefix="ec-rebuild-read")
         rt.start()
         wt.start()
         while True:
@@ -709,7 +731,8 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
             buf, view, off, width = item
             if not is_device_codec:
                 # host codec: SIMD decode inline, overlap only the I/O
-                rebuilt = codec.apply_rows(rows, list(view))
+                with _STAGE_DECODE.time():
+                    rebuilt = codec.apply_rows(rows, list(view))
                 wq.put((buf, rebuilt, off, width))
                 if write_err:
                     raise write_err[0]

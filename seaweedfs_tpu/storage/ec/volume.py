@@ -80,12 +80,13 @@ def _fetch_pool():
     if _FETCH_POOL is None:
         with _FETCH_POOL_LOCK:
             if _FETCH_POOL is None:
-                from concurrent.futures import ThreadPoolExecutor
+                from ...util.executors import MeteredThreadPoolExecutor
 
                 workers = int(os.environ.get(
                     "SEAWEEDFS_TPU_EC_FETCH_WORKERS", "16"))
-                _FETCH_POOL = ThreadPoolExecutor(
-                    max_workers=workers, thread_name_prefix="ec-fetch")
+                _FETCH_POOL = MeteredThreadPoolExecutor(
+                    max_workers=workers, name="ec_fetch",
+                    thread_name_prefix="ec-fetch")
     return _FETCH_POOL
 
 

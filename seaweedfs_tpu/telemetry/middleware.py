@@ -29,7 +29,10 @@ SLOW_REQUEST_SECONDS = float(
 
 DEBUG_TRACES_PATH = "/debug/traces"
 DEBUG_FAULTS_PATH = "/debug/faults"
+DEBUG_PROFILE_PATH = "/debug/profile"
 METRICS_PATH = "/metrics"
+
+TRACE_LIMIT_MAX = 1000
 
 
 @contextmanager
@@ -64,28 +67,102 @@ def http_request(handler, server_type: str, op: str):
             yield span
 
 
-def debug_traces_body(limit: int = 50) -> bytes:
+def debug_traces_body(limit: int = 50, trace_id: str | None = None) -> bytes:
     """JSON body for GET /debug/traces on any server."""
-    return trace.TRACER.traces_json(limit)
+    return trace.TRACER.traces_json(limit, trace_id=trace_id)
+
+
+def parse_trace_query(query: dict) -> tuple[str | None, int]:
+    """Validated (?trace=<32-hex id>, ?limit=N) from a parse_qs dict.
+
+    Raises ValueError with an operator-readable message — the shared
+    input validation for every server's /debug/traces and the master's
+    /cluster/traces (which forwards the same parameters)."""
+    trace_id: str | None = None
+    raw = query.get("trace", [""])[0].strip().lower()
+    if raw:
+        if len(raw) != 32 or not trace._is_hex(raw):
+            raise ValueError("trace must be a 32-hex-char trace id")
+        trace_id = raw
+    raw_limit = query.get("limit", [""])[0].strip()
+    limit = 50
+    if raw_limit:
+        try:
+            limit = int(raw_limit)
+        except ValueError:
+            raise ValueError("limit must be an integer") from None
+        if not 1 <= limit <= TRACE_LIMIT_MAX:
+            raise ValueError(f"limit must be in [1, {TRACE_LIMIT_MAX}]")
+    return trace_id, limit
+
+
+def _send(handler, code: int, body: bytes, ctype: str) -> None:
+    handler.send_response(code)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    if handler.command != "HEAD":
+        handler.wfile.write(body)
+
+
+def _send_error(handler, code: int, message: str) -> None:
+    import json
+
+    _send(handler, code, json.dumps({"error": message}).encode(),
+          "application/json")
 
 
 def serve_debug_http(handler, path: str) -> bool:
-    """Answer /metrics, /debug/traces or /debug/faults on a
-    BaseHTTPRequestHandler.
+    """Answer /metrics, /debug/traces, /debug/faults or /debug/profile on
+    a BaseHTTPRequestHandler.
 
     The one implementation of the observability surface every server
     type mounts on its main HTTP port; returns True when `path` was one
     of the endpoints (response fully written), False otherwise."""
+    import json
+    import urllib.parse
+
     if path == DEBUG_TRACES_PATH:
-        body, ctype = debug_traces_body(), "application/json"
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlparse(handler.path).query)
+        try:
+            trace_id, limit = parse_trace_query(query)
+        except ValueError as e:
+            _send_error(handler, 400, str(e))
+            return True
+        body, ctype = debug_traces_body(limit, trace_id), "application/json"
     elif path == METRICS_PATH:
         from ..stats.metrics import REGISTRY
 
         body, ctype = REGISTRY.render().encode(), "text/plain; version=0.0.4"
-    elif path == DEBUG_FAULTS_PATH:
-        import json
-        import urllib.parse
+    elif path == DEBUG_PROFILE_PATH:
+        from ..util import profiler
+        from ..util.grace import profile_status
 
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlparse(handler.path).query)
+        if query.get("status", [""])[0]:
+            # the pre-sampler status stub, kept for cheap liveness checks
+            body, ctype = (json.dumps(profile_status()).encode(),
+                           "application/json")
+        elif not profiler.enabled():
+            _send_error(handler, 403,
+                        f"profiler disabled ({profiler.DISABLE_VAR}=1)")
+            return True
+        else:
+            try:
+                seconds = float(query.get("seconds", [""])[0]
+                                or profiler.DEFAULT_DURATION_S)
+                hz = int(query.get("hz", [""])[0] or profiler.DEFAULT_HZ)
+                text = profiler.profile_collapsed(seconds, hz)
+            except (ValueError, TypeError) as e:
+                _send_error(handler, 400, str(e))
+                return True
+            except profiler.ProfilerBusy as e:
+                _send_error(handler, 409, str(e))
+                return True
+            body, ctype = text.encode(), "text/plain; charset=utf-8"
+    elif path == DEBUG_FAULTS_PATH:
         from ..util import faultpoint
 
         query = urllib.parse.parse_qs(
@@ -93,22 +170,12 @@ def serve_debug_http(handler, path: str) -> bool:
         try:
             state = faultpoint.handle_debug_request(query)
         except (ValueError, PermissionError) as e:
-            body = json.dumps({"error": str(e)}).encode()
-            handler.send_response(403 if isinstance(e, PermissionError)
-                                  else 400)
-            handler.send_header("Content-Type", "application/json")
-            handler.send_header("Content-Length", str(len(body)))
-            handler.end_headers()
-            if handler.command != "HEAD":
-                handler.wfile.write(body)
+            _send_error(handler,
+                        403 if isinstance(e, PermissionError) else 400,
+                        str(e))
             return True
         body, ctype = json.dumps(state).encode(), "application/json"
     else:
         return False
-    handler.send_response(200)
-    handler.send_header("Content-Type", ctype)
-    handler.send_header("Content-Length", str(len(body)))
-    handler.end_headers()
-    if handler.command != "HEAD":
-        handler.wfile.write(body)
+    _send(handler, 200, body, ctype)
     return True

@@ -94,10 +94,16 @@ class Tracer:
         with self._lock:
             return list(self._spans)
 
-    def recent_traces(self, limit: int = 50) -> list[dict]:
-        """Most-recent traces first, each with its spans in start order."""
+    def recent_traces(self, limit: int = 50,
+                      trace_id: str | None = None) -> list[dict]:
+        """Most-recent traces first, each with its spans in start order.
+        `trace_id` filters the ring down to one trace (the cluster
+        stitcher's per-trace query; a full dump per node would make the
+        fan-out O(ring size x nodes))."""
         by_trace: dict[str, list[Span]] = {}
         for s in self.spans():
+            if trace_id is not None and s.trace_id != trace_id:
+                continue
             by_trace.setdefault(s.trace_id, []).append(s)
         # order traces by the latest span end they contain, newest first
         ordered = sorted(
@@ -114,8 +120,15 @@ class Tracer:
             for tid, spans in ordered
         ]
 
-    def traces_json(self, limit: int = 50) -> bytes:
-        return json.dumps({"traces": self.recent_traces(limit)}).encode()
+    def traces_json(self, limit: int = 50,
+                    trace_id: str | None = None) -> bytes:
+        # "now" = this process's wall clock at render time: the stitcher
+        # compares it against its own clock (minus half the scrape RTT)
+        # to annotate per-node clock skew on merged timelines
+        return json.dumps({
+            "now": time.time(),
+            "traces": self.recent_traces(limit, trace_id=trace_id),
+        }).encode()
 
 
 TRACER = Tracer()

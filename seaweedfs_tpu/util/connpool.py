@@ -37,13 +37,35 @@ import time
 import urllib.error
 import urllib.parse
 
-from ..stats.metrics import CONNPOOL_DIAL, CONNPOOL_EVICT, CONNPOOL_REUSE
+from ..stats.metrics import (
+    CONNPOOL_DIAL,
+    CONNPOOL_EVICT,
+    CONNPOOL_IDLE,
+    CONNPOOL_IN_USE,
+    CONNPOOL_REUSE,
+)
 
 # label-less children resolved once — Metric.labels() takes the metric
 # lock and these fire on every internal request
 _REUSE = CONNPOOL_REUSE.labels()
 _DIAL = CONNPOOL_DIAL.labels()
 _EVICT = CONNPOOL_EVICT.labels()
+
+# per-peer saturation gauges, (in_use, idle) pairs cached by (host, port)
+# key so the hot path pays a dict hit, not the metric lock.  One atomic
+# assignment of the whole pair: two threads first-touching a peer may
+# both build it, but labels() dedupes children, and neither can observe
+# a half-populated entry
+_peer_gauge_pairs: dict = {}
+
+
+def _peer_gauges(key: tuple):
+    pair = _peer_gauge_pairs.get(key)
+    if pair is None:
+        peer = f"{key[0]}:{key[1]}"
+        pair = (CONNPOOL_IN_USE.labels(peer), CONNPOOL_IDLE.labels(peer))
+        _peer_gauge_pairs[key] = pair
+    return pair
 
 DEFAULT_TIMEOUT = 30.0
 MAX_IDLE_PER_HOST = 8
@@ -95,6 +117,7 @@ class PooledResponse:
         if self._released:
             return
         self._released = True
+        _peer_gauges(self._key)[0].dec()  # checkout ends either way
         if reusable and not self._resp.will_close:
             self._pool._put(self._key, self._conn)
         else:
@@ -132,6 +155,7 @@ class ConnectionPool:
         """-> (conn, reused).  Pops the freshest idle socket, evicting
         any that sat past the idle TTL."""
         now = time.monotonic()
+        _, g_idle = _peer_gauges(key)
         with self._lock:
             bucket = self._idle.get(key)
             while bucket:
@@ -140,11 +164,13 @@ class ConnectionPool:
                     _EVICT.inc()
                     conn.close()
                     continue
+                g_idle.set(len(bucket))
                 conn.timeout = timeout
                 if conn.sock is not None:
                     conn.sock.settimeout(timeout)
                 _REUSE.inc()
                 return conn, True
+            g_idle.set(len(bucket or ()))
         return self._dial(key, timeout), False
 
     def _dial(self, key: tuple, timeout: float | None):
@@ -158,6 +184,7 @@ class ConnectionPool:
     def _put(self, key: tuple, conn: http.client.HTTPConnection) -> None:
         if conn.sock is None:
             return
+        _, g_idle = _peer_gauges(key)
         with self._lock:
             bucket = self._idle.setdefault(key, [])
             bucket.append((conn, time.monotonic()))
@@ -165,12 +192,14 @@ class ConnectionPool:
                 old, _ = bucket.pop(0)
                 _EVICT.inc()
                 old.close()
+            g_idle.set(len(bucket))
 
     def close_all(self) -> None:
         with self._lock:
-            for bucket in self._idle.values():
+            for key, bucket in self._idle.items():
                 for conn, _ in bucket:
                     conn.close()
+                _peer_gauges(key)[1].set(0)
             self._idle.clear()
 
     def idle_count(self, host: str, port: int) -> int:
@@ -227,6 +256,8 @@ class ConnectionPool:
             getattr(body, "seekable", lambda: False)())
         conn, reused = (self._get(key, timeout) if can_replay
                         else (self._dial(key, timeout), False))
+        g_in_use = _peer_gauges(key)[0]
+        g_in_use.inc()  # checked out until PooledResponse._release
         for attempt in (0, 1):
             try:
                 conn.request(method, target, body=body,
@@ -236,15 +267,24 @@ class ConnectionPool:
             except _STALE_ERRORS:
                 conn.close()
                 if not reused or attempt:
+                    g_in_use.dec()
                     raise
                 # the peer closed the socket while it sat in the pool:
-                # replay exactly once on a fresh dial
+                # replay exactly once on a fresh dial.  The re-dial (or
+                # seek) itself failing must also end the checkout, or the
+                # in_use gauge inflates forever on peer outages
                 _EVICT.inc()
-                if streaming:
-                    body.seek(0)
-                conn, reused = self._dial(key, timeout), False
+                try:
+                    if streaming:
+                        body.seek(0)
+                    conn = self._dial(key, timeout)
+                except BaseException:
+                    g_in_use.dec()
+                    raise
+                reused = False
             except BaseException:
                 conn.close()
+                g_in_use.dec()
                 raise
         raise AssertionError("unreachable")  # pragma: no cover
 

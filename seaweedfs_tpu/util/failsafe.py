@@ -49,24 +49,12 @@ import urllib.error
 from dataclasses import dataclass, field
 from typing import Callable, Iterable
 
-from ..stats.metrics import REGISTRY
+from ..stats.metrics import (  # families declared centrally for the lint
+    CIRCUIT_STATE,
+    CIRCUIT_TRANSITIONS,
+    RETRY_COUNTER,
+)
 from . import glog
-
-RETRY_COUNTER = REGISTRY.counter(
-    "seaweedfs_retry_total",
-    "retried failures by caller type, operation and failure reason",
-    labels=("type", "op", "reason"),
-)
-CIRCUIT_STATE = REGISTRY.gauge(
-    "seaweedfs_circuit_state",
-    "per-peer circuit breaker state (0 closed, 1 open, 2 half-open)",
-    labels=("peer",),
-)
-CIRCUIT_TRANSITIONS = REGISTRY.counter(
-    "seaweedfs_circuit_transitions_total",
-    "circuit breaker state transitions by peer and target state",
-    labels=("peer", "to"),
-)
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 _STATE_VALUE = {CLOSED: 0.0, OPEN: 1.0, HALF_OPEN: 2.0}

@@ -91,10 +91,6 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
             return self._send_json(200, {"Version": "seaweedfs-tpu", **self.store.status()})
         if serve_debug_http(self, path.path):
             return
-        if path.path == "/debug/profile":
-            from ..util.grace import profile_status
-
-            return self._send_json(200, profile_status())
         if path.path in ("/ui", "/ui/", "/ui/index.html"):
             from ..util.ui import render_status_page
 

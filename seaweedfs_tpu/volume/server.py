@@ -6,7 +6,6 @@ The gRPC port is http_port + 10000 by convention, like the reference.
 
 from __future__ import annotations
 
-import concurrent.futures
 import threading
 import time
 import urllib.error
@@ -18,12 +17,14 @@ from ..pb import rpc as rpclib
 from ..security import Guard
 from ..stats.metrics import (
     DISK_SIZE_GAUGE,
+    REGISTRY,
     REPLICATION_ERROR,
     VOLUME_GAUGE,
     serve_metrics,
 )
 from ..storage.store import Store
 from ..util import connpool, glog
+from ..util.executors import MeteredThreadPoolExecutor
 from .grpc_handlers import VolumeGrpcService
 from .http_handlers import serve_http
 
@@ -99,8 +100,9 @@ class VolumeServer:
         # replica fan-out workers: writes/deletes post to every peer
         # CONCURRENTLY on pooled connections, so the client's ack waits
         # one slowest-peer RTT, not the sum over peers
-        self._replica_pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=8, thread_name_prefix="replica-fanout")
+        self._replica_pool = MeteredThreadPoolExecutor(
+            max_workers=8, name="replica_fanout",
+            thread_name_prefix="replica-fanout")
 
     # -- lifecycle --------------------------------------------------------
 
@@ -199,11 +201,20 @@ class VolumeServer:
                     self.current_leader = None
                 time.sleep(min(self.pulse_seconds, 1.0))
 
+    def _with_stats(self, hb: master_pb2.Heartbeat) -> master_pb2.Heartbeat:
+        """Attach the compact gauge/counter snapshot to a full heartbeat:
+        the master's /cluster/metrics fallback when a live federation
+        scrape cannot reach this node."""
+        hb.stats.captured_at_ms = int(time.time() * 1000)
+        for name, value in REGISTRY.snapshot_samples():
+            hb.stats.samples.add(name=name, value=value)
+        return hb
+
     def _heartbeat_once(self, master: str) -> None:
         stub = rpclib.master_stub(master)
 
         def requests():
-            yield self.store.collect_heartbeat()
+            yield self._with_stats(self.store.collect_heartbeat())
             last_full = time.monotonic()
             while not self._stop.is_set():
                 time.sleep(min(self.pulse_seconds / 3, 1.0))
@@ -221,7 +232,7 @@ class VolumeServer:
                 if time.monotonic() - last_full >= self.pulse_seconds:
                     last_full = time.monotonic()
                     self.update_gauges()
-                    yield self.store.collect_heartbeat()
+                    yield self._with_stats(self.store.collect_heartbeat())
 
         for resp in stub.SendHeartbeat(requests()):
             if resp.volume_size_limit:

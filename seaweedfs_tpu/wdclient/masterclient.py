@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 import grpc
 
@@ -18,13 +19,23 @@ from ..pb import rpc as rpclib
 from ..util import failsafe
 from .vid_map import Location, VidMap
 
+# how often a registered client (filer) refreshes its stats snapshot on
+# the KeepConnected stream — the master's federation fallback data
+STATS_INTERVAL_S = 10.0
+
 
 class MasterClient:
     def __init__(self, name: str, master_grpc_addresses: list[str],
-                 grpc_port: int = 0):
+                 grpc_port: int = 0, client_type: str = "",
+                 http_address: str = ""):
         self.name = name
         self.masters = list(master_grpc_addresses)
         self.grpc_port = grpc_port
+        # federation registration: a non-empty client_type announces this
+        # process (e.g. a filer) to the master's observability plane with
+        # a scrapeable HTTP address + periodic stats snapshots
+        self.client_type = client_type
+        self.http_address = http_address
         self.vid_map = VidMap()
         self.current_master = ""
         self._leader_hint = ""
@@ -68,16 +79,34 @@ class MasterClient:
             self._connected.clear()
             self._stop.wait(backoff.next())
 
+    def _registration(self) -> master_pb2.KeepConnectedRequest:
+        req = master_pb2.KeepConnectedRequest(
+            name=self.name, grpc_port=self.grpc_port,
+            client_type=self.client_type, http_address=self.http_address,
+        )
+        if self.client_type:
+            from ..stats.metrics import REGISTRY
+
+            req.stats.captured_at_ms = int(time.time() * 1000)
+            for sname, value in REGISTRY.snapshot_samples():
+                req.stats.samples.add(name=sname, value=value)
+        return req
+
     def _stream_from(self, master: str) -> None:
         stub = rpclib.master_stub(master)
 
         def requests():
-            yield master_pb2.KeepConnectedRequest(
-                name=self.name, grpc_port=self.grpc_port
-            )
-            # keep the stream open until stopped
+            yield self._registration()
+            # keep the stream open until stopped; registered clients
+            # refresh their stats snapshot so the master's federation
+            # fallback stays at most STATS_INTERVAL_S stale
+            last_stats = time.monotonic()
             while not self._stop.wait(1.0):
-                pass
+                if (self.client_type
+                        and time.monotonic() - last_stats
+                        >= STATS_INTERVAL_S):
+                    last_stats = time.monotonic()
+                    yield self._registration()
 
         for loc in stub.KeepConnected(requests()):
             if self._stop.is_set():

@@ -1,0 +1,215 @@
+"""Acceptance test for the cluster observability plane (ISSUE 5).
+
+Real subprocesses through the CLI (master + TWO volume servers + filer):
+
+* one client PUT appears as a single stitched trace from the master's
+  /cluster/traces?trace=<id> — spans from the filer AND a volume server,
+  parent-linked across processes, with per-node skew annotation;
+* /cluster/metrics federates both volume servers' gauges under distinct
+  `instance` labels, and keeps serving — with the dead node marked
+  stale and its last heartbeat snapshot re-served — after one volume
+  process is SIGKILLed;
+* /debug/profile returns non-empty collapsed stacks from a live server
+  while requests keep flowing.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+from helpers import free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CLIENT_TRACE_ID = "0b5e" + "ab" * 14  # 32 hex chars
+TRACEPARENT = f"00-{CLIENT_TRACE_ID}-{'22' * 8}-01"
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _spawn(args, cwd):
+    return subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", *args],
+        cwd=cwd, env=_env(),
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+
+
+def _wait_http(url, deadline_s=25):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                return r.status
+        except (urllib.error.URLError, ConnectionError, OSError):
+            time.sleep(0.3)
+    raise TimeoutError(url)
+
+
+def _get_bytes(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def _get(url, timeout=10):
+    return _get_bytes(url, timeout).decode()
+
+
+def test_cluster_observability_plane(tmp_path):
+    mport, v1port, v2port, fport = (free_port(), free_port(), free_port(),
+                                    free_port())
+    for d in ("v1", "v2"):
+        (tmp_path / d).mkdir()
+    procs = {}
+    try:
+        procs["master"] = _spawn(["master", "-port", str(mport)],
+                                 str(tmp_path))
+        _wait_http(f"http://127.0.0.1:{mport}/cluster/healthz")
+        for name, port in (("v1", v1port), ("v2", v2port)):
+            procs[name] = _spawn(
+                ["volume", "-dir", str(tmp_path / name), "-port", str(port),
+                 "-mserver", f"127.0.0.1:{mport}", "-ec.codec", "cpu"],
+                str(tmp_path))
+        procs["filer"] = _spawn(
+            ["filer", "-master", f"127.0.0.1:{mport}", "-port", str(fport),
+             "-store", str(tmp_path / "filer.db")], str(tmp_path))
+        _wait_http(f"http://127.0.0.1:{fport}/")
+
+        # both volume servers registered and assignable
+        deadline = time.time() + 25
+        while time.time() < deadline:
+            try:
+                status = json.loads(
+                    _get(f"http://127.0.0.1:{mport}/cluster/status"))
+                if len(status.get("DataNodes", {})) >= 2 and status.get(
+                        "Filers"):
+                    break
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.3)
+        else:
+            raise AssertionError("cluster never fully registered")
+        assert all("secondsSinceLastBeat" in n
+                   for n in status["DataNodes"].values())
+
+        # -- one PUT -> one stitched trace --------------------------------
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fport}/obs/file.bin",
+            data=os.urandom(4096), method="PUT",
+            headers={"traceparent": TRACEPARENT},
+        )
+        with urllib.request.urlopen(req, timeout=15) as r:
+            assert r.status == 201
+
+        # the edge span records just AFTER the 201 is written — poll
+        # briefly so a fast client can't outrun the ring append
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            doc = json.loads(_get(
+                f"http://127.0.0.1:{mport}/cluster/traces"
+                f"?trace={CLIENT_TRACE_ID}"))
+            if {"filer.post", "volumeServer.post"} <= {
+                    s["name"] for s in doc["spans"]}:
+                break
+            time.sleep(0.2)
+        assert doc["traceId"] == CLIENT_TRACE_ID
+        spans = doc["spans"]
+        instances = {s["instance"] for s in spans}
+        names = {s["name"] for s in spans}
+        assert f"127.0.0.1:{fport}" in instances, instances
+        assert instances & {f"127.0.0.1:{v1port}", f"127.0.0.1:{v2port}"}, (
+            instances)
+        assert "filer.post" in names and "volumeServer.post" in names
+        # cross-process parent link survives the stitch: the volume POST
+        # span's parent lives in the filer process's span set
+        filer_ids = {s["spanId"] for s in spans
+                     if s["instance"] == f"127.0.0.1:{fport}"}
+        vol_posts = [s for s in spans if s["name"] == "volumeServer.post"]
+        assert vol_posts and any(s["parentId"] in filer_ids
+                                 for s in vol_posts)
+        assert not vol_posts[0]["orphan"]
+        for node in doc["nodes"].values():
+            assert "clockSkewMs" in node
+        # bad input validation mirrors /debug/traces
+        try:
+            _get(f"http://127.0.0.1:{mport}/cluster/traces?trace=nope")
+            raise AssertionError("invalid trace id accepted")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+        # -- sampling profiler under load (before any node dies, so the
+        # load path has all its chunks) ------------------------------------
+        stop = time.time() + 2.0
+
+        def load():
+            while time.time() < stop:
+                try:
+                    _get_bytes(f"http://127.0.0.1:{fport}/obs/file.bin",
+                               timeout=5)
+                except Exception:
+                    pass
+
+        import threading
+
+        lt = threading.Thread(target=load, daemon=True)
+        lt.start()
+        prof = _get(
+            f"http://127.0.0.1:{fport}/debug/profile?seconds=1&hz=97",
+            timeout=15)
+        lt.join()
+        assert prof.strip(), "profiler returned no stacks"
+        line = prof.splitlines()[0]
+        stack, _, count = line.rpartition(" ")
+        assert int(count) >= 1 and stack
+        # requests kept flowing during and after the profile
+        assert len(_get_bytes(
+            f"http://127.0.0.1:{fport}/obs/file.bin")) == 4096
+        # parameter validation
+        try:
+            _get(f"http://127.0.0.1:{fport}/debug/profile?seconds=999")
+            raise AssertionError("overlong profile accepted")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+
+        # -- federation: both instances, then stale fallback --------------
+        text = _get(f"http://127.0.0.1:{mport}/cluster/metrics")
+        for port in (v1port, v2port):
+            assert f'instance="127.0.0.1:{port}"' in text, port
+        assert f'instance="127.0.0.1:{fport}"' in text
+        assert (f'seaweedfs_federation_up{{instance="127.0.0.1:{v2port}"'
+                f',type="volume"}} 1') in text
+
+        procs.pop("v2").kill()  # SIGKILL: sockets die, no clean leave
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            text = _get(f"http://127.0.0.1:{mport}/cluster/metrics")
+            if (f'seaweedfs_federation_stale{{instance='
+                    f'"127.0.0.1:{v2port}",type="volume"}} 1') in text:
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("dead node never marked stale")
+        # the dead node's last-heartbeat snapshot is still served, with
+        # its age, under its instance label; the live node stays live
+        assert (f'seaweedfs_federation_snapshot_age_seconds'
+                f'{{instance="127.0.0.1:{v2port}"') in text
+        assert f'instance="127.0.0.1:{v2port}"' in text
+        assert (f'seaweedfs_federation_up{{instance="127.0.0.1:{v1port}"'
+                f',type="volume"}} 1') in text
+    finally:
+        for p in procs.values():
+            p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -106,13 +106,19 @@ def test_volume_server_resizes_on_read(tmp_path_factory):
         with urllib.request.urlopen(
                 f"http://{a['url']}/{a['fid']}", timeout=10) as r:
             assert r.read() == png  # no params: original bytes
-        # /debug/profile works on both servers
+        # /debug/profile works on both servers: ?status=1 keeps the cheap
+        # JSON status, the default now runs the stack sampler (ISSUE 5)
         for port in (master.port, vs.port):
             with urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/debug/profile",
+                    f"http://127.0.0.1:{port}/debug/profile?status=1",
                     timeout=10) as r:
                 st = json.loads(r.read())
             assert st["threads"] >= 1 and st["max_rss_kb"] > 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{vs.port}/debug/profile"
+                "?seconds=0.2&hz=50", timeout=10) as r:
+            collapsed = r.read().decode()
+        assert collapsed.strip(), "sampler returned no stacks"
     finally:
         vs.stop()
         master.stop()

@@ -143,6 +143,118 @@ def test_escape_helpers():
     assert format_le(0.0001) == "0.0001"
 
 
+def test_saturation_gauge_families_render():
+    """The PR-5 saturation families (executor pools, per-peer connpool,
+    EC pipeline stages) must expose through the standard renderer."""
+    from seaweedfs_tpu.stats.metrics import (
+        CONNPOOL_IDLE,
+        CONNPOOL_IN_USE,
+        EC_PIPELINE_STAGE,
+        EXECUTOR_ACTIVE,
+        EXECUTOR_MAX,
+        EXECUTOR_QUEUE_DEPTH,
+        REGISTRY,
+    )
+
+    EXECUTOR_QUEUE_DEPTH.labels("t_exposition").set(3)
+    EXECUTOR_ACTIVE.labels("t_exposition").set(2)
+    EXECUTOR_MAX.labels("t_exposition").set(8)
+    CONNPOOL_IN_USE.labels("10.0.0.1:8080").set(1)
+    CONNPOOL_IDLE.labels("10.0.0.1:8080").set(4)
+    EC_PIPELINE_STAGE.labels("prefetch").observe(0.01)
+    text = REGISTRY.render()
+    families, samples = _parse(text)
+    assert families["seaweedfs_executor_queue_depth"][1] == "gauge"
+    assert families["seaweedfs_connpool_in_use"][1] == "gauge"
+    assert families["seaweedfs_ec_pipeline_stage_seconds"][1] == "histogram"
+    assert ('seaweedfs_executor_queue_depth{executor="t_exposition"} 3.0'
+            in text)
+    assert 'seaweedfs_connpool_idle{peer="10.0.0.1:8080"} 4.0' in text
+    assert ('seaweedfs_ec_pipeline_stage_seconds_count{stage="prefetch"}'
+            in text)
+    # the full registry still passes the strict parser with them present
+    for name, labels, _v in samples:
+        assert _family_of(name, families) in families, name
+
+
+def test_federated_exposition_parses_and_groups():
+    """The master's /cluster/metrics merge: per-node expositions regroup
+    by family (text-format requirement), instance/type labels injected,
+    and the result passes the same strict parser as a single node."""
+    from seaweedfs_tpu.telemetry.federation import FederatedExposition
+
+    node_a = _build_registry().render()
+    node_b = _build_registry().render()
+    fed = FederatedExposition()
+    fed.add_live({"instance": "10.0.0.1:8080", "type": "volume"}, node_a,
+                 0.01)
+    fed.add_live({"instance": "10.0.0.2:8080", "type": "volume"}, node_b,
+                 0.02)
+    fed.add_snapshot({"instance": "10.0.0.3:8888", "type": "filer"},
+                     [('t_volumes{collection="pics"}', 7.0)], 12.5)
+    fed.add_down({"instance": "10.0.0.4:8080", "type": "volume"})
+    text = fed.render()
+    families, samples = _parse(text)
+
+    # every sample belongs to a declared family, all families grouped once
+    for name, labels, _v in samples:
+        assert _family_of(name, families) in families, name
+    # both live nodes present with distinct instance labels, extra labels
+    # injected ahead of the node's own
+    assert ('t_requests_total{instance="10.0.0.1:8080",type_="volume"'
+            not in text)  # guard against label-name mangling
+    per_instance = {
+        labels.get("instance")
+        for name, labels, _v in samples if name == "t_requests_total"
+    }
+    assert {"10.0.0.1:8080", "10.0.0.2:8080"} <= per_instance
+    # histogram samples stayed contiguous under their base family
+    bucket_lines = [i for i, line in enumerate(text.splitlines())
+                    if line.startswith("t_latency_seconds")]
+    assert bucket_lines == list(
+        range(bucket_lines[0], bucket_lines[0] + len(bucket_lines)))
+    # federation meta-families: up/stale/age
+    by_name = {}
+    for name, labels, v in samples:
+        by_name.setdefault(name, {})[labels.get("instance")] = v
+    assert by_name["seaweedfs_federation_up"]["10.0.0.1:8080"] == 1
+    assert by_name["seaweedfs_federation_up"]["10.0.0.3:8888"] == 0
+    assert by_name["seaweedfs_federation_stale"]["10.0.0.3:8888"] == 1
+    assert by_name["seaweedfs_federation_stale"]["10.0.0.4:8080"] == 0
+    assert by_name["seaweedfs_federation_snapshot_age_seconds"][
+        "10.0.0.3:8888"] == 12.5
+    # snapshot sample re-served with the node's own labels preserved
+    assert ('t_volumes{instance="10.0.0.3:8888",type="filer",'
+            'collection="pics"} 7.0' in text)
+
+
+def test_federated_instance_label_value_escaping():
+    """A hostile/odd instance string must escape per the exposition spec
+    both in injected labels and in the meta-families."""
+    from seaweedfs_tpu.telemetry.federation import (
+        FederatedExposition,
+        inject_labels,
+    )
+
+    weird = 'host"with\\quirks\n:80'
+    out = inject_labels("t_total", {"instance": weird})
+    assert out == (
+        't_total{instance="host\\"with\\\\quirks\\n:80"}')
+    # and through the full merge path
+    fed = FederatedExposition()
+    fed.add_live({"instance": weird, "type": "volume"},
+                 "# HELP t_total t\n# TYPE t_total counter\nt_total 1\n",
+                 0.0)
+    text = fed.render()
+    families, samples = _parse(text)
+    values = [labels["instance"] for name, labels, _v in samples
+              if name == "t_total"]
+    assert values, text
+    # the strict parser's regex unescapes nothing; the raw escaped form
+    # must round-trip the spec escapes
+    assert values[0] == 'host\\"with\\\\quirks\\n:80'
+
+
 def test_preexisting_request_label_pairs_render():
     """The label pairs the seed emitted must still appear after the
     middleware refactor (ISSUE satellite: no silent metric loss)."""

@@ -1,0 +1,109 @@
+"""Metric-family lint: the registration rules CI enforces.
+
+Every `seaweedfs_*` family must be registered exactly once, in
+stats/metrics.py, with a snake_case name — scattered registration is how
+two call sites end up disagreeing about a family's labels and silently
+corrupting one of them (the pre-PR-5 state: failsafe.py and faultpoint.py
+registered their own).  The Registry itself now raises on a conflicting
+re-registration, and this test walks the source so a regression fails in
+the lint job, not in production.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "seaweedfs_tpu")
+METRICS_PY = os.path.join(PKG, "stats", "metrics.py")
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9_]*$")
+_REGISTER_METHODS = {"counter", "gauge", "histogram"}
+
+
+def _registration_calls(path: str):
+    """Yield (family_name_node, lineno) for REGISTRY.<kind>(...) calls."""
+    tree = ast.parse(open(path).read(), filename=path)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if (isinstance(fn, ast.Attribute)
+                and fn.attr in _REGISTER_METHODS
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "REGISTRY"):
+            yield node, node.lineno
+
+
+def test_every_family_registered_once_in_metrics_py():
+    seen: dict[str, int] = {}
+    for call, lineno in _registration_calls(METRICS_PY):
+        assert call.args and isinstance(call.args[0], ast.Constant), (
+            f"metrics.py:{lineno}: family name must be a string literal")
+        name = call.args[0].value
+        assert isinstance(name, str)
+        assert name.startswith("seaweedfs_"), (
+            f"metrics.py:{lineno}: {name!r} must carry the seaweedfs_ "
+            "namespace")
+        assert _SNAKE.match(name), (
+            f"metrics.py:{lineno}: {name!r} is not snake_case")
+        assert name not in seen, (
+            f"metrics.py:{lineno}: {name!r} already registered at "
+            f"line {seen[name]}")
+        seen[name] = lineno
+    assert len(seen) >= 25, "registry looks implausibly small"
+
+
+def test_no_registration_outside_metrics_py():
+    offenders = []
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if not fn.endswith(".py") or fn.endswith("_pb2.py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            if os.path.samefile(path, METRICS_PY):
+                continue
+            for _call, lineno in _registration_calls(path):
+                offenders.append(f"{os.path.relpath(path, REPO)}:{lineno}")
+    assert not offenders, (
+        "metric families must be registered in stats/metrics.py only; "
+        f"found REGISTRY registrations at: {offenders}")
+
+
+def test_runtime_registry_matches_source_families():
+    """Importing the package registers exactly the families the source
+    declares — no duplicates, no import-order surprises."""
+    from seaweedfs_tpu.stats.metrics import REGISTRY
+
+    # importing the consumers must not add or conflict with anything
+    import seaweedfs_tpu.util.failsafe  # noqa: F401
+    import seaweedfs_tpu.util.faultpoint  # noqa: F401
+
+    declared = set()
+    for call, _ in _registration_calls(METRICS_PY):
+        declared.add(call.args[0].value)
+    registered = {n for n in REGISTRY._metrics if n.startswith("seaweedfs_")}
+    assert declared == registered, (
+        declared.symmetric_difference(registered))
+
+
+def test_conflicting_reregistration_raises():
+    from seaweedfs_tpu.stats.metrics import Registry
+
+    r = Registry()
+    r.counter("t_total", "x", labels=("a",))
+    r.counter("t_total", "x", labels=("a",))  # identical: fine
+    with pytest.raises(ValueError, match="already registered"):
+        r.counter("t_total", "x", labels=("a", "b"))  # labels differ
+    with pytest.raises(ValueError, match="already registered"):
+        r.gauge("t_total", "x", labels=("a",))  # kind differs
+    r.histogram("t_seconds", "x", labels=("op",))
+    with pytest.raises(ValueError, match="already registered"):
+        r.histogram("t_seconds", "x", labels=("other",))
+    with pytest.raises(ValueError, match="already registered"):
+        r.counter("t_seconds", "x")

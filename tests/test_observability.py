@@ -1,0 +1,255 @@
+"""Unit coverage for the PR-5 observability plane pieces: the sampling
+profiler, /debug/traces query validation, trace stitching with clock
+skew, metered executors, and the registry's heartbeat snapshot."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+# -- sampling profiler -------------------------------------------------------
+
+
+def test_profiler_collects_thread_stacks():
+    from seaweedfs_tpu.util import profiler
+
+    stop = threading.Event()
+
+    def busy_loop_marker():
+        while not stop.is_set():
+            sum(range(500))
+
+    t = threading.Thread(target=busy_loop_marker, daemon=True)
+    t.start()
+    try:
+        counts = profiler.sample_stacks(duration_s=0.4, hz=150)
+    finally:
+        stop.set()
+        t.join()
+    assert counts, "no stacks sampled"
+    joined = "\n".join(counts)
+    assert "busy_loop_marker" in joined
+    text = profiler.collapsed(counts)
+    # collapsed format: `frames count` lines, hottest first
+    first = text.splitlines()[0]
+    stack, _, n = first.rpartition(" ")
+    assert int(n) >= 1 and ";" in stack or stack
+
+
+def test_profiler_validates_and_serializes():
+    from seaweedfs_tpu.util import profiler
+
+    for bad in ((0, 99), (-1, 99), (999, 99), (1, 0), (1, 100000)):
+        with pytest.raises(ValueError):
+            profiler.sample_stacks(*bad)
+    # exclusive: a second run while one is in flight is refused
+    results = []
+
+    def run():
+        try:
+            results.append(profiler.sample_stacks(0.5, 50))
+        except profiler.ProfilerBusy as e:
+            results.append(e)
+
+    t1 = threading.Thread(target=run)
+    t1.start()
+    time.sleep(0.1)
+    with pytest.raises(profiler.ProfilerBusy):
+        profiler.sample_stacks(0.2, 50)
+    t1.join()
+    assert len(results) == 1 and isinstance(results[0], dict)
+
+
+# -- /debug/traces query validation ------------------------------------------
+
+
+def test_parse_trace_query():
+    from seaweedfs_tpu.telemetry import parse_trace_query
+
+    assert parse_trace_query({}) == (None, 50)
+    tid = "ab" * 16
+    assert parse_trace_query({"trace": [tid]}) == (tid, 50)
+    assert parse_trace_query({"trace": [tid.upper()]}) == (tid, 50)
+    assert parse_trace_query({"limit": ["7"]}) == (None, 7)
+    for bad in ({"trace": ["xyz"]}, {"trace": ["ab" * 15]},
+                {"limit": ["0"]}, {"limit": ["1001"]},
+                {"limit": ["seven"]}, {"trace": ["g" * 32]}):
+        with pytest.raises(ValueError):
+            parse_trace_query(bad)
+
+
+def test_tracer_trace_filter_and_now():
+    import json
+
+    from seaweedfs_tpu.telemetry.trace import Tracer, Span
+
+    tr = Tracer(max_spans=16)
+    for i, tid in enumerate(("aa" * 16, "bb" * 16, "aa" * 16)):
+        tr.record(Span(trace_id=tid, span_id=f"{i:016x}", parent_id="",
+                       name=f"s{i}", start=time.time(), duration=0.001))
+    doc = json.loads(tr.traces_json(50, trace_id="aa" * 16))
+    assert isinstance(doc["now"], float)
+    assert len(doc["traces"]) == 1
+    assert {s["name"] for s in doc["traces"][0]["spans"]} == {"s0", "s2"}
+    assert len(json.loads(tr.traces_json(50))["traces"]) == 2
+
+
+# -- trace stitching ---------------------------------------------------------
+
+
+def test_stitch_trace_merges_skews_and_marks_orphans():
+    from seaweedfs_tpu.telemetry.stitch import estimate_skew, stitch_trace
+
+    t0 = 1_722_729_600.0
+    tid = "cd" * 16
+    span = lambda sid, parent, start, dur_ms, name: {  # noqa: E731
+        "traceId": tid, "spanId": sid, "parentId": parent, "name": name,
+        "start": start, "durationMs": dur_ms, "attrs": {}, "status": "ok",
+    }
+    filer = {
+        "instance": "f:8888", "type": "filer", "skew_s": 0.0, "rtt_s": 0.001,
+        "spans": [span("f" * 16, "", t0, 30.0, "filer.post")],
+    }
+    # the volume node's clock runs 10s fast; unadjusted, its span would
+    # sort before the filer's
+    volume = {
+        "instance": "v:8080", "type": "volume", "skew_s": 10.0,
+        "rtt_s": 0.002,
+        "spans": [span("e" * 16, "f" * 16, t0 + 10.005, 5.0,
+                       "volumeServer.post"),
+                  span("d" * 16, "0" * 16, t0 + 10.010, 1.0, "orphaned")],
+    }
+    out = stitch_trace(tid, [filer, volume])
+    assert out["traceId"] == tid
+    assert [s["name"] for s in out["spans"]] == [
+        "filer.post", "volumeServer.post", "orphaned"]
+    by_name = {s["name"]: s for s in out["spans"]}
+    assert by_name["volumeServer.post"]["instance"] == "v:8080"
+    assert abs(by_name["volumeServer.post"]["startAdjusted"]
+               - (t0 + 0.005)) < 1e-6
+    assert not by_name["volumeServer.post"]["orphan"]  # parent on filer
+    assert by_name["orphaned"]["orphan"]
+    assert out["nodes"]["v:8080"]["clockSkewMs"] == 10000.0
+    assert out["nodes"]["f:8888"]["spanCount"] == 1
+    assert out["durationMs"] > 0
+    # NTP-style estimate: node replied 0.2s after send with rtt 0.1
+    assert abs(estimate_skew(100.2, 100.0, 0.1) - 0.15) < 1e-9
+
+
+# -- metered executors -------------------------------------------------------
+
+
+def test_metered_executor_gauges_track_saturation():
+    from seaweedfs_tpu.stats.metrics import (
+        EXECUTOR_ACTIVE,
+        EXECUTOR_MAX,
+        EXECUTOR_QUEUE_DEPTH,
+    )
+    from seaweedfs_tpu.util.executors import MeteredThreadPoolExecutor
+
+    name = "t_metered"
+    pool = MeteredThreadPoolExecutor(max_workers=2, name=name)
+    assert EXECUTOR_MAX.labels(name).value == 2
+    gate = threading.Event()
+    running = threading.Semaphore(0)
+
+    def task():
+        running.release()
+        gate.wait(timeout=5)
+
+    futs = [pool.submit(task) for _ in range(4)]
+    assert running.acquire(timeout=5) and running.acquire(timeout=5)
+    time.sleep(0.05)
+    assert EXECUTOR_ACTIVE.labels(name).value == 2
+    assert EXECUTOR_QUEUE_DEPTH.labels(name).value == 2
+    gate.set()
+    for f in futs:
+        f.result(timeout=5)
+    time.sleep(0.05)
+    assert EXECUTOR_ACTIVE.labels(name).value == 0
+    assert EXECUTOR_QUEUE_DEPTH.labels(name).value == 0
+    pool.shutdown()
+    with pytest.raises(RuntimeError):
+        pool.submit(task)
+    assert EXECUTOR_QUEUE_DEPTH.labels(name).value == 0  # unwound
+
+
+def test_metered_executor_unwinds_queue_on_cancelled_map():
+    """Executor.map cancels pending futures when the consumer raises
+    mid-iteration; cancelled futures never run, so the queue gauge must
+    unwind via the done-callback, not the (never-called) wrapper."""
+    from seaweedfs_tpu.stats.metrics import EXECUTOR_QUEUE_DEPTH
+    from seaweedfs_tpu.util.executors import MeteredThreadPoolExecutor
+
+    name = "t_cancelled"
+    pool = MeteredThreadPoolExecutor(max_workers=1, name=name)
+
+    def work(i):
+        if i == 0:
+            time.sleep(0.05)
+            raise RuntimeError("boom")
+        return i
+
+    with pytest.raises(RuntimeError):
+        list(pool.map(work, range(10)))
+    pool.shutdown(wait=True)
+    time.sleep(0.05)  # done-callbacks fire on cancellation, allow a beat
+    assert EXECUTOR_QUEUE_DEPTH.labels(name).value == 0
+
+
+def test_profiler_disable_gate(monkeypatch):
+    from seaweedfs_tpu.util import profiler
+
+    monkeypatch.setenv(profiler.DISABLE_VAR, "1")
+    assert not profiler.enabled()
+    monkeypatch.delenv(profiler.DISABLE_VAR)
+    assert profiler.enabled()
+
+
+# -- shell cluster.status ----------------------------------------------------
+
+
+def test_shell_cluster_status_renders():
+    from helpers import free_port
+
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+    m = MasterServer(ip="127.0.0.1", port=free_port())
+    m.start()
+    try:
+        env = CommandEnv(f"127.0.0.1:{m.grpc_port}")
+        out = run_command(env, "cluster.status")
+        assert f"master 127.0.0.1:{m.port}" in out
+        assert "volume servers (0):" in out
+        assert "/cluster/metrics" in out
+        as_json = run_command(env, "cluster.status -json")
+        import json
+
+        assert json.loads(as_json)["IsLeader"] is True
+    finally:
+        m.stop()
+
+
+# -- registry snapshot -------------------------------------------------------
+
+
+def test_snapshot_samples_counters_and_gauges_only():
+    from seaweedfs_tpu.stats.metrics import Registry
+
+    r = Registry()
+    r.counter("t_c_total", "c", labels=("op",)).labels("x").inc(3)
+    r.gauge("t_g", "g").set(1.5)
+    r.histogram("t_h_seconds", "h").observe(0.2)
+    samples = dict(r.snapshot_samples())
+    assert samples['t_c_total{op="x"}'] == 3.0
+    assert samples["t_g"] == 1.5
+    assert not any(k.startswith("t_h_seconds") for k in samples)
+    # bounded
+    big = Registry()
+    c = big.counter("t_many_total", "c", labels=("i",))
+    for i in range(600):
+        c.labels(str(i)).inc()
+    assert len(big.snapshot_samples(max_samples=512)) == 512

@@ -106,8 +106,16 @@ def test_one_trace_spans_filer_and_volume_processes(tmp_path):
         with urllib.request.urlopen(req, timeout=15) as r:
             assert r.status == 201
 
-        filer_spans = _trace_spans(fport, CLIENT_TRACE_ID)
-        volume_spans = _trace_spans(vport, CLIENT_TRACE_ID)
+        # the edge span records just AFTER the 201 is written — poll
+        # briefly so a fast client can't outrun the ring append
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            filer_spans = _trace_spans(fport, CLIENT_TRACE_ID)
+            volume_spans = _trace_spans(vport, CLIENT_TRACE_ID)
+            if {"filer.post", "volumeServer.post"} <= {
+                    s["name"] for s in filer_spans + volume_spans}:
+                break
+            time.sleep(0.2)
 
         assert filer_spans, "filer did not adopt the client trace id"
         assert volume_spans, (
