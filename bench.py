@@ -535,6 +535,7 @@ _SNAPSHOT_PREFIXES = (
     "seaweedfs_connpool_reuse_total", "seaweedfs_connpool_dial_total",
     "seaweedfs_connpool_evict_total", "seaweedfs_retry_total",
     "seaweedfs_replication_error_total", "seaweedfs_request_total",
+    "seaweedfs_ec_service_jobs_total", "seaweedfs_ec_service_flush_total",
 )
 
 
@@ -740,6 +741,217 @@ def _smallfile_rates(n: int = 20000, concurrency: int = 16,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _hist_child_snapshot(hist, *labels):
+    """(counts[], count, total) for one histogram child — bench-side
+    delta arithmetic over the in-process registry."""
+    child = hist.labels(*labels)
+    with child._lock:
+        return list(child.counts), child.count, child.total
+
+
+def _hist_quantile(buckets, counts, count, q: float) -> float:
+    """Linear-interpolated quantile from cumulative bucket counts (the
+    usual Prometheus histogram_quantile estimate)."""
+    if count <= 0:
+        return 0.0
+    rank = q * count
+    prev_cum, prev_bound = 0, 0.0
+    for bound, cum in zip(buckets, counts):
+        if cum >= rank:
+            if cum == prev_cum:
+                return bound
+            return prev_bound + (bound - prev_bound) * (
+                (rank - prev_cum) / (cum - prev_cum))
+        prev_cum, prev_bound = cum, bound
+    return buckets[-1] if buckets else 0.0
+
+
+def _service_rates() -> dict:
+    """ISSUE 6 service stage: N volumes' concurrent encode+rebuild GF
+    jobs through the shared codec service vs per-volume direct dispatch.
+
+    Two profiles, both on the host codec (the device path is verified
+    byte-identical on the virtual mesh in tests/test_codec_service.py):
+
+    * **interval** (the headline `service_speedup`): needle-interval-
+      sized jobs (SEAWEEDFS_TPU_BENCH_SERVICE_KB, default 2KB — the
+      reference's canonical 1KB-file benchmark decodes ~1.1KB intervals)
+      mixing encode parity with rebuild decode-plan applies.  This is
+      the regime the service exists for: per-job dispatch overhead
+      dominates the GF kernel, and the scheduler's coalescing turns N
+      producers' per-call Python into one kernel call per batch.
+    * **bulk**: 1MB pipeline slices with reused output buffers — shows
+      bulk encode loses nothing by routing through the service
+      (`service_bulk_ratio`, expect ~0.9-1.0: kernel-bound either way).
+
+    Occupancy and p50/p99 job latency come from the
+    seaweedfs_ec_service_* registry deltas, so the numbers folded into
+    the JSON are exactly what /metrics would report.
+    """
+    import os
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.ops import gf256
+    from seaweedfs_tpu.ops.codec_service import CodecService
+    from seaweedfs_tpu.ops.rs_cpu import ReedSolomon
+    from seaweedfs_tpu.stats.metrics import (
+        EC_SERVICE_BATCH_JOBS,
+        EC_SERVICE_JOB_SECONDS,
+    )
+
+    n_vol = int(os.environ.get("SEAWEEDFS_TPU_BENCH_SERVICE_VOLUMES", "8"))
+    kb = int(os.environ.get("SEAWEEDFS_TPU_BENCH_SERVICE_KB", "2"))
+    n_jobs = int(os.environ.get("SEAWEEDFS_TPU_BENCH_SERVICE_JOBS", "6000"))
+    group = 16
+    width = kb << 10
+    rng = np.random.default_rng(7)
+    rs = ReedSolomon()
+    blocks = [rng.integers(0, 256, (10, width), dtype=np.uint8)
+              for _ in range(n_vol)]
+    # rebuild decode plan for the worst-case loss (first 4 data shards)
+    plan = gf256.decode_plan_for(
+        rs.matrix, 10, list(range(4, 14)), (0, 1, 2, 3))
+
+    result: dict = {"service_volumes": n_vol, "service_job_kb": kb,
+                    "service_jobs_per_volume": n_jobs,
+                    "service_mode": "host"}
+
+    def emit(**kv) -> None:
+        result.update(kv)
+        print(json.dumps({"partial": True, **result}), flush=True)
+
+    def baseline_worker(v: int) -> None:
+        codec = ReedSolomon()  # per-volume dispatch: own codec, own calls
+        if v % 2 == 0:
+            for _ in range(n_jobs):
+                codec.parity_of(blocks[v])
+        else:
+            for _ in range(n_jobs):
+                codec.apply_rows(plan, list(blocks[v]))
+
+    def service_worker(svc: CodecService, v: int) -> None:
+        pend: list = []
+        done = 0
+        while done < n_jobs:
+            g = min(group, n_jobs - done)
+            if v % 2 == 0:
+                pend.extend(svc.submit_parity_many([blocks[v]] * g))
+            else:
+                pend.extend(svc.submit_apply_many(plan, [blocks[v]] * g))
+            done += g
+            while len(pend) > 2 * group:
+                pend.pop(0).result()
+        for f in pend:
+            f.result()
+
+    total_bytes = n_vol * n_jobs * 10 * width
+    rs.parity_of(blocks[0])  # warm the native lib before any timing
+
+    # byte identity through the service before any rates are quoted
+    svc = CodecService(mode="host")
+    got = np.stack([np.asarray(r) for r in
+                    svc.submit_parity(blocks[0]).result(30)])
+    if not np.array_equal(got, rs.parity_of(blocks[0])):
+        svc.close()
+        return {"error": "service parity not byte-identical to cpu_simd"}
+    got = np.stack([np.asarray(r) for r in
+                    svc.submit_apply(plan, blocks[1]).result(30)])
+    if not np.array_equal(got, np.stack(rs.apply_rows(plan, list(blocks[1])))):
+        svc.close()
+        return {"error": "service decode not byte-identical to cpu_simd"}
+    result["service_byte_identical"] = True
+
+    # best-of-2 (same reasoning as every other stage on this noisy host)
+    base_dt = svc_dt = None
+    occ_before = _hist_child_snapshot(EC_SERVICE_BATCH_JOBS)
+    lat_before = {k: _hist_child_snapshot(EC_SERVICE_JOB_SECONDS, k)
+                  for k in ("parity", "apply")}
+    for trial in range(2):
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(n_vol) as pool:
+            list(pool.map(baseline_worker, range(n_vol)))
+        dt = time.perf_counter() - t0
+        base_dt = dt if base_dt is None else min(base_dt, dt)
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(n_vol) as pool:
+            list(pool.map(lambda v: service_worker(svc, v), range(n_vol)))
+        dt = time.perf_counter() - t0
+        svc_dt = dt if svc_dt is None else min(svc_dt, dt)
+        emit(per_volume_GBps=round(total_bytes / base_dt / 1e9, 3),
+             service_GBps=round(total_bytes / svc_dt / 1e9, 3),
+             service_speedup=round(base_dt / svc_dt, 3),
+             service_trials=trial + 1)
+    occ_after = _hist_child_snapshot(EC_SERVICE_BATCH_JOBS)
+    jobs_delta = occ_after[1] - occ_before[1]
+    if jobs_delta > 0:
+        result["service_batch_occupancy_mean"] = round(
+            (occ_after[2] - occ_before[2]) / jobs_delta, 2)
+    # p50/p99 job latency over the service runs, from the histogram delta
+    lat_counts = None
+    for k in ("parity", "apply"):
+        before, after = lat_before[k], _hist_child_snapshot(
+            EC_SERVICE_JOB_SECONDS, k)
+        d = [a - b for a, b in zip(after[0], before[0])]
+        if lat_counts is None:
+            lat_counts, lat_n = d, after[1] - before[1]
+        else:
+            lat_counts = [x + y for x, y in zip(lat_counts, d)]
+            lat_n += after[1] - before[1]
+    if lat_counts and lat_n:
+        # _HistogramChild.counts are already cumulative (observe bumps
+        # every bucket whose bound >= v), and deltas of cumulative
+        # counts stay cumulative — no further cumsum
+        buckets = EC_SERVICE_JOB_SECONDS.buckets
+        result["service_job_p50_ms"] = round(
+            _hist_quantile(buckets, lat_counts, lat_n, 0.50) * 1000, 3)
+        result["service_job_p99_ms"] = round(
+            _hist_quantile(buckets, lat_counts, lat_n, 0.99) * 1000, 3)
+    svc.close()
+
+    # bulk profile: 1MB pipeline slices, reused outputs on both sides
+    bulk_w = 1 << 20
+    bulk_jobs = int(os.environ.get("SEAWEEDFS_TPU_BENCH_SERVICE_BULK_JOBS",
+                                   "30"))
+    bulk_blocks = [rng.integers(0, 256, (10, bulk_w), dtype=np.uint8)
+                   for _ in range(n_vol)]
+
+    def bulk_base(v: int) -> None:
+        codec = ReedSolomon()
+        outs = [np.empty((4, bulk_w), np.uint8) for _ in range(4)]
+        for k in range(bulk_jobs):
+            codec.parity_into(list(bulk_blocks[v]), list(outs[k % 4]))
+
+    def bulk_service(svc2: CodecService, v: int) -> None:
+        outs = [np.empty((4, bulk_w), np.uint8) for _ in range(4)]
+        pend: list = []
+        for k in range(bulk_jobs):
+            pend.append(svc2.submit_parity(bulk_blocks[v], out=outs[k % 4]))
+            if len(pend) > 2:
+                pend.pop(0).result()
+        for f in pend:
+            f.result()
+
+    svc2 = CodecService(mode="host")
+    bulk_bytes = n_vol * bulk_jobs * 10 * bulk_w
+    bb = bs = None  # best-of-2: same noisy-host reasoning as every stage
+    for _ in range(2):
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(n_vol) as pool:
+            list(pool.map(bulk_base, range(n_vol)))
+        bb = min(bb or 1e9, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(n_vol) as pool:
+            list(pool.map(lambda v: bulk_service(svc2, v), range(n_vol)))
+        bs = min(bs or 1e9, time.perf_counter() - t0)
+    svc2.close()
+    result.update(
+        per_volume_bulk_GBps=round(bulk_bytes / bb / 1e9, 3),
+        service_bulk_GBps=round(bulk_bytes / bs / 1e9, 3),
+        service_bulk_ratio=round(bb / bs, 3),
+    )
+    return result
+
+
 def _cpu_rate(shard_bytes: int = 16 << 20, iters: int = 5) -> float:
     """Best single-pass rate: this shared vCPU sees multi-second steal
     spikes (observed swinging a mean-of-3 between 3.7 and 5.9 GB/s), so
@@ -877,18 +1089,22 @@ def main() -> None:
             print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
         return
     if "--probe-only" in sys.argv:
+        # the shared fast probe (ops.device_probe): subprocess + hard
+        # deadline (SEAWEEDFS_TPU_PROBE_TIMEOUT_S, default 10s), the same
+        # verdict codec selection uses — a wedged transport answers in
+        # seconds here instead of wedging this process
         try:
-            import jax
+            from seaweedfs_tpu.ops import device_probe
 
-            d = jax.devices()
-            out = {"devices": len(d), "platform": d[0].platform if d else ""}
-            import numpy as _np
-            import jax.numpy as _jnp
-
-            _np.asarray(_jnp.ones((8, 128)) + 1)  # round trip, not just init
-            print(json.dumps(out))
+            print(json.dumps(device_probe.probe(refresh=True).to_json()))
         except Exception as exc:  # noqa: BLE001
             print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:300]}))
+        return
+    if "--service-only" in sys.argv or "--service" in sys.argv:
+        try:
+            print(json.dumps(_service_rates()))
+        except Exception as exc:  # noqa: BLE001
+            print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
         return
     if "--degraded-only" in sys.argv:
         try:
@@ -926,31 +1142,41 @@ def main() -> None:
     # margin (it runs a 4x larger volume)
     stage_timeout = float(os.environ.get(
         "SEAWEEDFS_TPU_BENCH_STAGE_TIMEOUT_S", "300"))
-    # cheap tunnel-health probe: a wedged axon transport hangs EVERY
-    # device call, so burning the full 3x300s retry budget per TPU stage
-    # would eat ~half an hour to learn nothing — probe once, and on a
-    # dead tunnel give each TPU stage a single bounded attempt
-    probe = _stage_in_subprocess("--probe-only", timeout_s=90.0, attempts=1)
-    tunnel_ok = probe.get("devices", 0) >= 1
-    tpu = _stage_in_subprocess(
-        "--kernel-only", timeout_s=stage_timeout,
-        attempts=3 if tunnel_ok else 1,
-        env_per_attempt=[  # shrink the stage set on each retry: the caps
-            # map to DISTINCT subsets of the fixed 4/16/64/256 stages
-            # ({4,16,64,256} -> {4,16} -> {4}); re-running an identical
-            # shape after a timeout would just re-wedge the tunnel
-            {},
-            {"SEAWEEDFS_TPU_BENCH_KERNEL_MB": "16"},
-            {"SEAWEEDFS_TPU_BENCH_KERNEL_MB": "4"},
-        ])
+    # fast reachability gate (ops.device_probe, ≤10s hard deadline,
+    # in-process, cached): when no non-CPU device answers a round trip,
+    # the TPU stages are SKIPPED outright — acceptance is "unreachable
+    # devices degrade to cpu_simd in seconds", not one 300s attempt each.
+    # (BENCH_r04/r05 burned their entire budget learning the tunnel was
+    # dead, three stages at a time.)
+    from seaweedfs_tpu.ops import device_probe
+
+    pr = device_probe.probe()
+    tunnel_ok = pr.accelerator
+    probe_err = (f"skipped: {pr.error or 'no accelerator'} "
+                 f"(probe {pr.seconds:.1f}s, platform "
+                 f"{pr.platform or 'none'})")
+    if tunnel_ok:
+        tpu = _stage_in_subprocess(
+            "--kernel-only", timeout_s=stage_timeout, attempts=3,
+            env_per_attempt=[  # shrink the stage set on each retry: the
+                # caps map to DISTINCT subsets of the fixed 4/16/64/256
+                # stages ({4,16,64,256} -> {4,16} -> {4}); re-running an
+                # identical shape after a timeout just re-wedges the
+                # tunnel
+                {},
+                {"SEAWEEDFS_TPU_BENCH_KERNEL_MB": "16"},
+                {"SEAWEEDFS_TPU_BENCH_KERNEL_MB": "4"},
+            ])
+    else:
+        tpu = {"error": probe_err}
     # e2e runs BOTH codecs and reports the faster one — the framework's
     # `-ec.codec=auto` makes the same call at runtime.  On hosts where the
     # TPU sits behind a slow tunnel the C++ SIMD codec wins the
     # disk->shards pipeline outright; on a real PCIe/pod host the device
     # path wins.  The loser's rate is preserved alongside.
-    tpu_e2e = _stage_in_subprocess(
-        "--e2e-only", timeout_s=stage_timeout,
-        attempts=2 if tunnel_ok else 1)
+    tpu_e2e = (_stage_in_subprocess(
+        "--e2e-only", timeout_s=stage_timeout, attempts=2)
+        if tunnel_ok else {"error": probe_err})
     cpu_e2e = _stage_in_subprocess("--e2e-cpu-only",
                                    timeout_s=stage_timeout * 1.8,
                                    attempts=1)
@@ -1033,6 +1259,16 @@ def main() -> None:
             metrics_snapshot="--metrics-snapshot" in _sys.argv))
     except Exception as exc:  # noqa: BLE001
         out["smallfile_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    # ISSUE 6: codec-service batching vs per-volume dispatch (host SIMD,
+    # in-process, deterministic — no subprocess guard needed)
+    try:
+        svc_res = _service_rates()
+        if "error" in svc_res:  # namespace like every other stage: a
+            # service failure must not read as a failed bench run
+            out["service_error"] = svc_res.pop("error")
+        out.update(svc_res)
+    except Exception as exc:  # noqa: BLE001
+        out["service_error"] = f"{type(exc).__name__}: {exc}"[:300]
     print(json.dumps(out))
 
 

@@ -150,6 +150,22 @@ def gf_apply(matrix_rows, inputs: list[bytes], out_count: int) -> list[bytearray
     return outs
 
 
+def gf_apply_fast(mbytes: bytes, r: int, s: int, inputs, outs, n: int) -> None:
+    """Minimal-overhead GF matmul: prevalidated caller, prebuilt matrix
+    bytes, raw ndarray pointers straight into the C kernel.
+
+    The codec service's per-job hot path: ``gf_apply_arrays`` spends
+    ~15-20us/call on list building, ascontiguousarray checks and matrix
+    tobytes — more than the kernel itself below ~64KB.  Here the CALLER
+    guarantees: ``inputs``/``outs`` are C-contiguous uint8 rows of length
+    ``n``, ``mbytes`` is the (r, s) matrix's raw bytes.  No checks.
+    """
+    lib = _load()
+    in_ptrs = (ctypes.c_void_p * s)(*[a.ctypes.data for a in inputs])
+    out_ptrs = (ctypes.c_void_p * r)(*[o.ctypes.data for o in outs])
+    lib.sw_gf_apply(mbytes, r, s, in_ptrs, out_ptrs, n)
+
+
 def gf_apply_arrays(matrix_rows, inputs, out=None):
     """Zero-copy variant of gf_apply over numpy uint8 arrays.
 

@@ -124,6 +124,32 @@ def available_codecs() -> list[str]:
 
 _AUTO_CHOICE: list[str] = []
 
+# every name get_codec resolves to a jax-backed codec — the single
+# source of truth shared with ops.codec_service's mode/routing logic
+DEVICE_CODEC_NAMES = frozenset(
+    {"tpu", "pallas", "tpu_pallas", "jax", "tpu_xor", "tpu_mxu", "mxu"})
+_DEVICE_NAMES = DEVICE_CODEC_NAMES
+_FALLBACK_WARNED: set[str] = set()
+
+
+def effective_codec(name: str) -> tuple[str, str]:
+    """-> (name that get_codec will actually build, fallback reason).
+
+    Device codec names degrade to ``cpu`` when the fast reachability
+    probe (ops.device_probe, hard deadline in seconds) says jax cannot
+    produce devices — so a server started with ``-ec.codec=tpu`` on a
+    host with a wedged transport comes up on the SIMD codec immediately
+    instead of hanging every EC rpc for minutes.  The reason string is
+    empty when no fallback happened."""
+    if name not in _DEVICE_NAMES:
+        return name, ""
+    from . import device_probe
+
+    pr = device_probe.probe()
+    if pr.ok:
+        return name, ""
+    return "cpu", pr.error or "devices unreachable"
+
 
 def _resolve_auto(probe_mb: int = 4, timeout_s: float = 75.0) -> str:
     """Pick the codec that will win the disk->shards pipeline on THIS host.
@@ -147,6 +173,14 @@ def _resolve_auto(probe_mb: int = 4, timeout_s: float = 75.0) -> str:
     import time as _time
 
     if importlib.util.find_spec("jax") is None:
+        return "cpu"
+    # fast reachability gate first (seconds, cached): no devices, or only
+    # a CPU backend, decides "cpu" without paying the timing subprocess —
+    # and a wedged transport cannot burn the 75s budget below
+    from . import device_probe
+
+    pr = device_probe.probe()
+    if not pr.ok or pr.platform == "cpu":
         return "cpu"
     import numpy as np
 
@@ -211,6 +245,14 @@ def get_codec(name: str = "cpu", data_shards: int = DATA_SHARDS,
         if not _AUTO_CHOICE:
             _AUTO_CHOICE.append(_resolve_auto())
         name = _AUTO_CHOICE[0]
+    if name in _DEVICE_NAMES:
+        name, reason = effective_codec(name)
+        if reason and reason not in _FALLBACK_WARNED:
+            _FALLBACK_WARNED.add(reason)
+            from ..util import glog
+
+            glog.warning(
+                "ec codec: devices unreachable (%s); using cpu_simd", reason)
     if name in ("cpu", "go", "numpy"):
         return _instrument(ReedSolomon(data_shards, parity_shards), "cpu")
     if name in ("tpu", "pallas", "tpu_pallas"):

@@ -1,0 +1,166 @@
+"""Fast accelerator-reachability probe with a hard deadline.
+
+One question, answered in seconds and cached for the process lifetime:
+*can this host's jax produce working devices right now?*  Every consumer
+that used to discover an unreachable TPU by timing out on its own —
+``get_codec`` device-codec selection, ``-ec.codec=auto`` resolution, the
+codec service's mode pick, every TPU-touching bench stage — asks here
+instead, so a wedged transport degrades the caller to the host SIMD
+codec in ``SEAWEEDFS_TPU_PROBE_TIMEOUT_S`` (default 10s), not after the
+300s stage timeouts that poisoned BENCH_r04/r05.
+
+The check runs in a KILLABLE subprocess: a wedged device tunnel hangs
+every in-process jax call including backend init, and threads cannot be
+killed.  The child does a real host->device->host round trip, not just a
+device listing — a transport that enumerates but cannot move bytes must
+count as unreachable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+DEFAULT_TIMEOUT_S = 10.0
+
+# the child prints ONE json line after the round trip; anything else
+# (hang, crash, refused backend init) is a failed probe
+_CHILD_CODE = r"""
+import json, os, sys
+import jax
+_p = os.environ.get('JAX_PLATFORMS')
+if _p:
+    # the ambient sitecustomize may preload jax on the accelerator
+    # platform before JAX_PLATFORMS is read; re-assert the caller's
+    # choice via config, which wins if set before backend init
+    jax.config.update('jax_platforms', _p)
+if (_p or '').split(',')[0] == 'cpu':
+    # a cpu pin must not hang on a wedged accelerator auto-init hook
+    try:
+        from seaweedfs_tpu.util.jaxenv import force_cpu_backend
+        force_cpu_backend()
+    except Exception:
+        pass
+import numpy as np
+import jax.numpy as jnp
+d = jax.devices()
+np.asarray(jnp.ones((8, 128)) + 1)  # round trip, not just init
+print(json.dumps({'devices': len(d),
+                  'platform': d[0].platform if d else ''}))
+"""
+
+
+@dataclass(frozen=True)
+class ProbeResult:
+    ok: bool
+    devices: int = 0
+    platform: str = ""
+    seconds: float = 0.0
+    error: str = ""
+
+    @property
+    def accelerator(self) -> bool:
+        """True when a non-CPU backend answered the round trip — the
+        gate for dispatching bulk GF work to a device."""
+        return self.ok and self.platform not in ("", "cpu")
+
+    def to_json(self) -> dict:
+        out: dict = {"devices": self.devices, "platform": self.platform,
+                     "probe_seconds": round(self.seconds, 2)}
+        if not self.ok:
+            out["error"] = self.error or "probe failed"
+        return out
+
+
+_LOCK = threading.Lock()
+_CACHED: ProbeResult | None = None
+
+
+def probe_timeout_s() -> float:
+    try:
+        return float(os.environ.get(
+            "SEAWEEDFS_TPU_PROBE_TIMEOUT_S", str(DEFAULT_TIMEOUT_S)))
+    except ValueError:
+        return DEFAULT_TIMEOUT_S
+
+
+def _run_probe(timeout_s: float) -> ProbeResult:
+    import importlib.util
+    import subprocess
+    import sys
+
+    t0 = time.perf_counter()
+    if importlib.util.find_spec("jax") is None:
+        return ProbeResult(ok=False, error="jax not installed",
+                           seconds=time.perf_counter() - t0)
+    env = dict(os.environ)
+    # the child must resolve seaweedfs_tpu the same way the parent did,
+    # even when the package is only importable via the parent's
+    # script-dir sys.path entry
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in sys.path if p] +
+        [env.get("PYTHONPATH", "")]).rstrip(os.pathsep)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _CHILD_CODE], capture_output=True,
+            text=True, timeout=timeout_s, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return ProbeResult(
+            ok=False, seconds=time.perf_counter() - t0,
+            error=f"device probe timed out after {timeout_s:.0f}s")
+    except Exception as exc:  # fork failure, odd embedding — never raise
+        return ProbeResult(
+            ok=False, seconds=time.perf_counter() - t0,
+            error=f"{type(exc).__name__}: {exc}"[:300])
+    dt = time.perf_counter() - t0
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return ProbeResult(
+            ok=False, seconds=dt,
+            error=(tail[-1] if tail else f"probe rc={proc.returncode}")[:300])
+    parsed = None
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            break
+        except (json.JSONDecodeError, ValueError):
+            continue
+    if not isinstance(parsed, dict) or "devices" not in parsed:
+        return ProbeResult(ok=False, seconds=dt,
+                           error="probe emitted no device report")
+    return ProbeResult(
+        ok=int(parsed["devices"]) >= 1,
+        devices=int(parsed["devices"]),
+        platform=str(parsed.get("platform", "")),
+        seconds=dt,
+        error="" if int(parsed["devices"]) >= 1 else "no devices",
+    )
+
+
+def probe(timeout_s: float | None = None, refresh: bool = False) -> ProbeResult:
+    """Cached reachability verdict; the subprocess runs at most once per
+    process (per explicit ``refresh``).  ``timeout_s`` overrides the env
+    knob for this call only — it has no effect on a cache hit."""
+    global _CACHED
+    if not refresh:
+        cached = _CACHED
+        if cached is not None:
+            return cached
+    with _LOCK:
+        if not refresh and _CACHED is not None:
+            return _CACHED
+        result = _run_probe(
+            probe_timeout_s() if timeout_s is None else timeout_s)
+        _CACHED = result
+        return result
+
+
+def reset_cache() -> None:
+    """Forget the cached verdict (tests; long-lived admin shells)."""
+    global _CACHED
+    with _LOCK:
+        _CACHED = None

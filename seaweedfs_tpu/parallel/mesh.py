@@ -104,6 +104,32 @@ def batch_encode_sharded(
     return fn(jnp.asarray(volumes))
 
 
+@functools.lru_cache(maxsize=None)
+def _sharded_apply(mesh: Mesh, rows: tuple[tuple[int, ...], ...]):
+    """One jitted sharded batch-apply per (mesh, matrix): the codec
+    service dispatches encode (parity rows) and decode (plan rows)
+    batches through the same entry, so both inherit the dp x sp layout
+    without a recompile per batch."""
+    apply_one = make_apply_xor(rows)
+    sharding = NamedSharding(mesh, P("dp", None, "sp"))
+    return jax.jit(jax.vmap(apply_one), in_shardings=sharding,
+                   out_shardings=sharding)
+
+
+def batch_apply_sharded(
+    mesh: Mesh,
+    matrix: np.ndarray,
+    batch: jax.Array | np.ndarray,
+) -> jax.Array:
+    """Apply one (R, S) GF matrix to (V, S, B) batched inputs over the
+    mesh: V shards over ``dp``, B over ``sp``.  The generalisation of
+    ``batch_encode_sharded`` to arbitrary matrices (decode plans,
+    survivor->wanted rebuild rows); dispatch is async, so the caller can
+    keep a second batch in flight while this one computes."""
+    return _sharded_apply(mesh, _rows_of(np.asarray(matrix)))(
+        jnp.asarray(batch))
+
+
 # ---------------------------------------------------------------------------
 # Distributed decode: shard axis split over dp, psum-mod-2 over ICI.
 # ---------------------------------------------------------------------------

@@ -464,6 +464,52 @@ EC_PIPELINE_STAGE = REGISTRY.histogram(
     labels=("stage",),  # prefetch | decode | write
 )
 
+# -- EC codec service (ops/codec_service.py) --------------------------------
+# one bounded queue between every GF caller (encode, rebuild, degraded
+# reads, bench) and the compute backend; the scheduler coalesces
+# same-matrix jobs into batches.  Occupancy near 1 under load means the
+# producers are not concurrent enough to batch; queue_depth pinned at the
+# bound means the backend is the bottleneck (backpressure engaged).
+
+EC_SERVICE_QUEUE_DEPTH = REGISTRY.gauge(
+    "seaweedfs_ec_service_queue_depth",
+    "codec-service jobs submitted but not yet scheduled into a batch",
+)
+EC_SERVICE_INFLIGHT = REGISTRY.gauge(
+    "seaweedfs_ec_service_inflight_batches",
+    "codec-service batches dispatched to the device, results not yet read back",
+)
+EC_SERVICE_BATCH_JOBS = REGISTRY.histogram(
+    "seaweedfs_ec_service_batch_jobs",
+    "jobs coalesced into each codec-service batch (occupancy)",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
+)
+EC_SERVICE_BATCH_BYTES = REGISTRY.histogram(
+    "seaweedfs_ec_service_batch_bytes",
+    "input bytes per codec-service batch",
+    buckets=_EC_BYTE_BUCKETS,
+)
+EC_SERVICE_FLUSH = REGISTRY.counter(
+    "seaweedfs_ec_service_flush_total",
+    "codec-service batch flushes by trigger",
+    labels=("reason",),  # full | bytes | ready | drain
+)
+EC_SERVICE_JOBS = REGISTRY.counter(
+    "seaweedfs_ec_service_jobs_total",
+    "codec-service jobs by kind and outcome",
+    labels=("kind", "result"),  # parity|apply x ok|error
+)
+EC_SERVICE_JOB_SECONDS = REGISTRY.histogram(
+    "seaweedfs_ec_service_job_seconds",
+    "codec-service job wall time, submit to delivered result",
+    labels=("kind",),
+)
+EC_SERVICE_STAGE = REGISTRY.histogram(
+    "seaweedfs_ec_service_stage_seconds",
+    "per-batch wall time in each codec-service stage",
+    labels=("stage",),  # build | compute | readback
+)
+
 
 def serve_metrics(port: int, registry: Registry = REGISTRY,
                   host: str = "0.0.0.0") -> ThreadingHTTPServer:

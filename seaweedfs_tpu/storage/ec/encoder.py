@@ -20,7 +20,7 @@ import time
 
 import numpy as np
 
-from ...ops import gf256
+from ...ops import codec_service, gf256
 from ...ops.codec import get_codec
 from ...stats.metrics import (
     EC_PIPELINE_STAGE,
@@ -57,7 +57,7 @@ def write_sorted_file_from_idx(base_name: str, ext: str = ".ecx") -> None:
 
 
 def write_ec_files(base_name: str, codec_name: str = "cpu",
-                   slice_size: int = DEFAULT_SLICE) -> None:
+                   slice_size: int = DEFAULT_SLICE, service=None) -> None:
     """Generate .ec00 ~ .ec13 from .dat (ec_encoder.go:57-59)."""
     generate_ec_files(
         base_name,
@@ -65,6 +65,7 @@ def write_ec_files(base_name: str, codec_name: str = "cpu",
         small_block_size=SMALL_BLOCK_SIZE,
         codec_name=codec_name,
         slice_size=slice_size,
+        service=service,
     )
 
 
@@ -76,19 +77,31 @@ def generate_ec_files(
     slice_size: int = DEFAULT_SLICE,
     progress=None,
     sync: bool = False,
+    service=None,
 ) -> None:
     """`progress(volume_bytes_done)` fires after each slice's shard bytes
     hit the output files — lets callers (bench, shell) report live rates.
     `sync=True` fsyncs every shard file before returning, so a completed
     encode means the shards survive a crash (and so a timed encode shares
-    accounting with an fsync'd raw-write baseline)."""
+    accounting with an fsync'd raw-write baseline).
+
+    `service` routes the GF parity compute through the shared codec
+    service (ops.codec_service): slices become queued jobs the scheduler
+    coalesces with OTHER concurrent volumes' slices into device-resident
+    (or slab-SIMD) batches.  Default: the service engages automatically
+    for device codecs when the fast probe confirms a reachable
+    accelerator; host encodes keep the direct mmap path unless a caller
+    that knows it is concurrent passes a service explicitly."""
     codec = get_codec(codec_name)
+    if service is None:
+        service = codec_service.service_for_codec(codec_name)
     dat_path = base_name + ".dat"
     dat_size = os.path.getsize(dat_path)
     outs = [open(base_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
     try:
         with open(dat_path, "rb") as f:
-            if hasattr(codec, "parity_into") and dat_size > 0:
+            if (hasattr(codec, "parity_into") or service is not None) \
+                    and not hasattr(codec, "encode_device") and dat_size > 0:
                 # host codecs: zero-copy path — stripe rows are views into
                 # the mmap'd .dat, consumed in place by the GF kernel and
                 # handed to writev as-is; the only user-space byte traffic
@@ -98,14 +111,14 @@ def generate_ec_files(
                 # worth ~2x end-to-end.
                 _encode_stream_mmap(
                     f, dat_size, outs, codec, large_block_size,
-                    small_block_size, slice_size, progress,
+                    small_block_size, slice_size, progress, service,
                 )
             else:
                 # device codecs: overlap the prefetch thread's disk reads
                 # with HBM transfer + kernel via the async dispatch
                 _encode_stream_pipelined(
                     f, dat_size, outs, codec, large_block_size,
-                    small_block_size, slice_size, progress,
+                    small_block_size, slice_size, progress, service,
                 )
         if sync:
             for o in outs:
@@ -192,7 +205,8 @@ def _writev_all(fd: int, bufs: list) -> None:
 
 
 def _encode_stream_mmap(
-    f, dat_size, outs, codec, large, small, slice_size, progress=None
+    f, dat_size, outs, codec, large, small, slice_size, progress=None,
+    service=None,
 ) -> None:
     """Single-threaded zero-copy encode for host codecs.
 
@@ -248,15 +262,32 @@ def _encode_stream_mmap(
                     per_shard[i].append(row)
             # parity per segment into contiguous per-batch output slabs
             at = 0
-            for s, (_, _, _, width) in enumerate(batch):
-                codec.parity_into(
-                    [per_shard[i][s] for i in range(DATA_SHARDS)],
-                    [parity[j, at:at + width] for j in range(n_parity)],
-                )
-                at += width
+            futures = []
+            if service is not None:
+                # one vectored submit for the whole batch of segments:
+                # the service coalesces them (and any concurrent
+                # volume's) into one kernel call, and the data-shard
+                # writev below overlaps the parity compute
+                seg_ins, seg_outs = [], []
+                for s, (_, _, _, width) in enumerate(batch):
+                    seg_ins.append(
+                        [per_shard[i][s] for i in range(DATA_SHARDS)])
+                    seg_outs.append(
+                        [parity[j, at:at + width] for j in range(n_parity)])
+                    at += width
+                futures = service.submit_parity_many(seg_ins, seg_outs)
+            else:
+                for s, (_, _, _, width) in enumerate(batch):
+                    codec.parity_into(
+                        [per_shard[i][s] for i in range(DATA_SHARDS)],
+                        [parity[j, at:at + width] for j in range(n_parity)],
+                    )
+                    at += width
             for i in range(DATA_SHARDS):
                 outs[i].flush()  # keep the buffered layer empty around writev
                 _writev_all(outs[i].fileno(), per_shard[i])
+            for fut in futures:
+                fut.result()  # parity slab must be full before its writev
             for j in range(n_parity):
                 outs[DATA_SHARDS + j].flush()
                 _writev_all(outs[DATA_SHARDS + j].fileno(),
@@ -273,7 +304,8 @@ def _encode_stream_mmap(
 
 
 def _encode_stream_pipelined(
-    f, dat_size, outs, codec, large, small, slice_size, progress=None
+    f, dat_size, outs, codec, large, small, slice_size, progress=None,
+    service=None,
 ) -> None:
     """Overlap disk reads with compute for every codec; device codecs
     also overlap HBM transfer + kernel.
@@ -294,7 +326,7 @@ def _encode_stream_pipelined(
     import threading
 
     is_device_codec = hasattr(codec, "encode_device")
-    if is_device_codec:  # host-only codecs need no jax
+    if is_device_codec and service is None:  # host-only codecs need no jax
         import jax.numpy as jnp
 
     q: queue.Queue = queue.Queue(maxsize=2)
@@ -332,7 +364,7 @@ def _encode_stream_pipelined(
     # pallas_call.  Gated: this import pulls in jax, which host-only
     # encodes must not pay for.
     lane_tile_bytes = 0
-    if is_device_codec:
+    if is_device_codec and service is None:
         try:
             from ...ops.rs_pallas import LANES, SUBLANES
             lane_tile_bytes = SUBLANES * LANES * 4
@@ -342,6 +374,10 @@ def _encode_stream_pipelined(
     def dispatch(data: np.ndarray):
         """-> (device parity future, packed?) — async on the device;
         synchronous parity for host-only codecs."""
+        if service is not None:
+            # the codec service owns device transfer + double buffering;
+            # slices become jobs it may coalesce with other volumes'
+            return service.submit_parity(data), False
         if not is_device_codec:
             return codec.parity_of(data), False
         width = data.shape[1]
@@ -380,8 +416,10 @@ def _encode_stream_pipelined(
                 with _STAGE_WRITE.time():
                     for i in range(DATA_SHARDS):
                         outs[i].write(data[i])  # buffer-protocol, no copy
-                    for i in range(parity.shape[0]):
-                        outs[DATA_SHARDS + i].write(parity[i])
+                    # parity is a (P, W) array or a list of P rows (the
+                    # codec-service future resolves to a row list)
+                    for pi, prow in enumerate(parity):
+                        outs[DATA_SHARDS + pi].write(prow)
                 done += data.shape[1] * DATA_SHARDS
                 if progress is not None:
                     progress(min(done, dat_size))
@@ -393,7 +431,10 @@ def _encode_stream_pipelined(
 
     def drain(pending) -> None:
         data, parity_dev, packed = pending
-        if isinstance(parity_dev, np.ndarray):  # host codec: timed at dispatch
+        if hasattr(parity_dev, "result"):  # codec-service future
+            with _STAGE_DECODE.time():  # wait = batch compute completion
+                parity = parity_dev.result()
+        elif isinstance(parity_dev, np.ndarray):  # host: timed at dispatch
             parity = np.ascontiguousarray(parity_dev)
         else:
             with _STAGE_DECODE.time():  # device readback = compute completion
@@ -404,7 +445,14 @@ def _encode_stream_pipelined(
         if write_err:
             raise write_err[0]
 
-    pending = None
+    from collections import deque
+
+    # service dispatch is a queue submit, so TWO slices ride in flight
+    # (the service double-buffers H2D against compute against D2H);
+    # direct device dispatch keeps the original one-async-slice window
+    async_mode = is_device_codec or service is not None
+    max_pending = 2 if service is not None else 1
+    pending_q: deque = deque()
     try:
         while True:
             item = q.get()
@@ -412,18 +460,18 @@ def _encode_stream_pipelined(
                 raise item
             if item is None:
                 break
-            if not is_device_codec:
+            if not async_mode:
                 # synchronous codec: compute here, overlap only the writes
                 with _STAGE_DECODE.time():
                     parity, packed = dispatch(item)
                 drain((item, parity, packed))
                 continue
             parity_dev, packed = dispatch(item)
-            if pending is not None:
-                drain(pending)
-            pending = (item, parity_dev, packed)
-        if pending is not None:
-            drain(pending)
+            pending_q.append((item, parity_dev, packed))
+            if len(pending_q) > max_pending:
+                drain(pending_q.popleft())
+        while pending_q:
+            drain(pending_q.popleft())
         wq.put(None)
         wt.join()
         if write_err:
@@ -543,7 +591,8 @@ def _pick_rebuild_sources(
 def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
                      slice_size: int = DEFAULT_SLICE,
                      progress=None, remote_fetch=None,
-                     shard_size: int | None = None) -> list[int]:
+                     shard_size: int | None = None,
+                     service=None) -> list[int]:
     """Regenerate whichever .ecNN files are missing (ec_encoder.go:61-62).
 
     Runs the same three-stage pipeline as the encode path: a prefetch
@@ -596,9 +645,11 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
     # plan cache: one 10x10 inversion per survivor set, not per slice
     rows = gf256.decode_plan_for(
         codec.matrix, DATA_SHARDS, sources, tuple(missing))
+    if service is None:
+        service = codec_service.service_for_codec(codec_name)
     is_device_codec = hasattr(codec, "apply_rows_device") and hasattr(
         codec, "encode_device")
-    if is_device_codec:
+    if is_device_codec and service is None:
         import jax.numpy as jnp
 
     # everything that creates on-disk or OS state is populated INSIDE the
@@ -699,14 +750,24 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
 
     def drain(pending) -> None:
         buf, dev, off, width = pending
-        with _STAGE_DECODE.time():  # device readback = decode completion
-            rebuilt = np.ascontiguousarray(np.asarray(dev, dtype=np.uint8))
+        with _STAGE_DECODE.time():  # readback/wait = decode completion
+            if hasattr(dev, "result"):  # codec-service future -> row list
+                rebuilt = dev.result()
+            else:
+                rebuilt = np.ascontiguousarray(
+                    np.asarray(dev, dtype=np.uint8))
         wq.put((buf, rebuilt, off, width))
         if write_err:
             raise write_err[0]
 
+    from collections import deque
+
+    # service submits are queue hops, so two slices ride in flight (the
+    # service double-buffers); direct device dispatch keeps one async
+    async_mode = is_device_codec or service is not None
+    max_pending = 2 if service is not None else 1
+    pending_q: deque = deque()
     ok = False
-    pending = None
     try:
         for i in sources:
             if i not in remote:
@@ -729,7 +790,7 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
             if item is None:
                 break
             buf, view, off, width = item
-            if not is_device_codec:
+            if not async_mode:
                 # host codec: SIMD decode inline, overlap only the I/O
                 with _STAGE_DECODE.time():
                     rebuilt = codec.apply_rows(rows, list(view))
@@ -737,12 +798,15 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
                 if write_err:
                     raise write_err[0]
                 continue
-            dev = codec.apply_rows_device(rows, jnp.asarray(view))
-            if pending is not None:
-                drain(pending)  # slice k reads back while k+1 computes
-            pending = (buf, dev, off, width)
-        if pending is not None:
-            drain(pending)
+            if service is not None:
+                dev = service.submit_apply(rows, list(view))
+            else:
+                dev = codec.apply_rows_device(rows, jnp.asarray(view))
+            pending_q.append((buf, dev, off, width))
+            if len(pending_q) > max_pending:
+                drain(pending_q.popleft())  # k reads back while k+1 computes
+        while pending_q:
+            drain(pending_q.popleft())
         wq.put(None)
         wt.join()
         if write_err:

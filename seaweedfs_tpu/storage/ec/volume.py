@@ -16,6 +16,7 @@ from typing import Callable
 
 import numpy as np
 
+from ...ops import codec_service, gf256
 from ...ops.codec import get_codec
 from ...stats.metrics import EC_SINGLEFLIGHT
 from ...util.chunk_cache import IntervalCache
@@ -492,6 +493,19 @@ class EcVolume:
             raise IOError(
                 f"shard {shard_id} interval unreadable: only {have} shards available"
             )
+        svc = codec_service.service_for_degraded()
+        if svc is not None:
+            # degraded-read storms coalesce: concurrent reconstructions
+            # against the same survivor set (same decode-plan row) batch
+            # into ONE SIMD call on the service scheduler.  Same plan
+            # cache + same kernel as reconstruct_one -> byte-identical.
+            present = [i for i, s in enumerate(shards) if s is not None]
+            sub = [np.asarray(shards[i], dtype=np.uint8)
+                   for i in present[:DATA_SHARDS]]
+            row = gf256.decode_plan_for(
+                np.asarray(self.codec.matrix), DATA_SHARDS,
+                present, (shard_id,))
+            return svc.submit_apply(row, sub).result()[0].tobytes(), token
         if hasattr(self.codec, "reconstruct_one"):
             # latency path: decode only the wanted row, not all lost shards
             return np.asarray(

@@ -10,7 +10,9 @@ from __future__ import annotations
 import os
 import threading
 
+from ..ops.codec import effective_codec
 from ..pb import master_pb2
+from ..util import glog
 from .disk_location import DiskLocation
 from .ec import constants as ecc
 from .ec.encoder import (
@@ -338,7 +340,13 @@ class Store:
             raise KeyError(f"volume {vid} not found")
         base = v.file_name()
         v.sync()
-        write_ec_files(base, codec_name=codec_name or self.codec_name)
+        requested = codec_name or self.codec_name
+        effective, reason = effective_codec(requested)
+        if reason:
+            glog.warning(
+                "ec.encode vol=%d: codec %s unreachable (%s), using %s",
+                vid, requested, reason, effective)
+        write_ec_files(base, codec_name=requested)
         write_sorted_file_from_idx(base)
         save_volume_info(base + ".vif", v.version,
                          dat_file_size=os.path.getsize(base + ".dat"))
@@ -363,8 +371,14 @@ class Store:
                 shard_size = None
         elif self.ec_fetcher_factory is not None:
             remote_fetch = self.ec_fetcher_factory(vid)
+        requested = codec_name or self.codec_name
+        effective, reason = effective_codec(requested)
+        if reason:
+            glog.warning(
+                "ec.rebuild vol=%d: codec %s unreachable (%s), using %s",
+                vid, requested, reason, effective)
         return rebuild_ec_files(
-            base, codec_name=codec_name or self.codec_name,
+            base, codec_name=requested,
             remote_fetch=remote_fetch, shard_size=shard_size)
 
     def _ec_base(self, vid: int, collection: str = "") -> str:

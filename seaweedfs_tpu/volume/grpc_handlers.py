@@ -264,7 +264,23 @@ class VolumeGrpcService:
 
     # -- erasure coding ---------------------------------------------------
 
+    @staticmethod
+    def _log_ec_dispatch(op: str, vid: int, codec: str) -> None:
+        """One glog line naming the codec and codec-service mode this EC
+        rpc will run under — the operator-facing answer to "did my
+        -ec.codec=tpu request actually reach a device, and is it going
+        through the batching service or direct dispatch?"."""
+        from ..ops import codec_service
+        from ..util import glog
+
+        svc = codec_service.service_for_codec(codec) if codec else None
+        glog.info("rpc %s vol=%d codec=%s dispatch=%s", op, vid,
+                  codec or "(server default)",
+                  svc.mode + "-service" if svc is not None else "direct")
+
     def VolumeEcShardsGenerate(self, request, context):
+        self._log_ec_dispatch(
+            "VolumeEcShardsGenerate", request.volume_id, request.codec)
         try:
             self.store.generate_ec_shards(
                 request.volume_id,
@@ -276,6 +292,8 @@ class VolumeGrpcService:
         return vs.VolumeEcShardsGenerateResponse()
 
     def VolumeEcShardsRebuild(self, request, context):
+        self._log_ec_dispatch(
+            "VolumeEcShardsRebuild", request.volume_id, request.codec)
         try:
             rebuilt = self.store.rebuild_ec_shards(
                 request.volume_id,

@@ -142,6 +142,14 @@ class VolumeServer:
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
         self._replica_pool.shutdown(wait=False)
+        # NOTE: the shared EC codec service is deliberately NOT closed
+        # here — it is a process-wide singleton, and tests run several
+        # volume servers in one process (closing it would fail a sibling
+        # server's in-flight encode with "service is closed").  Encode/
+        # rebuild request threads block on their job futures, so a
+        # stopping server leaves no orphan work; process exit reaps the
+        # daemon scheduler, and codec_service.shutdown_all() exists for
+        # owners that do want an explicit drain.
         self.store.close()
 
     def update_gauges(self) -> None:
