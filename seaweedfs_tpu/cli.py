@@ -396,9 +396,12 @@ def cmd_s3(args) -> None:
         config_path=args.config,
         domain=args.domainName,
         iam_config_filer_path=args.iam_config or "",
+        masters=args.master or "",
     )
     s.start()
-    print(f"s3 gateway http={args.port} filer={args.filer}")
+    print(f"s3 gateway http={args.port} "
+          + (f"masters={args.master} (fleet discovery)" if args.master
+             else f"filer={args.filer}"))
     _wait()
 
 
@@ -795,7 +798,14 @@ def main(argv=None) -> None:
     fsy.set_defaults(fn=cmd_filer_sync)
 
     s3p = sub.add_parser("s3")
-    s3p.add_argument("-filer", default="127.0.0.1:8888")
+    s3p.add_argument("-filer", default="127.0.0.1:8888",
+                     help="filer http address(es), comma-separated; a "
+                          "list pins a static fleet ring")
+    s3p.add_argument("-master", default="",
+                     help="comma-separated master http addresses: "
+                          "discover the filer fleet from the master's "
+                          "registrations and route by consistent hash "
+                          "(the stateless-gateway mode)")
     s3p.add_argument("-port", type=int, default=8333)
     s3p.add_argument("-config", default="",
                      help="s3 identities json (empty = auth disabled)")

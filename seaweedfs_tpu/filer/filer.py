@@ -15,13 +15,22 @@ import threading
 import time
 
 from ..pb import filer_pb2
-from ..util import glog
+from ..util import faultpoint, glog
 from . import filechunks
 from .filerstore import FilerStore
+from .fleet.tenant import tenant_for_path
 from .meta_log import MetaLogBuffer
 
 ROOT = "/"
 DIR_BUCKETS = "/buckets"
+
+FP_STORE_INSERT = faultpoint.register("filer.store.insert")
+
+
+def _entry_bytes(entry: filer_pb2.Entry) -> int:
+    """Logical size of a file entry for tenant accounting."""
+    return (filechunks.total_size(entry.chunks)
+            or entry.attributes.file_size or len(entry.content))
 
 
 def split_path(path: str) -> tuple[str, str]:
@@ -51,6 +60,12 @@ class Filer:
         """
         self.store = store
         self.meta_log = MetaLogBuffer()
+        # fleet.TenantManager when the sharded metadata plane is on:
+        # quota checks + usage accounting run HERE, in the local
+        # mutation path only — meta_aggregator replays write straight to
+        # the store, so each tenant is accounted exactly once fleet-wide
+        # (on the shard that owns its bucket)
+        self.tenants = None
         self._append_lock = threading.Lock()
         # serializes hardlink KV counter read-modify-writes: two
         # concurrent unlinks must not both read counter=2/write 1 and
@@ -146,6 +161,10 @@ class Filer:
             entry.attributes.crtime = int(time.time())
         if not entry.attributes.mtime:
             entry.attributes.mtime = int(time.time())
+        # quota gate BEFORE any mutation: a rejection must leave the
+        # store (including hardlink KV counters) untouched
+        tenant, d_objects, d_bytes = self._tenant_delta(
+            directory, entry, old)
         self._set_hardlink(entry)
         broke_link = (old is not None and old.hard_link_id
                       and old.hard_link_id != entry.hard_link_id)
@@ -154,7 +173,11 @@ class Filer:
             # the counter logic owns the shared chunks' lifetime here —
             # other links may still reference them, so no rewrite diff
             self._delete_hardlink(old.hard_link_id, is_delete_data=True)
+        faultpoint.inject(FP_STORE_INSERT,
+                          ctx=join_path(directory, entry.name))
         self.store.insert_entry(directory, entry)
+        if tenant:
+            self.tenants.record(tenant, d_objects, d_bytes)
         # blobs shadowed by the rewrite get deleted asynchronously; runs
         # for plain entries AND for a hardlinked entry rewritten in place
         # (same id: every link now sees the new chunks via the KV meta)
@@ -170,6 +193,8 @@ class Filer:
             self.store.find_entry(directory, entry.name))
         if old is None:
             raise FileNotFoundError(join_path(directory, entry.name))
+        tenant, d_objects, d_bytes = self._tenant_delta(
+            directory, entry, old)
         self._set_hardlink(entry)
         if (old.hard_link_id
                 and old.hard_link_id != entry.hard_link_id):
@@ -181,7 +206,27 @@ class Filer:
                 self.queue_chunk_deletion(
                     self._garbage_fids(old.chunks, entry.chunks)
                 )
+        if tenant:
+            self.tenants.record(tenant, d_objects, d_bytes)
         self.meta_log.append(directory, old, entry, signatures=signatures)
+
+    def _tenant_delta(self, directory: str, entry: filer_pb2.Entry,
+                      old: filer_pb2.Entry | None) -> tuple[str, int, int]:
+        """-> (tenant, d_objects, d_bytes) for writing ``entry`` over
+        ``old``, AFTER passing the quota gate (raises QuotaExceededError
+        when the delta would overflow the tenant's bounds).  Directories
+        carry no usage; untenanted paths return ("", 0, 0)."""
+        if self.tenants is None or entry.is_directory:
+            return "", 0, 0
+        tenant = tenant_for_path(join_path(directory, entry.name))
+        if not tenant:
+            return "", 0, 0
+        old_is_file = old is not None and not old.is_directory
+        d_objects = 0 if old_is_file else 1
+        d_bytes = _entry_bytes(entry) - (
+            _entry_bytes(old) if old_is_file else 0)
+        self.tenants.check_quota(tenant, d_objects, d_bytes)
+        return tenant, d_objects, d_bytes
 
     def _garbage_fids(self, old_chunks, new_chunks) -> list[str]:
         """fids in old but not new, with manifests EXPANDED on both sides
@@ -226,21 +271,32 @@ class Filer:
             # SHARED chunk list, not the stub's stale copy
             entry = self._maybe_read_hardlink(
                 self.store.find_entry(directory, name))
+            existed = entry is not None
             if entry is None:
                 self._ensure_parents(directory)
                 entry = filer_pb2.Entry(name=name)
                 entry.attributes.crtime = int(time.time())
             offset = filechunks.total_size(entry.chunks)
+            added = 0
             for c in chunks:
                 c2 = filer_pb2.FileChunk()
                 c2.CopyFrom(c)
                 c2.offset = offset
                 offset += c2.size
+                added += c2.size
                 entry.chunks.append(c2)
             entry.attributes.mtime = int(time.time())
             entry.attributes.file_size = offset
+            tenant = ""
+            if self.tenants is not None:
+                tenant = tenant_for_path(join_path(directory, name))
+                if tenant:
+                    self.tenants.check_quota(
+                        tenant, 0 if existed else 1, added)
             self._set_hardlink(entry)
             self.store.insert_entry(directory, entry)
+            if tenant:
+                self.tenants.record(tenant, 0 if existed else 1, added)
             self.meta_log.append(directory, None, entry)
 
     def _ensure_parents(self, directory: str, signatures=None) -> None:
@@ -305,6 +361,10 @@ class Filer:
         elif is_delete_data and entry.chunks:
             self.queue_chunk_deletion(self._all_fids(entry.chunks))
         self.store.delete_entry(directory, name)
+        if self.tenants is not None and not entry.is_directory:
+            tenant = tenant_for_path(join_path(directory, name))
+            if tenant:
+                self.tenants.record(tenant, -1, -_entry_bytes(entry))
         self.meta_log.append(
             directory, entry, None, delete_chunks=is_delete_data,
             signatures=signatures,
@@ -312,6 +372,8 @@ class Filer:
 
     def _delete_tree(self, path: str, is_delete_data: bool) -> None:
         """Collect chunk fids of the whole subtree, then drop the metadata."""
+        tenant = (tenant_for_path(path)
+                  if self.tenants is not None else "")
         stack = [path]
         while stack:
             d = stack.pop()
@@ -327,6 +389,8 @@ class Filer:
                         self._delete_hardlink(e.hard_link_id, is_delete_data)
                     elif is_delete_data and e.chunks:
                         self.queue_chunk_deletion(self._all_fids(e.chunks))
+                    if tenant and not e.is_directory:
+                        self.tenants.record(tenant, -1, -_entry_bytes(e))
                 start = batch[-1].name
         self.store.delete_folder_children(path)
 
@@ -351,6 +415,18 @@ class Filer:
             new_path = join_path(new_dir, new_name)
             self._move_children(old_path, new_path)
         self.store.delete_entry(old_dir, old_name)
+        if self.tenants is not None and not entry.is_directory:
+            # cross-tenant rename moves the usage with the file; renames
+            # of whole directories across tenants are not produced by
+            # any gateway path and stay advisory
+            t_old = tenant_for_path(join_path(old_dir, old_name))
+            t_new = tenant_for_path(join_path(new_dir, new_name))
+            if t_old != t_new:
+                size = _entry_bytes(entry)
+                if t_old:
+                    self.tenants.record(t_old, -1, -size)
+                if t_new:
+                    self.tenants.record(t_new, 1, size)
         self.meta_log.append(
             old_dir, entry, moved, new_parent_path=new_dir,
         )

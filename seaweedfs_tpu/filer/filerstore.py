@@ -65,6 +65,11 @@ class FilerStore(ABC):
         limit: int = 1024,
     ) -> Iterator[filer_pb2.Entry]: ...
 
+    def count_entries(self) -> int | None:
+        """Total entries in this store, or None when the backend cannot
+        answer cheaply (fleet shard-size accounting is best-effort)."""
+        return None
+
     # -- KV ----------------------------------------------------------------
 
     @abstractmethod

@@ -1,0 +1,58 @@
+"""Filer fleet: the sharded metadata plane (ISSUE 7).
+
+One filer process fronting one store caps directory-listing and
+small-object QPS no matter how fast the data plane is.  The fleet splits
+that plane three ways:
+
+* ``ring``     — a consistent-hash ring (virtual nodes) that shards the
+  namespace by bucket / top-level prefix across N filer instances, each
+  owning its own ``FilerStore``;
+* ``router``   — gateway-side membership discovery (the master's filer
+  registrations from PR 5's KeepConnected plane) + ring construction, so
+  gateways stay stateless: every routing decision derives from the
+  master-discovered snapshot;
+* ``tenant``   — per-tenant namespaces with quotas (object count +
+  bytes, enforced where the shard owner runs) and weighted-fair-queueing
+  admission control on the filer serving executors, driven by the PR 5
+  queue-depth gauges.
+
+Durability under shard death comes from the existing metadata federation
+(``filer/meta_aggregator.py``): fleet filers peer with each other, every
+mutation replays into every peer's store, so when a shard dies the ring
+re-routes its keys to the successor — which already holds the namespace.
+The ring decides *ownership* (who serves and accounts for a prefix); the
+aggregator decides *survival*.
+"""
+
+from .ring import HashRing, shard_key
+from .router import FleetRouter
+from .tenant import (
+    AdmissionController,
+    QuotaExceededError,
+    SlowDownError,
+    TenantManager,
+    tenant_for_path,
+)
+
+__all__ = [
+    "AdmissionController",
+    "FleetFilerClient",
+    "FleetRouter",
+    "HashRing",
+    "QuotaExceededError",
+    "SlowDownError",
+    "TenantManager",
+    "shard_key",
+    "tenant_for_path",
+]
+
+
+def __getattr__(name: str):
+    # FleetFilerClient wraps the S3 gateway's FilerClient, and s3api in
+    # turn imports this package's tenant errors — loading it lazily
+    # keeps the package import acyclic
+    if name == "FleetFilerClient":
+        from .fleet_client import FleetFilerClient
+
+        return FleetFilerClient
+    raise AttributeError(name)

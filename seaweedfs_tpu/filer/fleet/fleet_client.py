@@ -1,0 +1,261 @@
+"""FleetFilerClient: the S3 gateway's filer surface, ring-routed.
+
+Drop-in for ``s3api.filer_client.FilerClient`` — same method surface —
+but every operation routes through the consistent-hash ring to the
+shard that owns its path, with deterministic failover to ring
+successors when the owner is unreachable.  Cross-shard listings (the
+``/buckets`` directory itself, and ``/``) fan out to every live shard
+and merge, so a freshly created bucket is visible before peer
+replication catches up on the other shards.
+
+Failover only triggers on TRANSPORT failures (connection refused, gRPC
+UNAVAILABLE, a broken stream): an HTTP error status is a real answer
+from a live shard — in particular a 503 SlowDown from admission control
+must surface to the client, not silently shop the request to a
+less-loaded shard and defeat the throttle.
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.error
+
+import grpc
+
+from ...pb import filer_pb2
+from ...s3api.filer_client import FilerClient, FilerUnavailable
+from ...util.executors import MeteredThreadPoolExecutor
+from .ring import shard_key
+from .router import FleetRouter
+
+# distinct shards tried per operation before giving up
+MAX_TRIES = 3
+
+_FAILOVER_GRPC = (grpc.StatusCode.UNAVAILABLE,)
+
+
+def _is_transport_failure(e: BaseException) -> bool:
+    if isinstance(e, FilerUnavailable):
+        return True
+    if isinstance(e, grpc.RpcError):
+        code = e.code() if callable(getattr(e, "code", None)) else None
+        return code in _FAILOVER_GRPC
+    if isinstance(e, urllib.error.HTTPError):
+        return False  # a real answer from a live shard
+    if isinstance(e, urllib.error.URLError):
+        return True
+    return isinstance(e, (ConnectionError, TimeoutError))
+
+
+class FleetFilerClient:
+    def __init__(self, router: FleetRouter):
+        self.router = router
+        self._clients: dict[str, FilerClient] = {}
+        self._clients_lock = threading.Lock()
+        # cross-shard listings fan out CONCURRENTLY: latency is bounded
+        # by the slowest shard, not the sum over the fleet (saturation
+        # visible as seaweedfs_executor_*{executor="fleet_fanout"})
+        self._fanout_pool = MeteredThreadPoolExecutor(
+            max_workers=8, name="fleet_fanout")
+
+    @property
+    def http_address(self) -> str:
+        try:
+            ring = self.router.ring()
+        except Exception:  # noqa: BLE001 — a log label, never fatal
+            return "fleet[?]"
+        return f"fleet[{len(ring)}]@{ring.version()}"
+
+    def _client(self, addr: str) -> FilerClient:
+        with self._clients_lock:
+            c = self._clients.get(addr)
+            if c is None:
+                c = self._clients[addr] = FilerClient(addr)
+            return c
+
+    # -- routing core ------------------------------------------------------
+
+    def _run(self, path: str, fn):
+        """fn(FilerClient) on the owner of ``path``, failing over in
+        ring order; a transport failure forces a membership refresh so
+        the second round routes on a post-mortem ring."""
+        tried: set[str] = set()
+        last: BaseException | None = None
+        for _round in range(2):
+            try:
+                candidates = self.router.candidates(path)
+            except LookupError as e:
+                # empty ring (master up, zero live filer registrations):
+                # an outage, so surface the retryable 503, never a 500
+                raise FilerUnavailable(f"filer ring is empty: {e}")
+            for addr in candidates:
+                if addr in tried:
+                    continue
+                if len(tried) >= MAX_TRIES:
+                    break
+                tried.add(addr)
+                try:
+                    result = fn(self._client(addr))
+                except BaseException as e:  # noqa: BLE001 — classified
+                    if not _is_transport_failure(e):
+                        raise
+                    last = e
+                    self.router.note_failure(addr)
+                    continue
+                self.router.note_route(
+                    "ok" if len(tried) == 1 else "failover")
+                return result
+        self.router.note_route("error")
+        raise FilerUnavailable(
+            f"no filer shard reachable for {path!r} "
+            f"(tried {sorted(tried)}): {last}")
+
+    def _fanout_shards(self) -> list[str]:
+        nodes = list(self.router.ring().nodes)
+        if not nodes:
+            raise FilerUnavailable("filer ring is empty")
+        return nodes
+
+    # -- metadata ----------------------------------------------------------
+
+    def find_entry(self, directory: str,
+                   name: str) -> filer_pb2.Entry | None:
+        path = f"{directory.rstrip('/')}/{name}"
+        return self._run(path, lambda c: c.find_entry(directory, name))
+
+    def list_entries(self, directory: str, prefix: str = "",
+                     start_from: str = "", inclusive: bool = False,
+                     limit: int = 1024) -> list[filer_pb2.Entry]:
+        if shard_key(directory) != "/":
+            return self._run(
+                directory,
+                lambda c: c.list_entries(directory, prefix=prefix,
+                                         start_from=start_from,
+                                         inclusive=inclusive, limit=limit))
+        # cross-shard directory (/, /buckets): merge every live shard's
+        # answer, fetched concurrently.  Replication makes the lists
+        # converge; the merge keeps the window between a create and its
+        # replay invisible.
+        merged: dict[str, filer_pb2.Entry] = {}
+        reached = 0
+        last: BaseException | None = None
+
+        def list_one(addr: str):
+            return self._client(addr).list_entries(
+                directory, prefix=prefix, start_from=start_from,
+                inclusive=inclusive, limit=limit)
+
+        futures = [(addr, self._fanout_pool.submit(list_one, addr))
+                   for addr in self._fanout_shards()]
+        for addr, fut in futures:
+            try:
+                batch = fut.result()
+            except BaseException as e:  # noqa: BLE001
+                if not _is_transport_failure(e):
+                    raise
+                last = e
+                self.router.note_failure(addr)
+                continue
+            reached += 1
+            for entry in batch:
+                merged.setdefault(entry.name, entry)
+        if not reached:
+            self.router.note_route("error")
+            raise FilerUnavailable(
+                f"no filer shard reachable for listing {directory!r}: "
+                f"{last}")
+        self.router.note_route("ok")
+        return [merged[name] for name in sorted(merged)][:limit]
+
+    def iter_entries(self, directory: str, prefix: str = "",
+                     page: int = 1024):
+        start, inclusive = "", False
+        while True:
+            batch = self.list_entries(directory, prefix=prefix,
+                                      start_from=start, inclusive=inclusive,
+                                      limit=page)
+            yield from batch
+            if len(batch) < page:
+                return
+            start, inclusive = batch[-1].name, False
+
+    def walk(self, directory: str):
+        from collections import deque
+
+        queue = deque([directory.rstrip("/") or "/"])
+        while queue:
+            d = queue.popleft()
+            for entry in self.iter_entries(d):
+                yield d, entry
+                if entry.is_directory:
+                    queue.append((d.rstrip("/") or "") + "/" + entry.name)
+
+    def create_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
+        path = f"{directory.rstrip('/')}/{entry.name}"
+        self._run(path, lambda c: c.create_entry(directory, entry))
+
+    def update_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
+        path = f"{directory.rstrip('/')}/{entry.name}"
+        self._run(path, lambda c: c.update_entry(directory, entry))
+
+    def mkdir(self, directory: str, name: str, mode: int = 0o777) -> None:
+        path = f"{directory.rstrip('/')}/{name}"
+        self._run(path, lambda c: c.mkdir(directory, name, mode))
+
+    def delete_entry(self, directory: str, name: str,
+                     is_delete_data: bool = True,
+                     is_recursive: bool = False) -> str:
+        path = f"{directory.rstrip('/')}/{name}"
+        return self._run(
+            path, lambda c: c.delete_entry(
+                directory, name, is_delete_data=is_delete_data,
+                is_recursive=is_recursive))
+
+    # -- bytes -------------------------------------------------------------
+
+    def put_object(self, path: str, data: bytes, mime: str = "") -> None:
+        self._run(path, lambda c: c.put_object(path, data, mime=mime))
+
+    # streamed PUTs up to this size buffer into memory so they can fail
+    # over between shards like every other write; larger bodies stream
+    # to the owner only (a half-consumed reader cannot be replayed)
+    STREAM_FAILOVER_MAX = 8 << 20
+
+    def put_object_stream(self, path: str, reader, length: int,
+                          mime: str = "") -> None:
+        if length <= self.STREAM_FAILOVER_MAX:
+            chunks: list[bytes] = []
+            got = 0
+            while got < length:
+                b = reader.read(min(1 << 20, length - got))
+                if not b:
+                    # a short body must fail the upload, never commit a
+                    # truncated object (the non-fleet path fails at the
+                    # transport when Content-Length goes unmet)
+                    raise IOError(
+                        f"short object body: got {got} of {length} bytes")
+                chunks.append(b)
+                got += len(b)
+            return self.put_object(path, b"".join(chunks), mime=mime)
+        try:
+            addr = self.router.owner(path)
+        except LookupError as e:
+            raise FilerUnavailable(f"filer ring is empty: {e}")
+        try:
+            self._client(addr).put_object_stream(path, reader, length,
+                                                 mime=mime)
+        except BaseException as e:  # noqa: BLE001
+            if _is_transport_failure(e):
+                self.router.note_failure(addr)
+                self.router.note_route("error")
+            raise
+        self.router.note_route("ok")
+
+    def open_object(self, path: str, range_header: str = ""):
+        return self._run(
+            path, lambda c: c.open_object(path, range_header=range_header))
+
+    def get_object(self, path: str,
+                   range_header: str = "") -> tuple[int, dict, bytes]:
+        return self._run(
+            path, lambda c: c.get_object(path, range_header=range_header))

@@ -1,0 +1,100 @@
+"""Consistent-hash ring with virtual nodes.
+
+Reference shape: the classic Karger ring as deployed by every
+SeaweedFS-class metadata shard map — each physical node is hashed onto
+the ring VNODES times, a key routes to the first vnode clockwise from
+its hash, and membership churn of one node out of N remaps only ~K/N
+keys (the dead node's arcs), never reshuffling the survivors.
+
+Determinism matters more than speed here: every gateway must compute the
+SAME mapping from the same membership list, across processes and hosts,
+or two gateways would account one bucket to two shards.  Hashing is
+therefore md5 over stable strings (no process-seeded ``hash()``), and
+lookup is a bisect over the sorted vnode array — O(log vnodes).
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+
+DEFAULT_VNODES = 64
+
+
+def _hash64(s: str) -> int:
+    """Stable 64-bit position on the ring (first 8 md5 bytes)."""
+    return int.from_bytes(hashlib.md5(s.encode()).digest()[:8], "big")
+
+
+def shard_key(path: str) -> str:
+    """The routing key a filer path shards on.
+
+    ``/buckets/<b>/...`` shards on the bucket — a bucket's whole subtree
+    (objects, multipart staging, markers) lands on ONE shard, so every
+    read-after-write inside a bucket is served by the store that took
+    the write.  Any other absolute path shards on its top-level segment
+    (``/etc/...``, ``/topics/...``), keeping each config/topic family
+    together.  ``/`` and ``/buckets`` themselves return ``"/"`` — the
+    caller treats that as "cross-shard" (listings fan out and merge)."""
+    p = "/" + path.strip("/")
+    if p == "/":
+        return "/"
+    segs = p.lstrip("/").split("/")
+    if segs[0] == "buckets":
+        if len(segs) == 1:
+            return "/"
+        return "b/" + segs[1]
+    return "t/" + segs[0]
+
+
+class HashRing:
+    """Immutable ring snapshot over a membership list."""
+
+    def __init__(self, nodes: list[str], vnodes: int = DEFAULT_VNODES):
+        self.nodes = sorted(set(nodes))
+        self.vnodes = vnodes
+        points: list[tuple[int, str]] = []
+        for node in self.nodes:
+            for i in range(vnodes):
+                points.append((_hash64(f"{node}#{i}"), node))
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._owners = [n for _, n in points]
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+    def __bool__(self) -> bool:
+        return bool(self.nodes)
+
+    def version(self) -> str:
+        """Stable fingerprint of the membership (snapshot identity)."""
+        return hashlib.md5("|".join(self.nodes).encode()).hexdigest()[:12]
+
+    def lookup(self, key: str) -> str:
+        """The owning node for ``key`` (first vnode clockwise)."""
+        if not self.nodes:
+            raise LookupError("empty filer ring")
+        i = bisect.bisect_right(self._hashes, _hash64(key))
+        if i == len(self._hashes):
+            i = 0
+        return self._owners[i]
+
+    def lookup_order(self, key: str) -> list[str]:
+        """Owner first, then each DISTINCT successor in ring order — the
+        failover sequence when the owner is unreachable.  With full
+        metadata replication across the fleet any successor can serve
+        the keys; ring order keeps the choice deterministic so every
+        gateway fails over to the same node."""
+        if not self.nodes:
+            raise LookupError("empty filer ring")
+        start = bisect.bisect_right(self._hashes, _hash64(key))
+        out: list[str] = []
+        n = len(self._owners)
+        for step in range(n):
+            owner = self._owners[(start + step) % n]
+            if owner not in out:
+                out.append(owner)
+                if len(out) == len(self.nodes):
+                    break
+        return out

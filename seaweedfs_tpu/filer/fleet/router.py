@@ -1,0 +1,144 @@
+"""Gateway-side fleet routing: master-discovered membership -> ring.
+
+The gateways hold NO durable routing state.  Membership comes from the
+master's observability plane — filers register over KeepConnected with
+``client_type="filer"`` and a scrapeable HTTP address (PR 5), and
+``GET /cluster/status`` serves them with per-client liveness.  The
+router polls that, filters stale registrations, and rebuilds the ring
+whenever membership changes; a routing failure forces an immediate
+refresh so a SIGKILLed filer stops being the owner within one
+round-trip of the master noticing, not a cache TTL later.
+
+A restarted gateway reconstructs the identical ring from the same
+master answer — that is the statelessness contract the acceptance test
+pins (restart a gateway mid-test; behavior identical).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from ...stats.metrics import RING_NODES, RING_REFRESH, RING_ROUTE
+from ...util import connpool, faultpoint, glog
+from .ring import DEFAULT_VNODES, HashRing, shard_key
+
+# how long a discovered membership snapshot is trusted before re-asking
+# the master; routing failures bypass the TTL
+MEMBERSHIP_TTL_S = 2.0
+
+# a filer whose KeepConnected registration went quiet for this long is
+# dropped from the ring even if the master still lists it
+STALE_FILER_S = 30.0
+
+FP_RING_ROUTE = faultpoint.register("filer.ring.route")
+
+
+class FleetRouter:
+    """Membership discovery + ring construction for one gateway process.
+
+    Two modes:
+    * static   — ``filers=[...]`` pins the membership (tests, fixed
+      fleets without a master);
+    * discover — ``masters=[...]`` (HTTP addresses) polls
+      /cluster/status for live filer registrations.
+    """
+
+    def __init__(self, masters: list[str] | None = None,
+                 filers: list[str] | None = None,
+                 vnodes: int = DEFAULT_VNODES,
+                 membership_ttl_s: float = MEMBERSHIP_TTL_S):
+        self.masters = [m.strip() for m in (masters or []) if m.strip()]
+        self.static_filers = [f.strip() for f in (filers or []) if f.strip()]
+        if not self.masters and not self.static_filers:
+            raise ValueError("FleetRouter needs masters or a filer list")
+        self.vnodes = vnodes
+        self.membership_ttl_s = membership_ttl_s
+        self._lock = threading.Lock()
+        self._ring = HashRing(self.static_filers, vnodes)
+        self._fetched_at = time.monotonic() if self.static_filers else 0.0
+        if self.static_filers:
+            RING_NODES.labels().set(len(self.static_filers))
+
+    # -- membership --------------------------------------------------------
+
+    def _discover(self) -> list[str]:
+        """Live filer HTTP addresses from the first answering master."""
+        last: Exception | None = None
+        for master in self.masters:
+            try:
+                with connpool.request(
+                        "GET", f"http://{master}/cluster/status",
+                        timeout=5) as r:
+                    doc = json.loads(r.read())
+            except Exception as e:  # noqa: BLE001 — rotate masters
+                last = e
+                continue
+            filers = []
+            for info in (doc.get("Filers") or {}).values():
+                addr = info.get("httpAddress")
+                age = info.get("secondsSinceLastSeen", 0.0)
+                if addr and float(age or 0.0) < STALE_FILER_S:
+                    filers.append(addr)
+            return sorted(set(filers))
+        raise IOError(f"no master answered /cluster/status: {last}")
+
+    def refresh(self, force: bool = False) -> HashRing:
+        """Return the current ring, re-discovering membership when the
+        snapshot aged out (or ``force``)."""
+        if self.static_filers:
+            return self._ring
+        with self._lock:
+            fresh = (time.monotonic() - self._fetched_at
+                     < self.membership_ttl_s)
+            if fresh and not force and self._ring:
+                return self._ring
+            try:
+                members = self._discover()
+            except Exception as e:  # noqa: BLE001 — keep the stale ring
+                RING_REFRESH.labels("error").inc()
+                if self._ring:
+                    glog.warning("filer ring refresh failed (%s); "
+                                 "keeping %d-node snapshot", e,
+                                 len(self._ring))
+                    return self._ring
+                raise
+            RING_REFRESH.labels("forced" if force else "ttl").inc()
+            if members != self._ring.nodes:
+                old = self._ring.version() if self._ring else "-"
+                self._ring = HashRing(members, self.vnodes)
+                glog.info("filer ring %s -> %s members=%s",
+                          old, self._ring.version(), members)
+            RING_NODES.labels().set(len(members))
+            self._fetched_at = time.monotonic()
+            return self._ring
+
+    def ring(self) -> HashRing:
+        return self.refresh()
+
+    # -- routing -----------------------------------------------------------
+
+    def candidates(self, path: str) -> list[str]:
+        """Failover-ordered filer addresses for ``path`` (owner first).
+
+        Cross-shard keys (``shard_key == "/"``) still return a full
+        deterministic order — callers that need a fan-out use
+        ``ring().nodes`` instead."""
+        faultpoint.inject(FP_RING_ROUTE, ctx=path)
+        ring = self.refresh()
+        return ring.lookup_order(shard_key(path))
+
+    def owner(self, path: str) -> str:
+        faultpoint.inject(FP_RING_ROUTE, ctx=path)
+        return self.refresh().lookup(shard_key(path))
+
+    def note_route(self, result: str) -> None:
+        """result ∈ ok | failover | error (one per routed operation)."""
+        RING_ROUTE.labels(result).inc()
+
+    def note_failure(self, addr: str) -> None:
+        """A candidate failed at the transport level: force the next
+        routing decision to re-ask the master (the filer may be gone)."""
+        with self._lock:
+            self._fetched_at = 0.0

@@ -11,6 +11,7 @@ import grpc
 
 from ..pb import filer_pb2
 from .filer import join_path
+from .fleet.tenant import QuotaExceededError
 
 
 class FilerGrpcService:
@@ -68,6 +69,10 @@ class FilerGrpcService:
             return filer_pb2.CreateEntryResponse()
         except FileExistsError as e:
             return filer_pb2.CreateEntryResponse(error=str(e))
+        except QuotaExceededError as e:
+            # the "quota exceeded" prefix is the wire contract the S3
+            # gateway maps to 403 QuotaExceeded XML
+            return filer_pb2.CreateEntryResponse(error=str(e))
 
     def UpdateEntry(self, request, context):
         try:
@@ -76,12 +81,17 @@ class FilerGrpcService:
                                     signatures=list(request.signatures))
         except FileNotFoundError as e:
             context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except QuotaExceededError as e:
+            context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
         return filer_pb2.UpdateEntryResponse()
 
     def AppendToEntry(self, request, context):
-        self.filer.append_chunks(
-            request.directory, request.entry_name, list(request.chunks)
-        )
+        try:
+            self.filer.append_chunks(
+                request.directory, request.entry_name, list(request.chunks)
+            )
+        except QuotaExceededError as e:
+            context.abort(grpc.StatusCode.PERMISSION_DENIED, str(e))
         entry = self.filer.store.find_entry(request.directory,
                                             request.entry_name)
         if entry is not None and len(entry.chunks) > self.fs.manifest_batch:

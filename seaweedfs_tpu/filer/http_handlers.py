@@ -20,6 +20,11 @@ from ..pb import filer_pb2
 from ..telemetry import http_request, serve_debug_http, trace
 from . import filechunks
 from .filer import join_path, split_path
+from .fleet.tenant import (
+    QuotaExceededError,
+    SlowDownError,
+    tenant_for_path,
+)
 
 
 class FilerHttpHandler(BaseHTTPRequestHandler):
@@ -52,16 +57,35 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
     def _json(self, code: int, obj: dict):
         self._send(code, json.dumps(obj).encode())
 
+    # -- admission (fleet WFQ) ---------------------------------------------
+
+    def _admitted(self, fn) -> None:
+        """Run one request under the tenant admission gate.  A rejection
+        is a well-formed 503 with Retry-After + a machine-readable
+        X-Seaweed-Reject header the S3 gateway translates into SlowDown
+        XML; untenanted paths (config, /debug) pass uncounted."""
+        tenant = tenant_for_path(
+            urllib.parse.unquote(urllib.parse.urlparse(self.path).path))
+        try:
+            with self.filer_server.admission.admit(tenant):
+                fn()
+        except SlowDownError as e:
+            self._send(503, json.dumps({"error": str(e)}).encode(),
+                       extra={"Retry-After": str(e.retry_after),
+                              "X-Seaweed-Reject": "slowdown"})
+
     # -- read / list -------------------------------------------------------
 
     def do_GET(self):
         with http_request(self, "filer", "get"):
-            self._do_get()
+            self._admitted(self._do_get)
 
     def _do_get(self):
         u = urllib.parse.urlparse(self.path)
         path = urllib.parse.unquote(u.path)
         q = urllib.parse.parse_qs(u.query)
+        if path == "/debug/tenants":
+            return self._serve_tenants(q)
         # debug/observability surface (exact paths, ahead of the namespace)
         if serve_debug_http(self, path):
             return
@@ -74,7 +98,44 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
 
     def do_HEAD(self):
         with http_request(self, "filer", "get"):
-            self._do_get()
+            self._admitted(self._do_get)
+
+    def _serve_tenants(self, q: dict):
+        """The shard's tenant plane in one JSON: quota config + usage per
+        tenant, the admission controller's live state, and this store's
+        entry count (the `filer.ring` shell command's data source).
+
+        ``?set=<tenant>&quota_bytes=&quota_objects=&weight=`` updates a
+        tenant's config — the HTTP twin of a gRPC KvPut, which already
+        exposes the same store to anyone with cluster reach."""
+        fs = self.filer_server
+        if q.get("set", [""])[0]:
+            tenant = q["set"][0]
+            kw = {}
+            for key in ("quota_bytes", "quota_objects"):
+                if q.get(key, [""])[0]:
+                    try:
+                        kw[key] = int(q[key][0])
+                    except ValueError:
+                        return self._json(400, {
+                            "error": f"{key} must be an integer"})
+            if q.get("weight", [""])[0]:
+                try:
+                    kw["weight"] = float(q["weight"][0])
+                except ValueError:
+                    return self._json(400, {"error": "bad weight"})
+            conf = fs.tenants.set_config(tenant, **kw)
+            return self._json(200, {"tenant": tenant, "config": conf})
+        try:
+            entries = self.filer.store.count_entries()
+        except Exception:  # noqa: BLE001 — optional per-backend
+            entries = None
+        return self._json(200, {
+            "tenants": fs.tenants.snapshot(),
+            "admission": fs.admission.snapshot(),
+            "entries": entries,
+            "store": type(self.filer.store).__name__,
+        })
 
     def _list_dir(self, path: str, q: dict):
         limit = int(q.get("limit", ["100"])[0])
@@ -139,11 +200,15 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         with http_request(self, "filer", "post"):
-            self._upload()
+            self._admitted(self._upload)
 
     def do_PUT(self):
         with http_request(self, "filer", "post"):
-            self._upload()
+            self._admitted(self._upload)
+
+    def _quota_reject(self, e: QuotaExceededError):
+        return self._send(403, json.dumps({"error": str(e)}).encode(),
+                          extra={"X-Seaweed-Reject": "quota"})
 
     def _upload(self):
         u = urllib.parse.urlparse(self.path)
@@ -172,6 +237,8 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
                     path, body, mime=ctype, collection=collection,
                     replication=q.get("replication", [""])[0], ttl=ttl,
                 )
+            except QuotaExceededError as e:
+                return self._quota_reject(e)
             except Exception as e:
                 return self._json(500, {
                     "error": str(e),
@@ -190,6 +257,8 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
                 ttl=ttl,
                 signatures=_signatures(q),
             )
+        except QuotaExceededError as e:
+            return self._quota_reject(e)
         except Exception as e:
             return self._json(500, {
                 "error": str(e),
@@ -204,7 +273,7 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):
         with http_request(self, "filer", "delete"):
-            self._do_delete()
+            self._admitted(self._do_delete)
 
     def _do_delete(self):
         u = urllib.parse.urlparse(self.path)

@@ -89,6 +89,14 @@ class FilerServer:
                 self._delete_chunks,
                 resolve_chunks_fn=self.resolve_chunks,
             )
+        # tenant plane (fleet): quotas checked in the Filer mutation
+        # path, WFQ admission consulted by the HTTP serving layer.
+        # Config/usage persist in this shard's own store KV.
+        from .fleet.tenant import AdmissionController, TenantManager
+
+        self.tenants = TenantManager(self.filer.store)
+        self.filer.tenants = self.tenants
+        self.admission = AdmissionController(self.tenants)
         # the store signature identifies THIS store across restarts
         # (meta_aggregator.go: "filer.store.id"); peers replicate only
         # from stores whose signature differs from their own
@@ -203,6 +211,7 @@ class FilerServer:
             self._metricsd.server_close()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
+        self.tenants.close()  # checkpoint usage before the store closes
         self.filer.close()
         self._pool.shutdown(wait=False)
 
@@ -239,6 +248,10 @@ class FilerServer:
                    signatures: list[int] | None = None) -> filer_pb2.Entry:
         """Auto-chunking upload: split, assign+upload each chunk, CreateEntry."""
         directory, name = split_path(path)
+        # quota pre-check BEFORE the chunk uploads: create_entry re-runs
+        # the authoritative gate, but failing here keeps an over-quota
+        # write from parking orphan chunks on the volume servers first
+        self._precheck_quota(directory, name, len(data))
         collection, replication, ttl = self.apply_path_conf(
             path, collection, replication, ttl)
         chunk_size = self.max_mb << 20
@@ -324,12 +337,28 @@ class FilerServer:
             return chunk
         raise IOError(f"chunk upload failed after re-assigns: {last}")
 
+    def _precheck_quota(self, directory: str, name: str,
+                        new_bytes: int, append: bool = False) -> None:
+        from .filer import _entry_bytes
+        from .fleet.tenant import tenant_for_path
+
+        tenant = tenant_for_path(f"{directory}/{name}")
+        if not tenant:
+            return
+        old = self.filer.store.find_entry(directory, name)
+        old_is_file = old is not None and not old.is_directory
+        d_bytes = new_bytes if append else (
+            new_bytes - (_entry_bytes(old) if old_is_file else 0))
+        self.tenants.check_quota(
+            tenant, 0 if old_is_file else 1, d_bytes)
+
     def append_file(self, path: str, data: bytes, mime: str = "",
                     collection: str = "", replication: str = "",
                     ttl: str = "") -> filer_pb2.Entry:
         """Append bytes as a new chunk (AppendToEntry semantics over HTTP;
         used by log-style writers like the message broker)."""
         directory, name = split_path(path)
+        self._precheck_quota(directory, name, len(data), append=True)
         collection, replication, ttl = self.apply_path_conf(
             path, collection, replication, ttl)
         chunk = self._upload_chunk(

@@ -47,6 +47,10 @@ class MemoryStore(FilerStore):
                 if i < len(names) and names[i] == name:
                     names.pop(i)
 
+    def count_entries(self) -> int:
+        with self._lock:
+            return sum(len(d) for d in self._dirs.values())
+
     def delete_folder_children(self, directory: str) -> None:
         with self._lock:
             prefix = directory.rstrip("/") + "/"

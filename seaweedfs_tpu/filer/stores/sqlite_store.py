@@ -45,6 +45,19 @@ class SqliteStore(FilerStore):
         self._conn.execute("PRAGMA synchronous=NORMAL")
         self._conn.executescript(_SCHEMA)
         self._lock = threading.RLock()
+        # reads run on their OWN connection + lock: WAL already lets a
+        # reader see the last committed snapshot while a writer commits,
+        # but one shared connection serialized listings behind insert
+        # fsyncs — the exact stall the sharded metadata plane exists to
+        # remove.  :memory: has no WAL file to share, so it keeps the
+        # single-connection behavior.
+        if path == ":memory:":
+            self._rconn = self._conn
+            self._rlock = self._lock
+        else:
+            self._rconn = sqlite3.connect(path, check_same_thread=False)
+            self._rconn.execute("PRAGMA query_only=ON")
+            self._rlock = threading.RLock()
 
     def insert_entry(self, directory: str, entry: filer_pb2.Entry) -> None:
         with self._lock:
@@ -58,8 +71,8 @@ class SqliteStore(FilerStore):
     update_entry = insert_entry
 
     def find_entry(self, directory: str, name: str) -> filer_pb2.Entry | None:
-        with self._lock:
-            row = self._conn.execute(
+        with self._rlock:
+            row = self._rconn.execute(
                 "SELECT meta FROM filemeta WHERE directory=? AND name=?",
                 (directory, name),
             ).fetchone()
@@ -104,14 +117,20 @@ class SqliteStore(FilerStore):
             params.append(_glob_escape(prefix) + "*")
         sql += "ORDER BY name LIMIT ?"
         params.append(limit)
-        with self._lock:
-            rows = self._conn.execute(sql, params).fetchall()
+        with self._rlock:
+            rows = self._rconn.execute(sql, params).fetchall()
         for (meta,) in rows:
             yield filer_pb2.Entry.FromString(meta)
 
+    def count_entries(self) -> int:
+        """Shard size for the fleet's per-shard accounting surface."""
+        with self._rlock:
+            return self._rconn.execute(
+                "SELECT COUNT(*) FROM filemeta").fetchone()[0]
+
     def kv_get(self, key: bytes) -> bytes | None:
-        with self._lock:
-            row = self._conn.execute(
+        with self._rlock:
+            row = self._rconn.execute(
                 "SELECT v FROM filer_kv WHERE k=?", (key,)
             ).fetchone()
         return row[0] if row else None
@@ -128,5 +147,8 @@ class SqliteStore(FilerStore):
             self._conn.commit()
 
     def close(self) -> None:
+        if self._rconn is not self._conn:
+            with self._rlock:
+                self._rconn.close()
         with self._lock:
             self._conn.close()

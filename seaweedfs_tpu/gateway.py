@@ -6,10 +6,15 @@ Reference: weed/command/gateway.go + weed/server/gateway_server.go —
   POST   /files/<path>      -> save bytes at the filer path
   DELETE /files/<path>      -> delete the filer path
   POST   /topics/<ns>/<t>   -> append a message to the topic log
-Masters and filers are picked round-robin per request.  The reference
-left /files and /topics as empty stubs (gateway_server.go:95-103); here
-they are functional: files proxy to the filer HTTP plane, topics append
-to the filer-backed topic log the message broker reads.
+Masters are picked round-robin per request.  Filer traffic routes
+through the fleet's consistent-hash ring (filer/fleet): with an explicit
+``-filer`` list the ring is static; without one, membership is
+discovered live from the master's filer registrations, so the gateway is
+fully stateless and a filer death re-routes its prefixes to the ring
+successor.  The reference left /files and /topics as empty stubs
+(gateway_server.go:95-103); here they are functional: files proxy to the
+filer HTTP plane, topics append to the filer-backed topic log the
+message broker reads.
 """
 
 from __future__ import annotations
@@ -22,6 +27,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .util.httpd import FrameworkHTTPServer
 
+from .filer.fleet import FleetRouter
 from .util import connpool, glog
 
 
@@ -32,16 +38,22 @@ class GatewayServer:
             raise ValueError("gateway needs at least one master")
         self.port = port
         self._masters = itertools.cycle(masters)
-        self._filers = itertools.cycle(filers) if filers else None
+        # static filer list pins the ring; otherwise discover members
+        # from the master's KeepConnected filer registrations
+        self.router = FleetRouter(
+            masters=None if filers else masters,
+            filers=filers or None)
         self._httpd: ThreadingHTTPServer | None = None
 
     def master(self) -> str:
         return next(self._masters)
 
-    def filer(self) -> str:
-        if self._filers is None:
-            raise LookupError("no filers configured")
-        return next(self._filers)
+    def filer_candidates(self, path: str) -> list[str]:
+        """Ring-ordered filer addresses for a /files or /topics path."""
+        try:
+            return self.router.candidates(path)
+        except LookupError:
+            raise LookupError("no filers configured or discovered")
 
     def start(self) -> None:
         handler = type("BoundGatewayHandler", (GatewayHandler,),
@@ -173,30 +185,44 @@ class GatewayHandler(BaseHTTPRequestHandler):
 
     def _proxy_filer(self, method: str, path: str,
                      query: str = "") -> None:
-        filer = self.gw.filer()
         data = self._body() if method == "PUT" else None
         qs = f"?{query}" if query else ""
         headers = ({"Content-Type": self.headers.get("Content-Type")
                     or "application/octet-stream"} if data else {})
-        try:
-            with connpool.request(
-                    method, f"http://{filer}{urllib.parse.quote(path)}{qs}",
-                    body=data, headers=headers, timeout=120) as r:
-                body = r.read()
-                self.send_response(r.status)
-                ct = r.headers.get("Content-Type", "application/json")
-                self.send_header("Content-Type", ct)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-        except urllib.error.HTTPError as e:
-            self._send_json(e.code, {"error": str(e.reason)})
+        last: Exception | None = None
+        for i, filer in enumerate(self.gw.filer_candidates(path)[:3]):
+            try:
+                with connpool.request(
+                        method,
+                        f"http://{filer}{urllib.parse.quote(path)}{qs}",
+                        body=data, headers=headers, timeout=120) as r:
+                    body = r.read()
+                    self.gw.router.note_route("ok" if i == 0
+                                              else "failover")
+                    self.send_response(r.status)
+                    ct = r.headers.get("Content-Type", "application/json")
+                    self.send_header("Content-Type", ct)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+            except urllib.error.HTTPError as e:
+                # a real filer answer (404, 403 quota, 503 slowdown):
+                # relay it — only transport failures fail over
+                self.gw.router.note_route("ok" if i == 0 else "failover")
+                return self._send_json(e.code, {"error": str(e.reason)})
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                last = e
+                self.gw.router.note_failure(filer)
+                continue
+        self.gw.router.note_route("error")
+        self._send_json(503, {"error": f"no filer shard reachable: {last}"})
 
     # -- topics (append to the broker's filer-backed log) --------------------
 
     def _post_topic(self, topic_path: str) -> None:
         data = self._body()
-        filer = self.gw.filer()
+        filer = self.gw.filer_candidates(f"/topics/{topic_path}")[0]
         url = (f"http://{filer}/topics/{urllib.parse.quote(topic_path)}"
                f"/messages.log?op=append")
         with connpool.request(

@@ -13,12 +13,32 @@ import urllib.parse
 
 import grpc
 
+from ..filer.fleet.tenant import QuotaExceededError, SlowDownError
 from ..pb import filer_pb2
 from ..pb import rpc as rpclib
 from ..util import connpool, failsafe
 from ..util.http_util import trace_headers
 
 GRPC_PORT_OFFSET = 10000
+
+
+def _raise_if_rejected(e: urllib.error.HTTPError) -> None:
+    """Translate a filer-side admission/quota rejection (marked with the
+    X-Seaweed-Reject header) into its typed exception — BEFORE the retry
+    machinery sees the 503, so a SlowDown is surfaced to the client
+    instead of hammered three more times."""
+    kind = (e.headers.get("X-Seaweed-Reject", "") if e.headers else "")
+    if not kind:
+        return
+    e.read()
+    if kind == "slowdown":
+        try:
+            retry_after = int(e.headers.get("Retry-After", "1") or 1)
+        except ValueError:
+            retry_after = 1
+        raise SlowDownError("", retry_after=retry_after)
+    if kind == "quota":
+        raise QuotaExceededError("", "filer shard rejected the write")
 
 # the gateway's edge to the filer: bounded retries, no breaker bypass —
 # the filer is the gateway's only backend, so we keep probing it
@@ -162,14 +182,20 @@ class FilerClient:
         # a filer PUT replaces the whole entry, so re-sending after an
         # ambiguous failure converges on the same result: idempotent
         def attempt() -> None:
-            with connpool.request(
-                    "PUT",
-                    f"http://{self.http_address}{urllib.parse.quote(path)}",
-                    body=data,
-                    headers=trace_headers(
-                        {"Content-Type": mime or "application/octet-stream"}),
-                    timeout=failsafe.attempt_timeout(120)) as r:
-                r.read()
+            try:
+                with connpool.request(
+                        "PUT",
+                        f"http://{self.http_address}"
+                        f"{urllib.parse.quote(path)}",
+                        body=data,
+                        headers=trace_headers(
+                            {"Content-Type":
+                             mime or "application/octet-stream"}),
+                        timeout=failsafe.attempt_timeout(120)) as r:
+                    r.read()
+            except urllib.error.HTTPError as e:
+                _raise_if_rejected(e)
+                raise
 
         failsafe.call(attempt, op="put_object", retry_type="s3",
                       policy=_S3_POLICY, idempotent=True)
@@ -180,16 +206,20 @@ class FilerClient:
         (http.client streams objects that expose .read).  The pool sends
         a non-seekable stream on a fresh dial — a half-consumed reader
         can't be replayed onto a stale keep-alive socket."""
-        with connpool.request(
-                "PUT",
-                f"http://{self.http_address}{urllib.parse.quote(path)}",
-                body=reader,
-                headers=trace_headers({
-                    "Content-Type": mime or "application/octet-stream",
-                    "Content-Length": str(length),
-                }),
-                timeout=600) as r:
-            r.read()
+        try:
+            with connpool.request(
+                    "PUT",
+                    f"http://{self.http_address}{urllib.parse.quote(path)}",
+                    body=reader,
+                    headers=trace_headers({
+                        "Content-Type": mime or "application/octet-stream",
+                        "Content-Length": str(length),
+                    }),
+                    timeout=600) as r:
+                r.read()
+        except urllib.error.HTTPError as e:
+            _raise_if_rejected(e)
+            raise
 
     def open_object(self, path: str, range_header: str = ""):
         """Streaming GET: returns the live HTTP response (file-like with
@@ -198,10 +228,14 @@ class FilerClient:
         headers = trace_headers()
         if range_header:
             headers["Range"] = range_header
-        return connpool.request(
-            "GET",
-            f"http://{self.http_address}{urllib.parse.quote(path)}",
-            headers=headers, timeout=600)
+        try:
+            return connpool.request(
+                "GET",
+                f"http://{self.http_address}{urllib.parse.quote(path)}",
+                headers=headers, timeout=600)
+        except urllib.error.HTTPError as e:
+            _raise_if_rejected(e)
+            raise
 
     def get_object(self, path: str, range_header: str = "") -> tuple[int, dict, bytes]:
         """-> (status, headers, body); raises on network failure only."""
@@ -209,12 +243,17 @@ class FilerClient:
         if range_header:
             headers["Range"] = range_header
         def attempt() -> tuple[int, dict, bytes]:
-            with connpool.request(
-                    "GET",
-                    f"http://{self.http_address}{urllib.parse.quote(path)}",
-                    headers=headers,
-                    timeout=failsafe.attempt_timeout(120)) as r:
-                return r.status, dict(r.headers), r.read()
+            try:
+                with connpool.request(
+                        "GET",
+                        f"http://{self.http_address}"
+                        f"{urllib.parse.quote(path)}",
+                        headers=headers,
+                        timeout=failsafe.attempt_timeout(120)) as r:
+                    return r.status, dict(r.headers), r.read()
+            except urllib.error.HTTPError as e:
+                _raise_if_rejected(e)
+                raise
 
         try:
             return failsafe.call(attempt, op="get_object", retry_type="s3",

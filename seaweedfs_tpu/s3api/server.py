@@ -27,7 +27,9 @@ from ..util.httpd import FrameworkHTTPServer
 import shutil
 import urllib.error
 
+from ..filer.fleet.tenant import QuotaExceededError, SlowDownError
 from ..pb import filer_pb2
+from ..stats.metrics import S3_REJECT
 from ..util.http_util import read_chunked_body
 from .auth import (
     ACTION_ADMIN,
@@ -76,9 +78,26 @@ class S3ApiServer:
         domain: str = "",
         iam_config_filer_path: str = "",
         iam_refresh_seconds: float = 3.0,
+        masters: str | list[str] = "",
     ):
         self.port = port
-        self.client = FilerClient(filer)
+        master_list = (masters.split(",") if isinstance(masters, str)
+                       else list(masters))
+        master_list = [m.strip() for m in master_list if m.strip()]
+        filer_list = [f.strip() for f in filer.split(",") if f.strip()]
+        if master_list or len(filer_list) > 1:
+            # fleet mode: stateless gateway over the sharded filer
+            # plane — membership from the master's filer registrations
+            # (or the static list), routing by consistent hash
+            from ..filer.fleet import FleetRouter
+            from ..filer.fleet.fleet_client import FleetFilerClient
+
+            self.client = FleetFilerClient(FleetRouter(
+                masters=master_list,
+                filers=filer_list if not master_list else None))
+        else:
+            self.client = FilerClient(filer_list[0] if filer_list
+                                      else filer)
         self.iam = IdentityAccessManagement(config_path, domain)
         self._httpd: ThreadingHTTPServer | None = None
         # parsed-bucket-policy cache: bucket -> (expires_at, policy|None)
@@ -334,10 +353,32 @@ class S3Handler(BaseHTTPRequestHandler):
                 self._send(e.status, _error_xml(e.code, str(e), self.path))
             except S3Error as e:
                 self._send_error(e.status, e.code, str(e))
+            except SlowDownError as e:
+                # WFQ admission on the owning filer shard said no —
+                # proper S3 throttle semantics so SDK clients back off
+                S3_REJECT.labels("slowdown").inc()
+                self._send(503, _error_xml(
+                    "SlowDown", "Please reduce your request rate.",
+                    self.path),
+                    extra={"Retry-After": str(e.retry_after)})
+            except QuotaExceededError as e:
+                S3_REJECT.labels("quota").inc()
+                self._send(403, _error_xml(
+                    "QuotaExceeded", str(e), self.path))
             except FilerUnavailable as e:
                 # never report an outage as NoSuchKey — sync clients would
                 # mirror the "deletion"
                 self._send_error(503, "ServiceUnavailable", str(e))
+            except IOError as e:
+                if str(e).startswith("quota exceeded"):
+                    # the gRPC CreateEntry path carries the rejection as
+                    # an error string (see filer grpc_handlers)
+                    S3_REJECT.labels("quota").inc()
+                    self._send(403, _error_xml(
+                        "QuotaExceeded", str(e), self.path))
+                else:
+                    self._send_error(500, "InternalError",
+                                     f"{type(e).__name__}: {e}")
             except BrokenPipeError:
                 pass
             except Exception as e:  # internal

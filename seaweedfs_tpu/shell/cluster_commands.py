@@ -50,6 +50,14 @@ def cluster_status(env: CommandEnv, args: list[str]) -> str:
         lines.append(
             f"  {name} http={f.get('httpAddress')} "
             f"lastSeen={f.get('secondsSinceLastSeen', '?')}s ago")
+    members = _live_filers(doc)
+    if members:
+        from ..filer.fleet.ring import HashRing
+
+        ring = HashRing(members)
+        lines.append(
+            f"filer ring: {len(ring)} shard(s) version={ring.version()} "
+            f"vnodes={ring.vnodes}/node (details: filer.ring)")
     snaps = doc.get("StatsSnapshots", {})
     if snaps:
         lines.append(f"stats snapshots ({len(snaps)}):")
@@ -62,4 +70,76 @@ def cluster_status(env: CommandEnv, args: list[str]) -> str:
     lines.append(
         f"federated scrape: http://{addr}/cluster/metrics ; "
         f"stitched traces: http://{addr}/cluster/traces?trace=<id>")
+    return "\n".join(lines)
+
+
+def _live_filers(status_doc: dict) -> list[str]:
+    """Ring membership exactly as a gateway would derive it from the
+    master's /cluster/status — same staleness cutoff as the router, so
+    the shell renders the ring gateways actually route on."""
+    from ..filer.fleet.router import STALE_FILER_S
+
+    members = []
+    for info in (status_doc.get("Filers") or {}).values():
+        addr = info.get("httpAddress")
+        age = float(info.get("secondsSinceLastSeen") or 0.0)
+        if addr and age < STALE_FILER_S:
+            members.append(addr)
+    return sorted(set(members))
+
+
+@register("filer.ring")
+def filer_ring(env: CommandEnv, args: list[str]) -> str:
+    """filer.ring [-json]  — fleet membership, per-shard entry counts,
+    per-tenant quota/usage (scraped from each shard's /debug/tenants)."""
+    from ..filer.fleet.ring import HashRing
+
+    addr = _master_http(env)
+    with connpool.request(
+            "GET", f"http://{addr}/cluster/status", timeout=10) as r:
+        doc = json.loads(r.read())
+    members = _live_filers(doc)
+    shards: dict[str, dict] = {}
+    for member in members:
+        try:
+            with connpool.request(
+                    "GET", f"http://{member}/debug/tenants",
+                    timeout=5) as r:
+                shards[member] = json.loads(r.read())
+        except Exception as e:  # noqa: BLE001 — a dead shard still prints
+            shards[member] = {"error": str(e)}
+    if "-json" in args:
+        ring = HashRing(members) if members else None
+        return json.dumps({
+            "members": members,
+            "version": ring.version() if ring else "",
+            "shards": shards,
+        }, indent=2, sort_keys=True)
+    if not members:
+        return "filer ring: no live filers registered with the master"
+    ring = HashRing(members)
+    lines = [f"filer ring: {len(ring)} shard(s) "
+             f"version={ring.version()} vnodes={ring.vnodes}/node"]
+    for member in members:
+        doc = shards.get(member, {})
+        if "error" in doc:
+            lines.append(f"  {member} UNREACHABLE ({doc['error']})")
+            continue
+        entries = doc.get("entries")
+        adm = doc.get("admission", {})
+        lines.append(
+            f"  {member} entries={'?' if entries is None else entries} "
+            f"inflight={adm.get('total', 0)}/{adm.get('capacity', '?')} "
+            f"store={doc.get('store', '?')}")
+        for tenant, t in sorted((doc.get("tenants") or {}).items()):
+            conf, usage = t.get("config", {}), t.get("usage", {})
+            quota_b = conf.get("quota_bytes", 0)
+            quota_o = conf.get("quota_objects", 0)
+            lines.append(
+                f"    tenant {tenant}: {usage.get('objects', 0)} obj"
+                + (f"/{quota_o}" if quota_o else "")
+                + f", {usage.get('bytes', 0)} B"
+                + (f"/{quota_b}" if quota_b else "")
+                + (f", weight={conf['weight']}" if "weight" in conf
+                   else ""))
     return "\n".join(lines)

@@ -511,6 +511,59 @@ EC_SERVICE_STAGE = REGISTRY.histogram(
 )
 
 
+# -- filer fleet: ring routing + per-tenant admission (filer/fleet/) --------
+# the sharded metadata plane: gateways route every metadata op through a
+# consistent-hash ring over master-discovered filers; each filer enforces
+# tenant quotas and WFQ admission.  route result `failover` means the
+# owner was unreachable and a ring successor served (the shard-death
+# path); sustained `failover` with no membership change = a dead filer
+# the master has not dropped yet.
+
+RING_NODES = REGISTRY.gauge(
+    "seaweedfs_filer_ring_nodes",
+    "filer shards in this process's current ring snapshot",
+)
+RING_REFRESH = REGISTRY.counter(
+    "seaweedfs_filer_ring_refresh_total",
+    "ring membership refreshes by trigger",
+    labels=("trigger",),  # ttl | forced | error
+)
+RING_ROUTE = REGISTRY.counter(
+    "seaweedfs_filer_ring_route_total",
+    "ring-routed filer operations by outcome",
+    labels=("result",),  # ok | failover | error
+)
+
+TENANT_INFLIGHT = REGISTRY.gauge(
+    "seaweedfs_tenant_inflight",
+    "admitted in-flight filer requests per tenant",
+    labels=("tenant",),
+)
+TENANT_ADMIT = REGISTRY.counter(
+    "seaweedfs_tenant_admit_total",
+    "filer admission decisions per tenant",
+    labels=("tenant", "result"),  # ok | slowdown
+)
+TENANT_USAGE_BYTES = REGISTRY.gauge(
+    "seaweedfs_tenant_usage_bytes",
+    "logical bytes stored per tenant on this filer shard",
+    labels=("tenant",),
+)
+TENANT_USAGE_OBJECTS = REGISTRY.gauge(
+    "seaweedfs_tenant_usage_objects",
+    "objects stored per tenant on this filer shard",
+    labels=("tenant",),
+)
+
+# S3 gateway rejections with proper error XML (503 SlowDown from WFQ
+# admission, 403 QuotaExceeded from tenant quotas)
+S3_REJECT = REGISTRY.counter(
+    "seaweedfs_s3_reject_total",
+    "S3 requests rejected by admission control or tenant quotas",
+    labels=("reason",),  # slowdown | quota
+)
+
+
 def serve_metrics(port: int, registry: Registry = REGISTRY,
                   host: str = "0.0.0.0") -> ThreadingHTTPServer:
     """Expose GET /metrics (Prometheus text) and GET /debug/traces (JSON)."""

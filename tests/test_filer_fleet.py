@@ -1,0 +1,570 @@
+"""Filer fleet units: consistent-hash ring properties, tenant quotas,
+WFQ admission isolation, ring-routed client failover, and the SQLite
+read/write lock split (ISSUE 7).
+
+The ring property tests pin the contracts the sharded metadata plane
+stands on: determinism across processes (two gateways must agree on
+every key's owner), bounded remap under membership churn (~K/N keys
+move when one of N nodes joins/leaves), and logarithmic lookup.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.filer.fleet.ring import HashRing, shard_key
+from seaweedfs_tpu.filer.fleet.tenant import (
+    AdmissionController,
+    QuotaExceededError,
+    SlowDownError,
+    TenantManager,
+)
+
+KEYS = [f"b/bucket-{i}" for i in range(4000)]
+NODES = [f"10.0.0.{i}:8888" for i in range(1, 6)]  # N=5
+
+
+# -- ring properties ---------------------------------------------------------
+
+
+def test_ring_lookup_matches_linear_reference():
+    """bisect lookup == the brute-force 'first vnode clockwise' scan."""
+    from seaweedfs_tpu.filer.fleet.ring import _hash64
+
+    ring = HashRing(NODES, vnodes=16)
+    points = sorted(
+        (_hash64(f"{n}#{i}"), n) for n in NODES for i in range(16))
+    for key in KEYS[:500]:
+        h = _hash64(key)
+        expect = next((n for ph, n in points if ph > h), points[0][1])
+        assert ring.lookup(key) == expect
+
+
+def test_ring_deterministic_across_processes():
+    """A gateway restarted (or a second gateway) derives the identical
+    mapping from the same membership — no process-seeded hashing."""
+    script = (
+        "from seaweedfs_tpu.filer.fleet.ring import HashRing\n"
+        f"ring = HashRing({NODES!r})\n"
+        f"print('|'.join(ring.lookup(f'b/bucket-{{i}}') "
+        "for i in range(200)))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        check=True).stdout.strip()
+    ring = HashRing(NODES)
+    assert out == "|".join(ring.lookup(f"b/bucket-{i}")
+                           for i in range(200))
+
+
+def test_ring_add_node_remaps_bounded_fraction():
+    before = HashRing(NODES)
+    after = HashRing(NODES + ["10.0.0.6:8888"])
+    moved = sum(1 for k in KEYS if before.lookup(k) != after.lookup(k))
+    expected = len(KEYS) / (len(NODES) + 1)
+    # every moved key must move TO the new node, and the count sits near
+    # K/(N+1) (generous 2x bound for vnode variance)
+    assert moved <= 2.0 * expected, (moved, expected)
+    for k in KEYS:
+        if before.lookup(k) != after.lookup(k):
+            assert after.lookup(k) == "10.0.0.6:8888"
+
+
+def test_ring_remove_node_remaps_only_its_keys():
+    before = HashRing(NODES)
+    dead = NODES[2]
+    after = HashRing([n for n in NODES if n != dead])
+    for k in KEYS:
+        owner = before.lookup(k)
+        if owner != dead:
+            # survivors keep every key they already owned
+            assert after.lookup(k) == owner, k
+        else:
+            assert after.lookup(k) != dead
+
+
+def test_ring_lookup_is_logarithmic():
+    """Doubling node count from 64 to 4096 vnodes total must not scale
+    lookup cost linearly.  Measured generously: 64x the ring points may
+    cost at most ~8x the time (true O(log) costs ~2x; a linear scan
+    would cost ~64x even on a noisy host)."""
+    small = HashRing([f"n{i}" for i in range(4)], vnodes=16)     # 64 pts
+    big = HashRing([f"n{i}" for i in range(64)], vnodes=64)      # 4096 pts
+    keys = [f"b/k{i}" for i in range(3000)]
+
+    def measure(ring):
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for k in keys:
+                ring.lookup(k)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_small, t_big = measure(small), measure(big)
+    assert t_big < 8 * t_small + 0.02, (t_small, t_big)
+
+
+def test_ring_lookup_order_covers_all_nodes():
+    ring = HashRing(NODES)
+    for k in KEYS[:50]:
+        order = ring.lookup_order(k)
+        assert order[0] == ring.lookup(k)
+        assert sorted(order) == sorted(NODES)  # distinct, complete
+
+
+def test_shard_key_mapping():
+    assert shard_key("/buckets/photos/a/b.jpg") == "b/photos"
+    assert shard_key("/buckets/photos") == "b/photos"
+    assert shard_key("buckets/photos/") == "b/photos"
+    assert shard_key("/etc/iam/identity.json") == "t/etc"
+    assert shard_key("/topics/ns/t/messages.log") == "t/topics"
+    assert shard_key("/buckets") == "/"
+    assert shard_key("/") == "/"
+
+
+def test_empty_ring_raises():
+    with pytest.raises(LookupError):
+        HashRing([]).lookup("b/x")
+
+
+# -- tenant quotas -----------------------------------------------------------
+
+
+def test_tenant_quota_objects_and_bytes():
+    tm = TenantManager()
+    tm.set_config("t1", quota_objects=2, quota_bytes=100)
+    tm.check_quota("t1", 1, 40)
+    tm.record("t1", 1, 40)
+    tm.check_quota("t1", 1, 40)
+    tm.record("t1", 1, 40)
+    with pytest.raises(QuotaExceededError):
+        tm.check_quota("t1", 1, 10)  # third object
+    with pytest.raises(QuotaExceededError):
+        tm.check_quota("t1", 0, 30)  # 80 + 30 > 100
+    # deletes always pass and free space
+    tm.record("t1", -1, -40)
+    tm.check_quota("t1", 1, 40)
+    # an unconfigured tenant is unlimited
+    tm.check_quota("t2", 1000, 1 << 40)
+
+
+def test_tenant_usage_persists_in_store_kv():
+    from seaweedfs_tpu.filer.filerstore import make_store
+
+    store = make_store("memory")
+    tm = TenantManager(store)
+    tm.set_config("t1", quota_bytes=1000)
+    tm.record("t1", 3, 300)
+    tm.close()
+    tm2 = TenantManager(store)
+    assert tm2.usage("t1") == {"objects": 3, "bytes": 300}
+    assert tm2.config("t1")["quota_bytes"] == 1000
+
+
+def test_filer_mutations_enforce_quota():
+    """End-to-end through Filer.create/update/delete: accounting follows
+    the entry lifecycle and over-quota writes raise before the store."""
+    from seaweedfs_tpu.filer.filer import Filer
+    from seaweedfs_tpu.filer.filerstore import make_store
+    from seaweedfs_tpu.pb import filer_pb2
+
+    filer = Filer(make_store("memory"))
+    tm = TenantManager(filer.store)
+    filer.tenants = tm
+    tm.set_config("b1", quota_objects=2)
+
+    def entry(name, size=10):
+        e = filer_pb2.Entry(name=name)
+        e.attributes.file_size = size
+        e.content = b"x" * size
+        return e
+
+    filer.create_entry("/buckets/b1", entry("a"))
+    filer.create_entry("/buckets/b1", entry("b"))
+    assert tm.usage("b1") == {"objects": 2, "bytes": 20}
+    with pytest.raises(QuotaExceededError):
+        filer.create_entry("/buckets/b1", entry("c"))
+    # overwrite is not a new object
+    filer.create_entry("/buckets/b1", entry("a", size=30))
+    assert tm.usage("b1") == {"objects": 2, "bytes": 40}
+    # a second tenant proceeds untouched
+    filer.create_entry("/buckets/b2", entry("x"))
+    assert tm.usage("b2") == {"objects": 1, "bytes": 10}
+    # delete frees the slot
+    filer.delete_entry("/buckets/b1", "b")
+    assert tm.usage("b1") == {"objects": 1, "bytes": 30}
+    filer.create_entry("/buckets/b1", entry("c"))
+    # recursive dir delete releases the whole subtree
+    filer.delete_entry("/buckets", "b1", is_recursive=True)
+    assert tm.usage("b1") == {"objects": 0, "bytes": 0}
+    filer.close()
+
+
+def test_untenanted_paths_skip_accounting():
+    from seaweedfs_tpu.filer.filer import Filer
+    from seaweedfs_tpu.filer.filerstore import make_store
+    from seaweedfs_tpu.pb import filer_pb2
+
+    filer = Filer(make_store("memory"))
+    tm = TenantManager(filer.store)
+    filer.tenants = tm
+    e = filer_pb2.Entry(name="identity.json")
+    e.content = b"{}"
+    filer.create_entry("/etc/iam", e)
+    assert tm.snapshot() == {}
+    filer.close()
+
+
+# -- WFQ admission -----------------------------------------------------------
+
+
+def _controller(capacity=4, queue_depth=0):
+    tm = TenantManager()
+    return tm, AdmissionController(
+        tm, capacity=capacity, queue_threshold=64,
+        queue_depth_fn=lambda: queue_depth)
+
+
+def test_admission_below_capacity_admits_everyone():
+    _, ac = _controller(capacity=4)
+    slots = [ac.admit("a"), ac.admit("a"), ac.admit("b")]
+    for s in slots:
+        s.__enter__()
+    for s in slots:
+        s.__exit__(None, None, None)
+    assert ac.snapshot()["total"] == 0
+
+
+def test_admission_saturated_clamps_heavy_tenant_not_light():
+    _, ac = _controller(capacity=4)
+    held = [ac.admit("hog") for _ in range(4)]
+    for s in held:
+        s.__enter__()
+    # saturated: the hog is far past its share -> SlowDown
+    with pytest.raises(SlowDownError):
+        ac.try_enter("hog")
+    # a light tenant still has a reserved share
+    with ac.admit("light"):
+        pass
+    for s in held:
+        s.__exit__(None, None, None)
+
+
+def test_admission_weights_shift_fair_share():
+    tm, ac = _controller(capacity=8)
+    tm.set_config("gold", weight=3.0)
+    tm.set_config("bronze", weight=1.0)
+    held = [ac.admit("bronze") for _ in range(8)]
+    for s in held:
+        s.__enter__()
+    # saturated; gold's share = 8 * 3/4 = 6 -> admit several
+    admitted = []
+    for _ in range(3):
+        s = ac.admit("gold")
+        s.__enter__()
+        admitted.append(s)
+    for s in admitted + held:
+        s.__exit__(None, None, None)
+
+
+def test_admission_queue_depth_gauge_triggers_saturation():
+    depth = [0]
+    tm = TenantManager()
+    ac = AdmissionController(tm, capacity=100, queue_threshold=5,
+                             queue_depth_fn=lambda: depth[0])
+    held = [ac.admit("a") for _ in range(50)]
+    for s in held:
+        s.__enter__()  # far below capacity: all admitted
+    depth[0] = 10  # the PR 5 saturation signal fires
+    with pytest.raises(SlowDownError):
+        ac.try_enter("a")  # growth frozen at current inflight
+    # a light tenant still gets its share of what's in flight
+    with ac.admit("b"):
+        pass
+    for s in held:
+        s.__exit__(None, None, None)
+    depth[0] = 0
+    with ac.admit("a"):  # saturation cleared -> admitted again
+        pass
+
+
+def test_admission_untenanted_exempt():
+    _, ac = _controller(capacity=1)
+    with ac.admit("t"):
+        # capacity gone; untenanted config reads still pass
+        with ac.admit(""):
+            pass
+
+
+def test_wfq_saturating_tenant_cannot_move_victim_p99():
+    """The SLO-isolation property: tenant A floods a capacity-8 filer
+    from 16 threads; tenant B sends sequential requests.  B must see
+    ZERO rejections and a p99 admission latency within the SLO bound
+    (admission is rejection-based — nothing queues, so B pays lock +
+    GIL scheduling cost only), while A is actively being rejected."""
+    tm, ac = _controller(capacity=8)
+    stop = threading.Event()
+    a_rejects = [0]
+
+    def flood():
+        while not stop.is_set():
+            try:
+                with ac.admit("A"):
+                    time.sleep(0.002)
+            except SlowDownError:
+                a_rejects[0] += 1
+
+    threads = [threading.Thread(target=flood, daemon=True)
+               for _ in range(16)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)  # let A saturate
+    latencies = []
+    b_rejects = 0
+    for _ in range(200):
+        t0 = time.perf_counter()
+        try:
+            with ac.admit("B"):
+                pass
+        except SlowDownError:
+            b_rejects += 1
+        latencies.append(time.perf_counter() - t0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    latencies.sort()
+    p99 = latencies[int(len(latencies) * 0.99)]
+    assert b_rejects == 0, f"victim tenant rejected {b_rejects}x"
+    # generous for a noisy shared host: the property is "bounded by
+    # scheduling noise, not by the flood" — an unfair controller would
+    # reject B outright or queue it behind A's 2ms holds
+    assert p99 < 0.050, f"victim p99 {p99 * 1e3:.2f}ms past the SLO bound"
+    assert a_rejects[0] > 0, "the flood was never actually clamped"
+
+
+# -- fleet client routing ----------------------------------------------------
+
+
+class _FakeFilerClient:
+    """Stands in for s3api FilerClient: records calls, optionally dead."""
+
+    def __init__(self, addr, registry, dead=False):
+        self.addr = addr
+        self.registry = registry
+        self.dead = dead
+        self.entries: dict[str, list] = {}
+
+    def _touch(self, op):
+        self.registry.append((self.addr, op))
+        if self.dead:
+            from seaweedfs_tpu.s3api.filer_client import FilerUnavailable
+
+            raise FilerUnavailable(f"{self.addr} is down")
+
+    def find_entry(self, directory, name):
+        self._touch("find")
+        return None
+
+    def list_entries(self, directory, prefix="", start_from="",
+                     inclusive=False, limit=1024):
+        self._touch("list")
+        return list(self.entries.get(directory, []))
+
+    def create_entry(self, directory, entry):
+        self._touch("create")
+
+
+def _fleet(nodes, dead=()):
+    from seaweedfs_tpu.filer.fleet import FleetFilerClient, FleetRouter
+
+    router = FleetRouter(filers=nodes)
+    client = FleetFilerClient(router)
+    registry: list = []
+    for n in nodes:
+        client._clients[n] = _FakeFilerClient(n, registry, dead=n in dead)
+    return client, registry
+
+
+def test_fleet_client_routes_to_ring_owner():
+    nodes = [f"127.0.0.1:{p}" for p in (7001, 7002, 7003)]
+    client, registry = _fleet(nodes)
+    ring = client.router.ring()
+    client.find_entry("/buckets/photos", "x.jpg")
+    assert registry == [(ring.lookup("b/photos"), "find")]
+
+
+def test_fleet_client_fails_over_in_ring_order():
+    nodes = [f"127.0.0.1:{p}" for p in (7001, 7002, 7003)]
+    ring_owner = None
+    from seaweedfs_tpu.filer.fleet.ring import HashRing
+
+    ring_owner = HashRing(nodes).lookup("b/photos")
+    client, registry = _fleet(nodes, dead={ring_owner})
+    client.find_entry("/buckets/photos", "x.jpg")
+    order = HashRing(nodes).lookup_order("b/photos")
+    assert [a for a, _ in registry] == order[:2]  # owner tried, then next
+
+
+def test_fleet_client_all_dead_raises_unavailable():
+    from seaweedfs_tpu.s3api.filer_client import FilerUnavailable
+
+    nodes = [f"127.0.0.1:{p}" for p in (7001, 7002, 7003)]
+    client, _ = _fleet(nodes, dead=set(nodes))
+    with pytest.raises(FilerUnavailable):
+        client.find_entry("/buckets/photos", "x.jpg")
+
+
+def test_fleet_client_bucket_listing_fans_out_and_merges():
+    from seaweedfs_tpu.pb import filer_pb2
+
+    nodes = [f"127.0.0.1:{p}" for p in (7001, 7002, 7003)]
+    client, registry = _fleet(nodes)
+    # one bucket visible on one shard only (replication lag), another on
+    # all three (converged): the merged view holds both, deduped
+    lagged = filer_pb2.Entry(name="fresh-bucket", is_directory=True)
+    common = filer_pb2.Entry(name="old-bucket", is_directory=True)
+    for n in nodes:
+        client._clients[n].entries["/buckets"] = [common]
+    client._clients[nodes[1]].entries["/buckets"].append(lagged)
+    names = [e.name for e in client.list_entries("/buckets")]
+    assert names == ["fresh-bucket", "old-bucket"]
+    assert {a for a, _ in registry} == set(nodes)  # true fan-out
+
+
+def test_fleet_client_non_transport_errors_do_not_fail_over():
+    nodes = [f"127.0.0.1:{p}" for p in (7001, 7002)]
+    client, registry = _fleet(nodes)
+
+    class Boom(_FakeFilerClient):
+        def find_entry(self, directory, name):
+            self._touch("find")
+            raise IOError("quota exceeded for tenant 'photos': full")
+
+    owner = client.router.ring().lookup("b/photos")
+    client._clients[owner] = Boom(owner, registry)
+    with pytest.raises(IOError, match="quota exceeded"):
+        client.find_entry("/buckets/photos", "x")
+    assert len(registry) == 1  # no second shard saw the request
+
+
+# -- sqlite store: reads do not stall behind the write lock ------------------
+
+
+def test_sqlite_reads_bypass_write_lock(tmp_path):
+    from seaweedfs_tpu.filer.filerstore import make_store
+    from seaweedfs_tpu.pb import filer_pb2
+
+    store = make_store("sqlite", path=str(tmp_path / "filer.db"))
+    for i in range(20):
+        e = filer_pb2.Entry(name=f"f{i}")
+        store.insert_entry("/d", e)
+    assert store.count_entries() == 20
+
+    results: dict = {}
+    with store._lock:  # a writer mid-commit holds this
+        def read():
+            results["find"] = store.find_entry("/d", "f3")
+            results["list"] = [e.name for e in store.list_entries("/d")]
+            results["kv"] = store.kv_get(b"nope")
+
+        t = threading.Thread(target=read, daemon=True)
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive(), "read stalled behind the write lock"
+    assert results["find"].name == "f3"
+    assert len(results["list"]) == 20
+    store.close()
+
+
+def test_sqlite_read_conn_sees_committed_writes(tmp_path):
+    from seaweedfs_tpu.filer.filerstore import make_store
+    from seaweedfs_tpu.pb import filer_pb2
+
+    store = make_store("sqlite", path=str(tmp_path / "filer.db"))
+    store.insert_entry("/d", filer_pb2.Entry(name="a"))
+    assert store.find_entry("/d", "a") is not None  # read conn, write conn
+    store.kv_put(b"k", b"v")
+    assert store.kv_get(b"k") == b"v"
+    store.delete_entry("/d", "a")
+    assert store.find_entry("/d", "a") is None
+    store.close()
+
+
+# -- router discovery parsing ------------------------------------------------
+
+
+def test_router_static_mode_is_stable():
+    from seaweedfs_tpu.filer.fleet import FleetRouter
+
+    r = FleetRouter(filers=["b:2", "a:1"])
+    assert r.ring().nodes == ["a:1", "b:2"]
+    assert r.candidates("/buckets/x/k")[0] == r.owner("/buckets/x/k")
+
+
+def test_router_discovery_parses_cluster_status(monkeypatch):
+    from seaweedfs_tpu.filer.fleet import router as router_mod
+
+    doc = {"Filers": {
+        "filer@127.0.0.1:8881": {"httpAddress": "127.0.0.1:8881",
+                                 "secondsSinceLastSeen": 1.0},
+        "filer@127.0.0.1:8882": {"httpAddress": "127.0.0.1:8882",
+                                 "secondsSinceLastSeen": 2.0},
+        "filer@127.0.0.1:8883": {"httpAddress": "127.0.0.1:8883",
+                                 "secondsSinceLastSeen": 999.0},  # stale
+    }}
+
+    class _Resp:
+        status = 200
+
+        def read(self):
+            return json.dumps(doc).encode()
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+    monkeypatch.setattr(router_mod.connpool, "request",
+                        lambda *a, **k: _Resp())
+    r = router_mod.FleetRouter(masters=["127.0.0.1:9333"])
+    assert r.ring().nodes == ["127.0.0.1:8881", "127.0.0.1:8882"]
+
+
+# -- fault points ------------------------------------------------------------
+
+
+def test_filer_store_insert_faultpoint_fires():
+    """Arming `filer.store.insert` models a shard store dying mid-write:
+    the mutation fails BEFORE the store insert, nothing is recorded, and
+    tenant usage stays untouched (FAULTS.md shard-death fault point)."""
+    from seaweedfs_tpu.filer.filer import Filer
+    from seaweedfs_tpu.filer.filerstore import make_store
+    from seaweedfs_tpu.pb import filer_pb2
+    from seaweedfs_tpu.util import faultpoint
+
+    filer = Filer(make_store("memory"))
+    tm = TenantManager(filer.store)
+    filer.tenants = tm
+    faultpoint.set_fault("filer.store.insert", "error", count=1,
+                         match="/buckets/fp-b/")
+    try:
+        e = filer_pb2.Entry(name="x")
+        e.content = b"data"
+        with pytest.raises(faultpoint.FaultInjected):
+            filer.create_entry("/buckets/fp-b", e)
+        assert filer.store.find_entry("/buckets/fp-b", "x") is None
+        assert tm.usage("fp-b") == {"objects": 0, "bytes": 0}
+        # the armed count is spent: the retry lands
+        filer.create_entry("/buckets/fp-b", e)
+        assert tm.usage("fp-b") == {"objects": 1, "bytes": 4}
+    finally:
+        faultpoint.clear_fault("all")
+        filer.close()

@@ -1,0 +1,255 @@
+"""Multi-filer fleet acceptance (ISSUE 7): real subprocesses through the
+CLI — master + volume server + THREE peered filers + a stateless S3
+gateway in master-discovery mode.
+
+Asserts the tentpole contracts:
+
+* the gateway routes every bucket to its ring owner and serves reads
+  and writes across all three shards;
+* restarting the gateway mid-test changes nothing — it holds no routing
+  state beyond the master-discovered ring snapshot;
+* SIGKILL one filer: NO namespace is lost (its buckets re-route to the
+  ring successor, which holds the replicated metadata), keys owned by
+  surviving shards see ZERO 5xx throughout, and writes keep working —
+  including new writes into the dead shard's buckets.
+
+Runs as its own bounded CI step (see .github/workflows/ci.yml),
+mirroring the PR 5 cluster-observability job; marked slow so tier-1
+stays fast.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from helpers import free_port
+
+from seaweedfs_tpu.filer.fleet.ring import HashRing
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _spawn(args, cwd):
+    return subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", *args],
+        cwd=cwd, env=_env(),
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+
+
+def _req(method, url, data=None, headers=None, timeout=15):
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _wait_http(url, deadline_s=30):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=2) as r:
+                return r.status
+        except (urllib.error.URLError, ConnectionError, OSError):
+            time.sleep(0.3)
+    raise TimeoutError(url)
+
+
+def _spawn_gateway(s3port, mport, cwd):
+    p = _spawn(["s3", "-port", str(s3port),
+                "-master", f"127.0.0.1:{mport}"], cwd)
+    _wait_http(f"http://127.0.0.1:{s3port}/")
+    return p
+
+
+def test_filer_fleet_shard_death_and_stateless_gateway(tmp_path):
+    mport = free_port()
+    vport = free_port()
+    fports = [free_port() for _ in range(3)]
+    s3port = free_port()
+    filer_addrs = [f"127.0.0.1:{p}" for p in fports]
+    peers = ",".join(filer_addrs)
+    (tmp_path / "vol").mkdir()
+    procs = {}
+    try:
+        procs["master"] = _spawn(["master", "-port", str(mport)],
+                                 str(tmp_path))
+        _wait_http(f"http://127.0.0.1:{mport}/cluster/healthz")
+        # every bucket is its own collection (volume growth per bucket),
+        # so the slot budget must cover 6+ buckets x 3 grown volumes
+        procs["volume"] = _spawn(
+            ["volume", "-dir", str(tmp_path / "vol"), "-port", str(vport),
+             "-mserver", f"127.0.0.1:{mport}", "-ec.codec", "cpu",
+             "-max", "500"],
+            str(tmp_path))
+        for i, port in enumerate(fports):
+            procs[f"filer{i}"] = _spawn(
+                ["filer", "-master", f"127.0.0.1:{mport}",
+                 "-port", str(port),
+                 "-store", str(tmp_path / f"filer{i}.db"),
+                 "-peers", peers],
+                str(tmp_path))
+        for port in fports:
+            _wait_http(f"http://127.0.0.1:{port}/")
+
+        # master sees the volume server + all three filer registrations
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                status = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{mport}/cluster/status",
+                    timeout=5).read())
+                if (len(status.get("DataNodes", {})) >= 1
+                        and len(status.get("Filers", {})) >= 3):
+                    break
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.3)
+        else:
+            raise AssertionError("fleet never fully registered")
+
+        procs["s3"] = _spawn_gateway(s3port, mport, str(tmp_path))
+
+        # the ring is deterministic: compute shard ownership exactly as
+        # the gateway does, and pick buckets until every shard owns >= 2
+        ring = HashRing(sorted(filer_addrs))
+        by_owner = {a: [] for a in filer_addrs}
+        buckets = []
+        for i in range(200):
+            name = f"fleet-b{i}"
+            owner = ring.lookup(f"b/{name}")
+            if len(by_owner[owner]) < 2:
+                by_owner[owner].append(name)
+                buckets.append(name)
+            if all(len(v) >= 2 for v in by_owner.values()):
+                break
+        assert all(len(v) >= 2 for v in by_owner.values()), by_owner
+
+        # -- writes + reads across every shard ----------------------------
+        payload = {b: f"payload-of-{b}".encode() * 64 for b in buckets}
+        for b in buckets:
+            code, _ = _req("PUT", f"http://127.0.0.1:{s3port}/{b}")
+            assert code == 200, (b, code)
+            code, _ = _req("PUT", f"http://127.0.0.1:{s3port}/{b}/obj1",
+                           data=payload[b])
+            assert code == 200, (b, code)
+        for b in buckets:
+            code, body = _req("GET", f"http://127.0.0.1:{s3port}/{b}/obj1")
+            assert code == 200 and body == payload[b], b
+
+        # list-buckets merges across shards
+        code, body = _req("GET", f"http://127.0.0.1:{s3port}/")
+        assert code == 200
+        for b in buckets:
+            assert b.encode() in body
+
+        # -- stateless gateway: restart it mid-test, behavior identical ---
+        procs["s3"].terminate()
+        procs["s3"].wait(timeout=10)
+        procs["s3"] = _spawn_gateway(s3port, mport, str(tmp_path))
+        for b in buckets:
+            code, body = _req("GET", f"http://127.0.0.1:{s3port}/{b}/obj1")
+            assert code == 200 and body == payload[b], (
+                f"post-restart read of {b} failed: {code}")
+
+        # -- wait until every filer holds every bucket's metadata ---------
+        # (peer replication: each filer replays the others' mutation
+        # streams into its own store)
+        deadline = time.time() + 30
+        replicated = False
+        while time.time() < deadline and not replicated:
+            replicated = True
+            for addr in filer_addrs:
+                for b in buckets:
+                    code, _ = _req(
+                        "GET", f"http://{addr}/buckets/{b}/obj1",
+                        timeout=5)
+                    if code != 200:
+                        replicated = False
+                        break
+                if not replicated:
+                    break
+            if not replicated:
+                time.sleep(0.5)
+        assert replicated, "peer replication never converged"
+
+        # -- shell: filer.ring renders membership + shard entry counts ----
+        shell = subprocess.run(
+            [sys.executable, "-m", "seaweedfs_tpu", "shell",
+             "-master", f"127.0.0.1:{mport}", "-c", "filer.ring"],
+            capture_output=True, text=True, env=_env(),
+            cwd=str(tmp_path), timeout=30)
+        assert "filer ring: 3 shard(s)" in shell.stdout, shell.stdout
+        for addr in filer_addrs:
+            assert f"{addr} entries=" in shell.stdout, shell.stdout
+
+        # -- SIGKILL one shard --------------------------------------------
+        victim_idx = 0
+        victim_addr = filer_addrs[victim_idx]
+        dead_buckets = by_owner[victim_addr]
+        surviving = [b for b in buckets if b not in dead_buckets]
+        procs.pop(f"filer{victim_idx}").kill()
+
+        # keys owned by SURVIVING shards: zero 5xx, polled throughout
+        # the recovery window
+        recover_deadline = time.time() + 25
+        dead_ok = False
+        while time.time() < recover_deadline:
+            for b in surviving:
+                code, body = _req(
+                    "GET", f"http://127.0.0.1:{s3port}/{b}/obj1")
+                assert code < 500, (
+                    f"surviving-shard key {b} returned {code} "
+                    "during failover")
+                assert code == 200 and body == payload[b], (b, code)
+            if not dead_ok:
+                # the dead shard's namespace must re-route and recover
+                codes = [
+                    _req("GET",
+                         f"http://127.0.0.1:{s3port}/{b}/obj1")[0]
+                    for b in dead_buckets]
+                dead_ok = all(c == 200 for c in codes)
+            if dead_ok:
+                break
+            time.sleep(0.5)
+        assert dead_ok, "dead shard's namespace was lost"
+        for b in dead_buckets:
+            code, body = _req("GET", f"http://127.0.0.1:{s3port}/{b}/obj1")
+            assert code == 200 and body == payload[b], (
+                f"no namespace may be lost: {b} -> {code}")
+
+        # -- writes keep working, including INTO the dead shard -----------
+        for b in (surviving[0], dead_buckets[0]):
+            code, _ = _req("PUT",
+                           f"http://127.0.0.1:{s3port}/{b}/post-kill",
+                           data=b"written after shard death")
+            assert code == 200, (b, code)
+            code, body = _req(
+                "GET", f"http://127.0.0.1:{s3port}/{b}/post-kill")
+            assert code == 200 and body == b"written after shard death", b
+    finally:
+        for p in procs.values():
+            p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()

@@ -41,6 +41,9 @@ def _req(method, url, data=None, headers=None):
         return e.code, dict(e.headers), e.read()
 
 
+_STACK: dict = {}
+
+
 @pytest.fixture(scope="module")
 def s3(tmp_path_factory):
     from seaweedfs_tpu.filer.server import FilerServer
@@ -71,6 +74,9 @@ def s3(tmp_path_factory):
     filer.start()
     gw = S3ApiServer(filer=f"127.0.0.1:{filer.port}", port=_free_port())
     gw.start()
+    # the admission/quota rejection tests reach into the (in-process)
+    # filer's tenant plane to arm deterministic rejections
+    _STACK["filer"] = filer
     yield f"http://127.0.0.1:{gw.port}"
     gw.stop()
     filer.stop()
@@ -725,3 +731,68 @@ def test_directory_marker_lifecycle(s3):
     assert code == 204
     code, _, body = _req("GET", f"{s3}/mk-b/folder/kid.txt")
     assert (code, body) == (200, b"k")
+
+
+# ---------------------------------------------------------------------------
+# Tenant admission + quota rejections (ISSUE 7: fleet error semantics)
+# ---------------------------------------------------------------------------
+
+
+def _reject_count(reason):
+    from seaweedfs_tpu.stats.metrics import S3_REJECT
+
+    return S3_REJECT.labels(reason).value
+
+
+def test_quota_exceeded_returns_403_error_xml(s3):
+    """An over-quota tenant gets well-formed 403 QuotaExceeded XML and
+    the reject counter moves; a second tenant proceeds unthrottled."""
+    _mk_bucket(s3, "quota-b")
+    _mk_bucket(s3, "quota-free")
+    _put(s3, "quota-b", "one", b"fits")
+    _STACK["filer"].tenants.set_config("quota-b", quota_objects=1)
+    before = _reject_count("quota")
+    code, _, body = _req("PUT", f"{s3}/quota-b/two", b"over")
+    assert code == 403, (code, body)
+    root = _xml(body)
+    assert root.tag == "Error"
+    assert _text(root, "Code") == "QuotaExceeded"
+    assert _text(root, "Resource") == "/quota-b/two"
+    assert _reject_count("quota") == before + 1
+    # the other tenant's writes proceed
+    _put(s3, "quota-free", "anything", b"ok")
+    # overwrite of the existing object is NOT a new object -> allowed
+    _put(s3, "quota-b", "one", b"rewritten")
+    # freeing the slot re-admits writes
+    assert _req("DELETE", f"{s3}/quota-b/one")[0] == 204
+    _put(s3, "quota-b", "two", b"now fits")
+    _STACK["filer"].tenants.set_config("quota-b", quota_objects=0)
+
+
+def test_admission_slowdown_returns_503_with_retry_after(s3):
+    """WFQ admission rejections surface as 503 SlowDown XML with a
+    Retry-After header (the S3 throttle contract SDKs back off on)."""
+    _mk_bucket(s3, "slow-b")
+    _put(s3, "slow-b", "obj", b"seed")
+    filer = _STACK["filer"]
+    old_capacity = filer.admission.capacity
+    filer.admission.capacity = 1
+    slot = filer.admission.admit("slow-b")
+    slot.__enter__()  # the tenant now holds the whole capacity
+    try:
+        before = _reject_count("slowdown")
+        code, headers, body = _req("GET", f"{s3}/slow-b/obj")
+        assert code == 503, (code, body)
+        root = _xml(body)
+        assert _text(root, "Code") == "SlowDown"
+        assert int(headers.get("Retry-After", "0")) >= 1
+        assert _reject_count("slowdown") == before + 1
+        # an untouched tenant keeps its reserved share
+        _mk_bucket(s3, "slow-free")
+        _put(s3, "slow-free", "k", b"independent tenant")
+        assert _req("GET", f"{s3}/slow-free/k")[0] == 200
+    finally:
+        slot.__exit__(None, None, None)
+        filer.admission.capacity = old_capacity
+    # capacity released: the throttled tenant is served again
+    assert _req("GET", f"{s3}/slow-b/obj")[0] == 200
