@@ -86,6 +86,11 @@ class MasterGrpcService:
                     # can't reach it
                     self.master.record_stats_snapshot(
                         node.id, "volume", hb.stats)
+                if hb.scrub_findings:
+                    # confirmed corruption findings from the node's scrub
+                    # daemon: queue them for the maintenance repair pass
+                    self.master.record_scrub_findings(
+                        node.id, hb.scrub_findings)
                 if deleted_vids:
                     # vids gone from this node must leave the writable
                     # sets too — rebuild_layouts only ever registers, so

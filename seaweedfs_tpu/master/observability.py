@@ -224,4 +224,10 @@ def cluster_status(master) -> dict:
         }
         for instance, snap in master.stats_snapshots_snapshot().items()
     }
+    # self-healing plane: per-volume health (under-replication + open
+    # scrub findings) so `cluster.status -json` answers "is anything
+    # silently rotten and is repair keeping up"
+    master.update_replication_health()
+    out["VolumeHealth"] = master.volume_health_snapshot()
+    out["ScrubFindings"] = len(master.scrub_findings_snapshot())
     return out

@@ -35,6 +35,11 @@ from .sequence import make_sequencer
 GRPC_PORT_OFFSET = 10000
 
 
+class _Unrepairable(Exception):
+    """A scrub finding with no repair path (no healthy replica, node
+    gone): parked as `unrepairable` instead of burning retry attempts."""
+
+
 class MasterServer:
     def __init__(
         self,
@@ -88,6 +93,14 @@ class MasterServer:
         self._clients_lock = threading.Lock()
         self.stats_snapshots: dict[str, dict] = {}
         self._snapshots_lock = threading.Lock()
+        # self-healing plane: corruption findings from volume-server scrub
+        # daemons (heartbeat field 18), keyed for idempotent re-reports;
+        # the maintenance loop's repair pass drains them
+        self.scrub_findings: dict[tuple, dict] = {}
+        self._scrub_lock = threading.Lock()
+        # serializes repair passes (maintenance loop vs /vol/repair): a
+        # concurrent pass would VolumeUnmount mid-VolumeCopy
+        self._repair_mutex = threading.Lock()
         from ..util.executors import MeteredThreadPoolExecutor
 
         self.federation_pool = MeteredThreadPoolExecutor(
@@ -491,6 +504,13 @@ class MasterServer:
 
         while not self._stop.wait(self.maintenance_interval):
             try:
+                # self-healing first: corruption findings queued by scrub
+                # daemons turn into re-copies/rebuilds before the heavier
+                # encode/balance script runs
+                self.repair_pass()
+            except Exception as e:
+                glog.warning("repair pass failed: %s", e)
+            try:
                 env = CommandEnv(f"{self.ip}:{self.grpc_port}")
                 for line in run_maintenance(env,
                                             script=self.maintenance_script):
@@ -498,6 +518,209 @@ class MasterServer:
                         glog.info("maintenance: %s", line)
             except Exception as e:  # the loop must survive, not go mute
                 glog.warning("maintenance run failed: %s", e)
+
+    # -- self-healing: scrub finding ingest + repair orchestration --------
+
+    MAX_SCRUB_FINDINGS = 1024
+    MAX_REPAIR_ATTEMPTS = 3
+
+    def record_scrub_findings(self, node_id: str, findings) -> None:
+        """Heartbeat ingest: keep findings keyed so a node re-reporting
+        persistent corruption updates in place instead of piling up."""
+        with self._scrub_lock:
+            for f in findings:
+                key = (node_id, f.volume_id, f.kind, f.shard_id, f.needle_id)
+                cur = self.scrub_findings.get(key)
+                if cur is not None:
+                    cur["last_reported_ms"] = f.detected_at_ms
+                    continue
+                if len(self.scrub_findings) >= self.MAX_SCRUB_FINDINGS:
+                    # one rotten disk can report thousands of needles;
+                    # the repair (one volume re-copy) fixes them all, so
+                    # dropping the tail loses nothing actionable
+                    continue
+                self.scrub_findings[key] = {
+                    "node": node_id, "volume_id": f.volume_id,
+                    "kind": f.kind, "shard_id": f.shard_id,
+                    "needle_id": f.needle_id, "detail": f.detail,
+                    "detected_at_ms": f.detected_at_ms,
+                    "last_reported_ms": f.detected_at_ms,
+                    "attempts": 0, "status": "pending",
+                }
+
+    def scrub_findings_snapshot(self) -> list[dict]:
+        with self._scrub_lock:
+            return [dict(v) for v in self.scrub_findings.values()]
+
+    def repair_pass(self) -> dict:
+        """Turn queued scrub findings into repairs: a corrupt replica is
+        re-copied from a healthy peer (VolumeCopy), a corrupt EC shard is
+        deleted and rebuilt in place (VolumeEcShardsRebuild) then
+        remounted.  Also refreshes the under-replication gauge."""
+        summary = {"repaired": [], "failed": [], "skipped": []}
+        if not self.is_leader():
+            return summary
+        if not self._repair_mutex.acquire(blocking=False):
+            return summary  # a pass is already running (loop vs /vol/repair)
+        try:
+            return self._repair_pass_locked(summary)
+        finally:
+            self._repair_mutex.release()
+
+    def _repair_pass_locked(self, summary: dict) -> dict:
+        from ..stats.metrics import SCRUB_REPAIRS
+
+        with self._scrub_lock:
+            work = [(k, dict(v)) for k, v in self.scrub_findings.items()
+                    if v["status"] in ("pending", "failed")
+                    and v["attempts"] < self.MAX_REPAIR_ATTEMPTS]
+        for key, f in work:
+            with self._scrub_lock:
+                if key not in self.scrub_findings:
+                    # an earlier repair in THIS pass already healed the
+                    # whole volume and dropped its sibling findings
+                    continue
+            kind = f["kind"]
+            repair_kind = "ec_shard" if kind == "ec_shard" else "replica"
+            try:
+                if kind == "ec_shard":
+                    self._repair_ec_shard(f)
+                else:
+                    # replica + index findings both heal by re-copying the
+                    # whole volume from a healthy peer
+                    self._repair_replica(f)
+            except _Unrepairable as e:
+                with self._scrub_lock:
+                    if key in self.scrub_findings:
+                        self.scrub_findings[key]["status"] = "unrepairable"
+                        self.scrub_findings[key]["error"] = str(e)
+                summary["skipped"].append(key)
+                continue
+            except Exception as e:  # noqa: BLE001 — per-finding isolation
+                SCRUB_REPAIRS.labels(repair_kind, "error").inc()
+                with self._scrub_lock:
+                    if key in self.scrub_findings:
+                        self.scrub_findings[key]["attempts"] += 1
+                        self.scrub_findings[key]["status"] = "failed"
+                        self.scrub_findings[key]["error"] = str(e)
+                glog.warning("repair of %s failed: %s", key, e)
+                summary["failed"].append(key)
+                continue
+            SCRUB_REPAIRS.labels(repair_kind, "ok").inc()
+            with self._scrub_lock:
+                if kind == "ec_shard":
+                    # the rebuild healed exactly this shard
+                    drop = [k for k, v in self.scrub_findings.items()
+                            if v["node"] == f["node"]
+                            and v["volume_id"] == f["volume_id"]
+                            and v["kind"] == "ec_shard"
+                            and v["shard_id"] == f["shard_id"]]
+                else:
+                    # one volume re-copy heals EVERY queued needle/index
+                    # finding on that (node, volume)
+                    drop = [k for k, v in self.scrub_findings.items()
+                            if v["node"] == f["node"]
+                            and v["volume_id"] == f["volume_id"]
+                            and v["kind"] != "ec_shard"]
+                for k in drop:
+                    del self.scrub_findings[k]
+            glog.info("repaired %s finding on %s vol=%d",
+                      kind, f["node"], f["volume_id"])
+            summary["repaired"].append(key)
+        self.update_replication_health()
+        return summary
+
+    def _repair_replica(self, f: dict) -> None:
+        """Re-copy a corrupted replica from a healthy peer via the
+        existing VolumeCopy pull protocol."""
+        vid = f["volume_id"]
+        with self.topo.lock:
+            corrupt = self.topo.nodes.get(f["node"])
+            holders = [n for n in self.topo.nodes.values()
+                       if vid in n.volumes]
+            collection = ""
+            for n in holders:
+                collection = n.volumes[vid].collection
+                break
+        if corrupt is None:
+            raise _Unrepairable(f"node {f['node']} left the cluster")
+        healthy = [n for n in holders if n.id != corrupt.id]
+        if not healthy:
+            raise _Unrepairable(
+                f"volume {vid}: no healthy replica to copy from")
+        source = healthy[0]
+        stub = rpclib.volume_server_stub(corrupt.grpc_address, timeout=600)
+        try:
+            stub.VolumeUnmount(vs.VolumeUnmountRequest(volume_id=vid))
+        except grpc.RpcError:
+            pass  # already unmounted (or racing) — the copy re-mounts
+        stub.VolumeCopy(vs.VolumeCopyRequest(
+            volume_id=vid, collection=collection,
+            source_data_node=source.grpc_address,
+        ))
+
+    def _repair_ec_shard(self, f: dict) -> None:
+        """Rebuild a corrupted EC shard in place: drop the rotten .ecNN,
+        decode it back from the surviving shards, remount."""
+        vid, sid = f["volume_id"], f["shard_id"]
+        with self.topo.lock:
+            node = self.topo.nodes.get(f["node"])
+            collection = (node.ec_collections.get(vid, "")
+                          if node is not None else "")
+        if node is None:
+            raise _Unrepairable(f"node {f['node']} left the cluster")
+        stub = rpclib.volume_server_stub(node.grpc_address, timeout=600)
+        stub.VolumeEcShardsDelete(vs.VolumeEcShardsDeleteRequest(
+            volume_id=vid, collection=collection, shard_ids=[sid]))
+        rebuilt = stub.VolumeEcShardsRebuild(vs.VolumeEcShardsRebuildRequest(
+            volume_id=vid, collection=collection))
+        if sid not in list(rebuilt.rebuilt_shard_ids):
+            raise IOError(
+                f"shard {sid} not rebuilt (got {list(rebuilt.rebuilt_shard_ids)})")
+        stub.VolumeEcShardsMount(vs.VolumeEcShardsMountRequest(
+            volume_id=vid, collection=collection, shard_ids=[sid]))
+
+    def update_replication_health(self) -> dict:
+        """Per-volume replica health + the cluster under-replication
+        gauge (seaweedfs_volume_underreplicated)."""
+        from ..stats.metrics import VOLUME_UNDERREPLICATED
+
+        health: dict[str, dict] = {}
+        under = 0
+        with self.topo.lock:
+            holders: dict[int, list] = {}
+            desired: dict[int, int] = {}
+            for n in self.topo.nodes.values():
+                for vid, v in n.volumes.items():
+                    holders.setdefault(vid, []).append(n.id)
+                    desired[vid] = ReplicaPlacement.from_byte(
+                        v.replica_placement).copy_count()
+        for vid, locs in holders.items():
+            want = max(desired.get(vid, 1), 1)
+            if len(locs) < want:
+                under += 1
+                health[str(vid)] = {
+                    "replicas": len(locs), "desired": want,
+                    "underReplicated": True, "locations": sorted(locs),
+                }
+        VOLUME_UNDERREPLICATED.set(under)
+        self._volume_health = health
+        return health
+
+    def volume_health_snapshot(self) -> dict:
+        """The /cluster/status health block: under-replicated volumes +
+        outstanding scrub findings grouped per volume."""
+        health = dict(getattr(self, "_volume_health", {}))
+        for f in self.scrub_findings_snapshot():
+            entry = health.setdefault(str(f["volume_id"]), {})
+            entry.setdefault("findings", []).append({
+                "node": f["node"], "kind": f["kind"],
+                "shardId": f["shard_id"],
+                "needleId": f"{f['needle_id']:x}",
+                "status": f["status"], "attempts": f["attempts"],
+                "detail": f.get("detail", ""),
+            })
+        return health
 
     # -- admin lock -------------------------------------------------------
 
@@ -598,6 +821,7 @@ _MASTER_OPS = {
     "/cluster/metrics": "cluster.metrics",
     "/cluster/traces": "cluster.traces",
     "/vol/vacuum": "vol.vacuum", "/vol/grow": "vol.grow",
+    "/vol/repair": "vol.repair",
     "/vol/status": "vol.status", "/col/delete": "col.delete",
     "/submit": "submit", "/debug/profile": "debug.profile",
     "/debug/traces": "debug.traces", "/metrics": "metrics",
@@ -868,6 +1092,18 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
                 float(qget("garbageThreshold", "0") or 0) or None
             )
             return self._json(200, {"vacuumed": vacuumed})
+        if u.path == "/vol/repair":
+            # on-demand repair pass over queued scrub findings (the
+            # maintenance loop runs the same pass on its interval)
+            if not self.master.is_leader():
+                return self._redirect_to_leader()
+            s = self.master.repair_pass()
+            return self._json(200, {
+                "repaired": [list(k) for k in s["repaired"]],
+                "failed": [list(k) for k in s["failed"]],
+                "skipped": [list(k) for k in s["skipped"]],
+                "outstanding": len(self.master.scrub_findings_snapshot()),
+            })
         if u.path == "/vol/grow":
             # master_server_handlers_admin.go volumeGrowHandler
             try:

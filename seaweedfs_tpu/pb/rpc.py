@@ -96,6 +96,7 @@ VOLUME_SERVER = Service("volume_server_pb.VolumeServer", {
     "VolumeServerLeave": _m(UU, _V.VolumeServerLeaveRequest, _V.VolumeServerLeaveResponse),
     "Query": _m(US, _V.QueryRequest, _V.QueriedStripe),
     "VolumeNeedleStatus": _m(UU, _V.VolumeNeedleStatusRequest, _V.VolumeNeedleStatusResponse),
+    "VolumeScrub": _m(UU, _V.VolumeScrubRequest, _V.VolumeScrubResponse),
 })
 
 _F = filer_pb2

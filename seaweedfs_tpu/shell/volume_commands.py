@@ -46,6 +46,57 @@ def volume_vacuum(env: CommandEnv, args: list[str]) -> str:
     return "vacuum triggered"
 
 
+@register("volume.scrub")
+def volume_scrub(env: CommandEnv, args: list[str]) -> str:
+    """On-demand integrity scan: verify needle CRCs / EC parity on disk.
+
+    volume.scrub [-node ip:port] [-volumeId N] [-rate MBps]
+    Without -node, every node is scrubbed (restricted to holders when
+    -volumeId is given); findings are also queued for the master's
+    repair pass via the next heartbeat."""
+    flags = _parse_flags(args)
+    vid = int(flags.get("volumeId", "0") or 0)
+    rate = float(flags.get("rate", "0") or 0)
+    if "node" in flags:
+        nodes = [flags["node"]]
+    else:
+        nodes = []
+        for _dc, _rack, dn in _iter_nodes(env.topology()):
+            if vid:
+                holds = any(
+                    v.id == vid
+                    for disk in dn.disk_infos.values()
+                    for v in disk.volume_infos
+                ) or any(
+                    e.id == vid
+                    for disk in dn.disk_infos.values()
+                    for e in disk.ec_shard_infos
+                )
+                if not holds:
+                    continue
+            nodes.append(dn.id)
+    if not nodes:
+        return f"no node holds volume {vid}" if vid else "no nodes"
+    lines = []
+    for node in nodes:
+        try:
+            resp = env.volume_server(_node_grpc(node)).VolumeScrub(
+                vs.VolumeScrubRequest(volume_id=vid, rate_mbps=rate)
+            )
+        except grpc.RpcError as e:
+            lines.append(f"{node}: error: {e}")
+            continue
+        lines.append(
+            f"{node}: scanned={resp.scanned} bytes={resp.scanned_bytes}"
+            f" corruptNeedles={resp.corrupt_needles}"
+            f" corruptShards={resp.corrupt_shards}"
+            f" indexRepairs={resp.index_repairs}"
+        )
+        for line in resp.findings:
+            lines.append(f"  finding: {line}")
+    return "\n".join(lines)
+
+
 @register("volume.mount")
 def volume_mount(env: CommandEnv, args: list[str]) -> str:
     flags = _parse_flags(args)

@@ -564,6 +564,38 @@ S3_REJECT = REGISTRY.counter(
 )
 
 
+# -- self-healing integrity plane (storage/scrub.py, ISSUE 8) ---------------
+# the scrub daemon proactively re-reads sealed volumes (needle CRC against
+# the index) and EC shards (recomputed RS parity) under a bytes/s throttle;
+# corruption found here or on the read path is quarantined and repaired by
+# the master's maintenance repair pass.
+
+SCRUB_BYTES = REGISTRY.counter(
+    "seaweedfs_scrub_bytes_total",
+    "bytes read and verified by the scrubber, by target kind",
+    labels=("kind",),  # volume | ec
+)
+SCRUB_NEEDLES = REGISTRY.counter(
+    "seaweedfs_scrub_needles_total",
+    "records verified by the scrubber, by kind and result",
+    labels=("kind", "result"),  # volume|ec x ok|corrupt|skipped
+)
+SCRUB_ERRORS = REGISTRY.counter(
+    "seaweedfs_scrub_errors_total",
+    "corruption findings by origin",
+    labels=("kind",),  # needle | shard | index | vacuum | read_path
+)
+SCRUB_REPAIRS = REGISTRY.counter(
+    "seaweedfs_scrub_repairs_total",
+    "self-healing repair attempts by kind and outcome",
+    labels=("kind", "result"),  # replica|ec_shard|index x ok|error
+)
+VOLUME_UNDERREPLICATED = REGISTRY.gauge(
+    "seaweedfs_volume_underreplicated",
+    "volumes with fewer live replicas than their placement requires",
+)
+
+
 def serve_metrics(port: int, registry: Registry = REGISTRY,
                   host: str = "0.0.0.0") -> ThreadingHTTPServer:
     """Expose GET /metrics (Prometheus text) and GET /debug/traces (JSON)."""

@@ -1,5 +1,5 @@
 from . import types  # noqa: F401
-from .needle import Needle  # noqa: F401
+from .needle import CorruptNeedleError, Needle  # noqa: F401
 from .needle_map import NeedleMap, NeedleValue  # noqa: F401
 from .replica_placement import ReplicaPlacement  # noqa: F401
 from .super_block import (  # noqa: F401

@@ -22,7 +22,7 @@ from ...stats.metrics import EC_SINGLEFLIGHT
 from ...util.chunk_cache import IntervalCache
 from .. import idx as idx_mod
 from .. import types as t
-from ..needle import Needle, actual_size
+from ..needle import CorruptNeedleError, Needle, actual_size
 from ..super_block import VERSION3
 from .constants import (
     DATA_SHARDS,
@@ -140,6 +140,10 @@ class EcVolume:
         # older layout must never be served
         self.mount_seq = 0
         self.remote_fetch: FetchFn | None = None
+        # corruption_hook(volume_id, shard_id): the read path calls it
+        # when a needle CRC failure is traced to a local shard interval
+        # (the scrubber's quarantine + confirm queue on a volume server)
+        self.corruption_hook: "Callable[[int, int], None] | None" = None
         # single-flight state + reconstructed-interval LRU for degraded
         # reads (0 MB disables the cache; single-flight always on)
         self._sf_lock = threading.Lock()
@@ -344,13 +348,46 @@ class EcVolume:
         offset, size, intervals = self.locate(needle_id)
         if t.size_is_deleted(size):
             raise NotFoundError(f"needle {needle_id:x} deleted")
-        blob = b"".join(self._read_interval(iv) for iv in intervals)
-        n = Needle.from_bytes(blob, self.version)
+        parts = [self._read_interval(iv) for iv in intervals]
+        try:
+            n = Needle.from_bytes(b"".join(parts), self.version)
+        except CorruptNeedleError:
+            # a straight shard read handed back rotten bytes (CRC caught
+            # it): re-serve each interval by reconstructing it from the
+            # OTHER shards, mark the shard whose bytes disagree suspect,
+            # and only fail if even the rebuilt needle is corrupt
+            n = self._reread_corrupt(intervals, parts)
         if n.id != needle_id:
             raise NotFoundError(
                 f"needle id mismatch: want {needle_id:x} got {n.id:x}"
             )
         return n
+
+    def _reread_corrupt(self, intervals, parts) -> Needle:
+        """Corruption failover for EC reads: reconstruct every interval
+        from sibling shards instead of trusting the local bytes.  The
+        interval whose reconstruction differs from what was read names
+        the corrupt shard — reported through corruption_hook so the
+        scrubber confirms and the master rebuilds it."""
+        fixed: list[bytes] = []
+        for iv, got in zip(intervals, parts):
+            shard_id, off = iv.to_shard_id_and_offset(
+                self.large_block_size, self.small_block_size
+            )
+            try:
+                rec = self._reconstruct_interval(shard_id, off, iv.size)
+            except (OSError, IOError):
+                fixed.append(got)  # not enough siblings: keep what we read
+                continue
+            if rec != got:
+                hook = self.corruption_hook
+                if hook is not None:
+                    try:
+                        hook(self.volume_id, shard_id)
+                    except Exception:  # noqa: BLE001 — never fail the read
+                        pass
+            fixed.append(rec)
+        return Needle.from_bytes(b"".join(fixed), self.version)
 
     def _read_interval(self, iv: Interval) -> bytes:
         shard_id, off = iv.to_shard_id_and_offset(

@@ -42,6 +42,15 @@ LAST_MODIFIED_BYTES = 5
 TTL_BYTES = 2
 
 
+class CorruptNeedleError(ValueError):
+    """CRC mismatch parsing a needle: the bytes on disk are rotten.
+
+    A ValueError subclass so every existing `except ValueError` parse
+    guard keeps working, while the read path and the scrubber can tell
+    silent corruption apart from a garbled/short read and route it into
+    quarantine + repair instead of a dead-end 500."""
+
+
 def padding_length(needle_size: int, version: int) -> int:
     """1..8 bytes; the reference adds a full 8 when already aligned."""
     if version == VERSION3:
@@ -214,7 +223,7 @@ class Needle:
             )[0]
             n.checksum = crc32c.checksum(n.data)
             if verify and stored != crc32c.mask(n.checksum):
-                raise ValueError("CRC error: data on disk corrupted")
+                raise CorruptNeedleError("CRC error: data on disk corrupted")
         if version == VERSION3:
             ts_off = t.NEEDLE_HEADER_SIZE + size + t.NEEDLE_CHECKSUM_SIZE
             n.append_at_ns = struct.unpack(">Q", blob[ts_off : ts_off + 8])[0]

@@ -27,7 +27,7 @@ from .ec.decoder import (
 )
 from .ec.shard_bits import ShardBits
 from .ec.volume import EcVolume
-from .needle import Needle
+from .needle import CorruptNeedleError, Needle
 from ..util.chunk_cache import NeedleCache
 from .replica_placement import ReplicaPlacement
 from .super_block import CURRENT_VERSION, SuperBlock
@@ -81,6 +81,10 @@ class Store:
         # vid -> FetchFn factory, injected by the volume server so EcVolumes
         # can read remote shards (store_ec.go's readRemoteEcShardInterval)
         self.ec_fetcher_factory = None
+        # self-healing integrity plane (storage/scrub.py): the volume
+        # server installs its Scrubber here; the read path feeds CRC
+        # failures into its quarantine + confirm queue
+        self.scrubber = None
         # hot-needle cache: repeated small-file GETs skip needle-map
         # lookup, disk read and CRC parse.  Per-store (never process
         # global: two in-process test clusters may reuse volume ids);
@@ -162,6 +166,8 @@ class Store:
                     if loc.delete_volume(vid):
                         if self.needle_cache is not None:
                             self.needle_cache.drop_volume(vid)
+                        if self.scrubber is not None:
+                            self.scrubber.quarantine.drop_volume(vid)
                         self.deleted_volumes.append(info)
                         return True
             return False
@@ -175,6 +181,8 @@ class Store:
                     if loc.unmount_volume(vid):
                         if self.needle_cache is not None:
                             self.needle_cache.drop_volume(vid)
+                        if self.scrubber is not None:
+                            self.scrubber.forget_volume(vid)
                         self.deleted_volumes.append(info)
                         return True
             return False
@@ -195,6 +203,11 @@ class Store:
                     if fvid == vid:
                         v = loc.add_volume(vid, collection)
                         self.new_volumes.append(self._short_info(v))
+                        if self.scrubber is not None:
+                            # a (re)mount replaced the volume's bytes —
+                            # a repair's VolumeCopy lands here; stale
+                            # findings/quarantine must not re-deliver
+                            self.scrubber.forget_volume(vid)
                         return True
             return False
 
@@ -241,7 +254,16 @@ class Store:
         v = self.find_volume(vid)
         if v is not None:
             seq = v.write_seq  # snapshot BEFORE the read
-            n = v.read_needle(needle_id, expected_cookie)
+            try:
+                n = v.read_needle(needle_id, expected_cookie)
+            except CorruptNeedleError:
+                # silent corruption on the hot path: quarantine the
+                # needle (the scrubber confirms + the master repairs)
+                # and let the retryable error reach the caller, whose
+                # replica failover rotates to a healthy copy
+                if self.scrubber is not None:
+                    self.scrubber.suspect_needle(vid, needle_id)
+                raise
             if cache is not None:
                 # compare-and-put under the volume lock: a racing
                 # append/delete bumps write_seq before its own
@@ -301,7 +323,17 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
-        _base, snapshot = compact(v)
+        on_corrupt = None
+        if self.scrubber is not None:
+            # a needle the copy skipped as rotten leaves the compacted
+            # index too — only a whole-volume re-copy from a healthy
+            # replica brings it back, so the finding must reach the
+            # master even though it can't be re-verified in place
+            def on_corrupt(needle_id: int) -> None:
+                self.scrubber.report_corruption(
+                    vid, "replica", needle_id=needle_id,
+                    detail="corrupt needle dropped during vacuum")
+        _base, snapshot = compact(v, on_corrupt=on_corrupt)
         self._compact_snapshots = getattr(self, "_compact_snapshots", {})
         self._compact_snapshots[vid] = snapshot
         return snapshot
@@ -404,6 +436,8 @@ class Store:
                 ev.collection = collection
                 if self.ec_fetcher_factory is not None:
                     ev.remote_fetch = self.ec_fetcher_factory(vid)
+                if self.scrubber is not None:
+                    ev.corruption_hook = self.scrubber.suspect_shard
                 # keep only the requested shards mounted
                 for sid in list(ev.shards):
                     if sid not in shard_ids:
@@ -412,6 +446,10 @@ class Store:
             else:
                 for sid in shard_ids:
                     ev.add_shard(sid)
+            if self.scrubber is not None:
+                # a (re)mounted shard's bytes are fresh (repair rebuilds
+                # land here): stale findings must not re-deliver
+                self.scrubber.forget_shards(vid, shard_ids)
             self.new_ec_shards.append(
                 master_pb2.VolumeEcShardInformationMessage(
                     id=vid,

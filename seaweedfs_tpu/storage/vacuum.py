@@ -13,17 +13,24 @@ diffing.
 from __future__ import annotations
 
 import os
+import struct
 
+from ..stats.metrics import SCRUB_ERRORS
+from ..util import glog
 from . import types as t
-from .needle import Needle, actual_size
+from .needle import CorruptNeedleError, Needle, actual_size
 from .volume import Volume
 
 
-def compact(volume: Volume) -> tuple[str, int]:
+def compact(volume: Volume, on_corrupt=None) -> tuple[str, int]:
     """Write .cpd/.cpx shadow files with live needles; returns (base, snapshot).
 
     Holds the volume lock only long enough to snapshot the end offset; the
     copy itself reads from the immutable prefix of the append-only .dat.
+    `on_corrupt(needle_id)` fires for every needle skipped as rotten so
+    the caller can queue a repair (Store.compact_volume wires the
+    scrubber) — after commit the needle is gone from the local index and
+    only a replica re-copy restores it.
     """
     base = volume.file_name()
     with volume._lock:
@@ -48,6 +55,24 @@ def compact(volume: Volume) -> tuple[str, int]:
                 continue
             src.seek(nv.offset)
             blob = src.read(actual_size(nv.size, version))
+            # verify while copying: a silently-rotten needle must not be
+            # laundered into the compacted volume as fresh-looking bytes
+            # (seaweedfs_scrub_errors_total{kind="vacuum"}); the skipped
+            # needle heals from a replica via the scrub/repair plane
+            try:
+                n = Needle.from_bytes(blob, version)
+                if n.id != key:
+                    raise CorruptNeedleError(
+                        f"record at {nv.offset} carries id {n.id:x}")
+            except (CorruptNeedleError, ValueError, IndexError,
+                    struct.error) as e:
+                SCRUB_ERRORS.labels("vacuum").inc()
+                glog.warning(
+                    "vacuum: skipping corrupt needle %x in volume %d: %s",
+                    key, volume.volume_id, e)
+                if on_corrupt is not None:
+                    on_corrupt(key)
+                continue
             dat_out.write(blob)
             idx_out.write(t.pack_index_entry(key, offset, nv.size))
             offset += len(blob)

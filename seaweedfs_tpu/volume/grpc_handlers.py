@@ -608,6 +608,34 @@ class VolumeGrpcService:
                               "need csv_input or json_input")
             yield vs.QueriedStripe(records=records)
 
+    def VolumeScrub(self, request, context):
+        """On-demand integrity scan (shell `volume.scrub`): one volume /
+        EC volume, or the whole node when volume_id=0; an optional
+        per-call rate override on the scrubber's token bucket."""
+        scrubber = self.server.scrubber
+        rate = request.rate_mbps or None
+        try:
+            if request.volume_id:
+                r = scrubber.scrub_volume(request.volume_id, rate_mbps=rate)
+            else:
+                r = scrubber.scrub_once(rate_mbps=rate)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        findings = [
+            (f"vol={f['volume_id']} kind={f['kind']} shard={f['shard_id']} "
+             f"needle={f['needle_id']:x} {f['detail']}")
+            for f in scrubber.recent_findings(request.volume_id or None)
+        ]
+        return vs.VolumeScrubResponse(
+            scanned=r.get("scanned",
+                          r.get("volumes", 0) + r.get("ec_volumes", 0)),
+            scanned_bytes=r.get("bytes", r.get("scanned_bytes", 0)),
+            corrupt_needles=r.get("corrupt_needles", 0),
+            corrupt_shards=r.get("corrupt_shards", 0),
+            index_repairs=r.get("index_repairs", 0),
+            findings=findings[-32:],
+        )
+
     def VolumeNeedleStatus(self, request, context):
         try:
             n = self.store.read_needle(request.volume_id, request.needle_id)

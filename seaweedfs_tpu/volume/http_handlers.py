@@ -20,7 +20,12 @@ from .. import images
 from ..security.jwt import token_from_header, verify_write_jwt
 from ..telemetry import http_request, serve_debug_http
 from ..storage.file_id import FileId
-from ..storage.needle import FLAG_HAS_MIME, FLAG_HAS_NAME, Needle
+from ..storage.needle import (
+    FLAG_HAS_MIME,
+    FLAG_HAS_NAME,
+    CorruptNeedleError,
+    Needle,
+)
 from ..util import faultpoint
 
 # chaos points on the public data path; ctx is this server's host:port so
@@ -91,6 +96,8 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
             return self._send_json(200, {"Version": "seaweedfs-tpu", **self.store.status()})
         if serve_debug_http(self, path.path):
             return
+        if path.path == "/debug/scrub":
+            return self._send_json(200, self.volume_server.scrubber.status())
         if path.path in ("/ui", "/ui/", "/ui/index.html"):
             from ..util.ui import render_status_page
 
@@ -121,6 +128,12 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
             n = self.store.read_needle(fid.volume_id, fid.key)
         except KeyError:
             return self._send_json(404, {"error": "not found"})
+        except CorruptNeedleError as e:
+            # quarantined by the store; a 5xx is the retryable NACK the
+            # filer's _download_failover rotates on, so the client's read
+            # lands on a healthy replica while repair runs in background
+            return self._send_json(
+                500, {"error": f"needle corrupt, retry a replica: {e}"})
         except IOError as e:
             return self._send_json(500, {"error": str(e)})
         if n.cookie != fid.cookie:
@@ -201,6 +214,9 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
             n = self.store.read_needle(fid.volume_id, fid.key)
         except KeyError:
             return self._send_json(404, {"error": "not found"})
+        except CorruptNeedleError as e:
+            return self._send_json(
+                500, {"error": f"needle corrupt, retry a replica: {e}"})
         except IOError as e:
             return self._send_json(500, {"error": str(e)})
         if n.cookie != fid.cookie:
@@ -323,6 +339,12 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
             size = self.store.delete_needle(fid.volume_id, fid.key)
         except KeyError:
             return self._send_json(404, {"error": "not found"})
+        except CorruptNeedleError as e:
+            # cannot cookie-check rotten bytes; the retryable error sends
+            # the delete to a healthy replica, whose fan-out tombstones
+            # this copy too
+            return self._send_json(
+                500, {"error": f"needle corrupt, retry a replica: {e}"})
         if "replicate" not in qs.get("type", []):
             self.volume_server.replicate_delete(
                 fid, self.path, self.headers.get("Authorization") or ""

@@ -22,6 +22,7 @@ from ..stats.metrics import (
     VOLUME_GAUGE,
     serve_metrics,
 )
+from ..storage.scrub import Scrubber
 from ..storage.store import Store
 from ..util import connpool, glog
 from ..util.executors import MeteredThreadPoolExecutor
@@ -103,6 +104,11 @@ class VolumeServer:
         self._replica_pool = MeteredThreadPoolExecutor(
             max_workers=8, name="replica_fanout",
             thread_name_prefix="replica-fanout")
+        # self-healing integrity plane: throttled background scrubber +
+        # quarantine the read path feeds (SEAWEEDFS_TPU_SCRUB_RATE_MBPS=0
+        # disables the daemon; on-demand volume.scrub still works)
+        self.scrubber = Scrubber(self.store)
+        self.store.scrubber = self.scrubber
 
     # -- lifecycle --------------------------------------------------------
 
@@ -111,6 +117,8 @@ class VolumeServer:
         for loc in self.store.locations:
             for vid, ev in loc.ec_volumes.items():
                 ev.remote_fetch = self._make_ec_fetcher(vid)
+                ev.corruption_hook = self.scrubber.suspect_shard
+        self.scrubber.start()
         self._httpd = serve_http(self, "0.0.0.0", self.port)
         self._grpc_server = rpclib.serve(
             [(rpclib.VOLUME_SERVER, VolumeGrpcService(self))], self.grpc_port
@@ -130,6 +138,7 @@ class VolumeServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self.scrubber.stop()
         if getattr(self, "_tcpd", None):
             self._tcpd.shutdown()
             self._tcpd.server_close()
@@ -216,6 +225,11 @@ class VolumeServer:
         hb.stats.captured_at_ms = int(time.time() * 1000)
         for name, value in REGISTRY.snapshot_samples():
             hb.stats.samples.add(name=name, value=value)
+        # confirmed scrub findings ride the same beat; re-delivered every
+        # full beat until the target heals (the master keys findings
+        # idempotently), so a stream that dies mid-send loses nothing
+        for f in self.scrubber.outstanding_findings():
+            hb.scrub_findings.add(**f)
         return hb
 
     def _heartbeat_once(self, master: str) -> None:
