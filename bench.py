@@ -952,6 +952,235 @@ def _service_rates() -> dict:
     return result
 
 
+def _soak_rates() -> dict:
+    """ISSUE 9 / ROADMAP 5d soak smoke: production-shaped mixed traffic.
+
+    Bounded (~SEAWEEDFS_TPU_SOAK_SECONDS, default 30s of load + setup):
+    an in-process master + 2 volume servers run concurrent reads AND
+    writes while the lifecycle controller executes one forced
+    seal -> EC-encode transition on a filled volume and a vacuum on a
+    garbage-heavy sibling.  Asserts:
+
+      * every read during every stage returns the exact original bytes
+        (byte-identity through seal, encode, volume delete, EC serving);
+      * zero client-visible 5xx;
+      * read p99 from the registry request histogram stays under the
+        SLO (SEAWEEDFS_TPU_SOAK_P99_S, default 2.0s — generous for
+        noisy 1-vCPU CI hosts; the point is catching order-of-magnitude
+        regressions under mixed load, not microbenchmarking).
+
+    Emits soak_ok plus the measured numbers; the CI step gates on
+    soak_ok so every future PR is judged under production-shaped
+    traffic, not single-op microbenches.
+    """
+    import os
+    import shutil
+    import socket
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.stats.metrics import REQUEST_HISTOGRAM
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    soak_s = float(os.environ.get("SEAWEEDFS_TPU_SOAK_SECONDS", "30"))
+    slo_p99_s = float(os.environ.get("SEAWEEDFS_TPU_SOAK_P99_S", "2.0"))
+    reserved: set[int] = set()
+
+    def _port() -> int:
+        while True:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                p = s.getsockname()[1]
+            if (p <= 55000 and p not in reserved
+                    and p + 10000 not in reserved):
+                reserved.update((p, p + 10000))
+                return p
+
+    tmp = tempfile.mkdtemp(prefix="swfs-soak-")
+    journal_dir = tempfile.mkdtemp(prefix="swfs-soak-journal-")
+    master = MasterServer(
+        ip="127.0.0.1", port=_port(), volume_size_limit_mb=4,
+        lifecycle_dir=journal_dir,
+        lifecycle_policy={"*": {
+            # force the pipeline inside the bounded window: seal at 10%
+            # fullness, encode as soon as sealed+quiet 1s, vacuum at 25%
+            "seal_full_percent": 10.0, "ec_cooldown_seconds": 1.0,
+            "vacuum_garbage_ratio": 0.25,
+        }})
+    master.start()
+    vols = []
+    for i in range(2):
+        d = os.path.join(tmp, f"v{i}")
+        os.makedirs(d)
+        v = VolumeServer(directories=[d], ip="127.0.0.1", port=_port(),
+                         master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+                         pulse_seconds=0.5, max_volume_count=16)
+        v.start()
+        vols.append(v)
+    errors: list[str] = []
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and len(master.topo.nodes) < 2:
+            time.sleep(0.1)
+
+        def put(fid: str, url: str, payload: bytes) -> bool:
+            body = (b"--bb\r\nContent-Disposition: form-data; "
+                    b'name="file"; filename="s.bin"\r\n\r\n'
+                    + payload + b"\r\n--bb--\r\n")
+            req = urllib.request.Request(
+                f"http://{url}/{fid}", data=body, method="POST",
+                headers={"Content-Type":
+                         "multipart/form-data; boundary=bb"})
+            with urllib.request.urlopen(req, timeout=20) as r:
+                return r.status < 300
+
+        def assign() -> tuple[str, str]:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{master.port}/dir/assign", timeout=20
+            ) as r:
+                a = json.loads(r.read())
+            return a["fid"], a["url"]
+
+        def derived_fids(base_fid: str, n: int) -> list[str]:
+            # consecutive keys on the SAME volume (the smallfile-bench
+            # trick): lets the seeding fill one specific volume instead
+            # of scattering across the whole writable set
+            vid_s, _, rest = base_fid.partition(",")
+            base_key = int(rest[:-8], 16)
+            cookie = rest[-8:]
+            return [f"{vid_s},{base_key + i:x}{cookie}" for i in range(n)]
+
+        # seed the lifecycle target: fill one volume past the seal
+        # threshold (4MB limit * 10% = ~420KB) with known payloads
+        rng = np.random.default_rng(7)
+        known: dict[tuple[str, str], bytes] = {}
+        first_fid, first_url = assign()
+        target_vid = int(first_fid.split(",")[0])
+        for fid in derived_fids(first_fid, 10):
+            payload = rng.integers(0, 256, 64 << 10).astype(
+                np.uint8).tobytes()
+            if put(fid, first_url, payload):
+                known[(fid, first_url)] = payload
+        # garbage-heavy sibling for the vacuum leg: write then delete
+        # most of a second volume's needles
+        g_base = None
+        for _ in range(20):
+            fid, url = assign()
+            if int(fid.split(",")[0]) != target_vid:
+                g_base = (fid, url)
+                break
+        if g_base is not None:
+            g_fids = derived_fids(g_base[0], 10)
+            for fid in g_fids:
+                put(fid, g_base[1], os.urandom(32 << 10))
+            for fid in g_fids[:-2]:
+                req = urllib.request.Request(
+                    f"http://{g_base[1]}/{fid}", method="DELETE")
+                with urllib.request.urlopen(req, timeout=20):
+                    pass
+
+        stop = threading.Event()
+        counts = {"reads": 0, "writes": 0}
+        lock = threading.Lock()
+        items = list(known.items())
+
+        def reader(i: int) -> None:
+            while not stop.is_set():
+                (fid, url), want = items[counts["reads"] % len(items)]
+                try:
+                    with urllib.request.urlopen(
+                            f"http://{url}/{fid}", timeout=20) as r:
+                        got = r.read()
+                        if r.status >= 500:
+                            errors.append(f"read {fid}: {r.status}")
+                        elif got != want:
+                            errors.append(f"read {fid}: wrong bytes "
+                                          f"({len(got)} vs {len(want)})")
+                except urllib.error.HTTPError as e:
+                    if e.code >= 500:
+                        errors.append(f"read {fid}: {e.code}")
+                except OSError as e:
+                    errors.append(f"read {fid}: {e}")
+                with lock:
+                    counts["reads"] += 1
+
+        def writer() -> None:
+            # write failures do NOT gate the soak: the assign->write
+            # window races the seal (a just-sealed volume bounces a
+            # write until the next heartbeat updates the writable set),
+            # and the production client re-assigns on that — modeled
+            # here by simply retrying with a fresh assign
+            while not stop.is_set():
+                try:
+                    fid, url = assign()
+                    if put(fid, url, os.urandom(8 << 10)):
+                        with lock:
+                            counts["writes"] += 1
+                except (urllib.error.HTTPError, OSError):
+                    pass
+                time.sleep(0.02)
+
+        c0, n0, _t0 = _hist_child_snapshot(
+            REQUEST_HISTOGRAM, "volumeServer", "get")
+        pool = ThreadPoolExecutor(5)
+        futs = [pool.submit(reader, i) for i in range(4)]
+        futs.append(pool.submit(writer))
+        t_start = time.perf_counter()
+        # the forced lifecycle transition runs CONCURRENTLY with the
+        # load: cycles until seal + ec_encode + vacuum all land
+        transitions_done: dict = {}
+        cycle_deadline = time.time() + max(soak_s - 2, 5)
+        while time.time() < cycle_deadline:
+            master.lifecycle.run_once()
+            states = master.lifecycle.journal.counts()
+            transitions_done = {
+                j["key"]: j["state"]
+                for j in master.lifecycle.journal.jobs(("done",))}
+            if (f"{target_vid}:ec_encode" in transitions_done
+                    and any(k.endswith(":vacuum")
+                            for k in transitions_done)):
+                break
+            time.sleep(1.0)
+        remaining = soak_s - (time.perf_counter() - t_start)
+        if remaining > 0:
+            time.sleep(min(remaining, soak_s))
+        stop.set()
+        pool.shutdown(wait=True)
+        elapsed = time.perf_counter() - t_start
+        c1, n1, _t1 = _hist_child_snapshot(
+            REQUEST_HISTOGRAM, "volumeServer", "get")
+        delta_counts = [b - a for a, b in zip(c0, c1)]
+        p99 = _hist_quantile(
+            list(REQUEST_HISTOGRAM.buckets), delta_counts, n1 - n0, 0.99)
+        sealed = f"{target_vid}:seal" in transitions_done
+        encoded = f"{target_vid}:ec_encode" in transitions_done
+        vacuumed = any(k.endswith(":vacuum") for k in transitions_done)
+        ok = (not errors and sealed and encoded and vacuumed
+              and p99 <= slo_p99_s and counts["reads"] > 0)
+        return {
+            "soak_ok": bool(ok),
+            "soak_seconds": round(elapsed, 1),
+            "soak_reads": counts["reads"],
+            "soak_writes": counts["writes"],
+            "soak_read_p99_s": round(p99, 4),
+            "soak_p99_slo_s": slo_p99_s,
+            "soak_transitions": sorted(transitions_done),
+            "soak_error_count": len(errors),
+            "soak_errors": errors[:10],
+            "soak_journal_states": master.lifecycle.journal.counts(),
+        }
+    finally:
+        for v in vols:
+            v.stop()
+        master.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+        shutil.rmtree(journal_dir, ignore_errors=True)
+
+
 def _cpu_rate(shard_bytes: int = 16 << 20, iters: int = 5) -> float:
     """Best single-pass rate: this shared vCPU sees multi-second steal
     spikes (observed swinging a mean-of-3 between 3.7 and 5.9 GB/s), so
@@ -1099,6 +1328,14 @@ def main() -> None:
             print(json.dumps(device_probe.probe(refresh=True).to_json()))
         except Exception as exc:  # noqa: BLE001
             print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:300]}))
+        return
+    if "--soak-only" in sys.argv or "--soak" in sys.argv:
+        try:
+            print(json.dumps(_soak_rates()))
+        except Exception as exc:  # noqa: BLE001
+            print(json.dumps(
+                {"soak_ok": False,
+                 "error": f"{type(exc).__name__}: {exc}"[:500]}))
         return
     if "--service-only" in sys.argv or "--service" in sys.argv:
         try:

@@ -29,6 +29,12 @@ def cmd_master(args) -> None:
     sequencer = mconf.get_string("master.sequencer.type", "memory")
     node_id = mconf.get_int("master.sequencer.sequencer_snowflake_id")
 
+    lifecycle_policy = None
+    if args.lifecyclePolicy:
+        import json
+
+        with open(args.lifecyclePolicy) as f:
+            lifecycle_policy = json.load(f)
     m = MasterServer(
         ip=args.ip,
         port=args.port,
@@ -36,6 +42,10 @@ def cmd_master(args) -> None:
         default_replication=args.defaultReplication,
         maintenance_interval=interval,
         maintenance_script=script,
+        lifecycle_interval=args.lifecycleInterval,
+        lifecycle_dir=args.lifecycleDir,
+        lifecycle_rate_mbps=args.lifecycleRateMBps,
+        lifecycle_policy=lifecycle_policy,
         sequencer=sequencer,
         sequencer_node_id=node_id,
         sequencer_etcd_urls=mconf.get_string(
@@ -668,6 +678,19 @@ def main(argv=None) -> None:
     m.add_argument("-maintenanceInterval", type=float, default=None,
                help="seconds between maintenance runs; 0 disables "
                     "(default: master.toml periodic_seconds)")
+    m.add_argument("-lifecycleInterval", type=float, default=0.0,
+                   help="lifecycle controller cycle seconds; 0 = manual "
+                        "only (volume.lifecycle -apply)")
+    m.add_argument("-lifecycleDir", default="",
+                   help="crash-safe lifecycle journal directory; empty "
+                        "keeps jobs in memory only")
+    m.add_argument("-lifecycleRateMBps", type=float, default=None,
+                   help="cluster background-I/O budget shared by "
+                        "lifecycle jobs and scrub (None = env "
+                        "SEAWEEDFS_TPU_LIFECYCLE_RATE_MBPS, 0 = "
+                        "unthrottled)")
+    m.add_argument("-lifecyclePolicy", default="",
+                   help="JSON policy file: {collection: {field: value}}")
     m.add_argument("-metricsPort", type=int, default=0)
     m.add_argument("-jwtKey", default="")
     m.add_argument("-peers", default="",

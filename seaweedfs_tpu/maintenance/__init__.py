@@ -1,0 +1,27 @@
+"""Autonomous storage lifecycle plane (ISSUE 9).
+
+A master-resident controller that turns per-collection declarative
+policies into journaled, idempotent background jobs:
+
+    hot volume -> seal -> EC-encode -> cloud-tier
+                          vacuum / rebalance / ttl-expire
+
+Policies are evaluated against heartbeat-fed topology state, jobs are
+persisted to a crash-safe journal (replayed on master restart,
+duplicate-suppressed by (volume, transition) key), and execution is
+paced by a cluster-wide bytes/s token bucket plus the PR 5 saturation
+gauges so lifecycle traffic never starves foreground I/O — the
+operational failure mode arXiv:1309.0186 documents for EC clusters.
+"""
+
+from .controller import LifecycleController, TRANSITIONS
+from .journal import JobJournal
+from .policy import LifecyclePolicy, PolicySet
+
+__all__ = [
+    "JobJournal",
+    "LifecycleController",
+    "LifecyclePolicy",
+    "PolicySet",
+    "TRANSITIONS",
+]

@@ -106,6 +106,11 @@ class MasterGrpcService:
                     volume_size_limit=self.topo.volume_size_limit,
                     leader=self.master.leader(),
                     leader_grpc=self.master.leader_grpc(),
+                    # the shared background-I/O budget: volume servers
+                    # point their scrub bucket at this rate so scrub +
+                    # lifecycle tier traffic can never saturate a node
+                    # together (0 = keep the node's local default)
+                    lifecycle_rate_mbps=self.master.lifecycle.rate_mbps,
                 )
         finally:
             if node is not None and context.code() is None:
@@ -283,6 +288,49 @@ class MasterGrpcService:
         self._require_leader(context)
         self.master.vacuum(request.garbage_threshold or 0.3)
         return master_pb2.VacuumVolumeResponse()
+
+    # -- lifecycle plane --------------------------------------------------
+
+    def Lifecycle(self, request, context):
+        """The volume.lifecycle shell surface: status / policy / run.
+
+        `run` evaluates the policies now; with apply=False it only
+        reports the plan (dry run), with apply=True the planned jobs are
+        journaled and executed before the response returns."""
+        import json
+
+        lc = self.master.lifecycle
+        action = request.action or "status"
+        if action == "status":
+            return master_pb2.LifecycleResponse(
+                report=json.dumps(lc.status()))
+        if action == "policy":
+            try:
+                policies = lc.set_policies(request.policy_json)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            return master_pb2.LifecycleResponse(report=policies.dumps())
+        if action == "run":
+            self._require_leader(context)
+            plans = lc.evaluate()
+            if request.volume_id:
+                plans = [p for p in plans
+                         if p["volume_id"] == request.volume_id]
+            if request.transition:
+                plans = [p for p in plans
+                         if p["transition"] == request.transition]
+            report = {"planned": plans, "results": []}
+            if request.apply:
+                accepted = lc.submit(plans)
+                # scoped: execute only the jobs THIS request planned —
+                # unrelated resumed/queued jobs stay for the controller
+                report["results"] = lc.run_pending(
+                    wait=True, keys={j["key"] for j in accepted})
+            return master_pb2.LifecycleResponse(
+                report=json.dumps(report))
+        context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                      f"unknown lifecycle action {action!r} "
+                      "(want status|policy|run)")
 
     # -- admin lock -------------------------------------------------------
 

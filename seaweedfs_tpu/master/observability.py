@@ -230,4 +230,13 @@ def cluster_status(master) -> dict:
     master.update_replication_health()
     out["VolumeHealth"] = master.volume_health_snapshot()
     out["ScrubFindings"] = len(master.scrub_findings_snapshot())
+    # lifecycle plane: one-line controller summary (the full journal is
+    # at /cluster/lifecycle); answers "is background maintenance alive
+    # and is anything parked waiting for an operator"
+    lc = master.lifecycle
+    out["Lifecycle"] = {
+        "enabled": lc.interval_s > 0,
+        "rateMBps": lc.rate_mbps,
+        "jobStates": lc.journal.counts(),
+    }
     return out

@@ -58,6 +58,10 @@ class MasterServer:
         jwt_signing_key: bytes | str = b"",
         peers: list[str] | None = None,  # master quorum (ip:port HTTP addrs)
         raft_state_dir: str = "",
+        lifecycle_interval: float = 0.0,  # seconds; 0 = manual only
+        lifecycle_dir: str = "",          # journal dir; "" = memory only
+        lifecycle_rate_mbps: float | None = None,  # None = env, 0 = off
+        lifecycle_policy: dict | None = None,
     ):
         self.ip = ip
         self.port = port
@@ -110,6 +114,21 @@ class MasterServer:
             jwt_signing_key.encode() if isinstance(jwt_signing_key, str)
             else jwt_signing_key
         )
+        # lifecycle plane (maintenance/): policy-driven seal -> EC ->
+        # tier -> vacuum -> rebalance with a crash-safe job journal.
+        # Constructed unconditionally so /cluster/lifecycle and the
+        # volume.lifecycle shell command work even when the periodic
+        # loop is disabled (interval 0)
+        from ..maintenance import LifecycleController, PolicySet
+
+        self.lifecycle = LifecycleController(
+            self,
+            policies=(PolicySet.parse(lifecycle_policy)
+                      if lifecycle_policy is not None else None),
+            interval_s=lifecycle_interval,
+            rate_mbps=lifecycle_rate_mbps,
+            journal_dir=lifecycle_dir,
+        )
         self._rng = random.Random()
         # raft quorum (raft_server.go:21-46): multi-master when peers given
         self.raft = None
@@ -147,6 +166,7 @@ class MasterServer:
         threading.Thread(target=self._liveness_loop, daemon=True).start()
         if self.maintenance_interval > 0:
             threading.Thread(target=self._maintenance_loop, daemon=True).start()
+        self.lifecycle.start()
         if self.raft is not None:
             self.raft.start()
         glog.info("master started http=%d grpc=%d peers=%d",
@@ -155,6 +175,7 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self.lifecycle.stop()
         if self.raft is not None:
             self.raft.stop()
         if self._httpd:
@@ -464,38 +485,58 @@ class MasterServer:
         threshold = threshold or self.garbage_threshold
         vacuumed = []
         with self.topo.lock:
-            vid_nodes: dict[int, list] = {}
-            for n in self.topo.nodes.values():
-                for vid in n.volumes:
-                    vid_nodes.setdefault(vid, []).append(n)
-        for vid, nodes in vid_nodes.items():
-            try:
-                ratios = [
-                    rpclib.volume_server_stub(n.grpc_address, timeout=30)
-                    .VacuumVolumeCheck(vs.VacuumVolumeCheckRequest(volume_id=vid))
-                    .garbage_ratio
-                    for n in nodes
-                ]
-                if not ratios or min(ratios) < threshold:
-                    continue
-                for n in nodes:
-                    rpclib.volume_server_stub(n.grpc_address, timeout=600).VacuumVolumeCompact(
-                        vs.VacuumVolumeCompactRequest(volume_id=vid)
-                    )
-                for n in nodes:
-                    rpclib.volume_server_stub(n.grpc_address, timeout=600).VacuumVolumeCommit(
-                        vs.VacuumVolumeCommitRequest(volume_id=vid)
-                    )
+            vids = sorted({vid for n in self.topo.nodes.values()
+                           for vid in n.volumes})
+        for vid in vids:
+            if self.vacuum_volume(vid, threshold):
                 vacuumed.append(vid)
-            except grpc.RpcError:
-                for n in nodes:
-                    try:
-                        rpclib.volume_server_stub(n.grpc_address, timeout=30).VacuumVolumeCleanup(
-                            vs.VacuumVolumeCleanupRequest(volume_id=vid)
-                        )
-                    except grpc.RpcError:
-                        pass
         return vacuumed
+
+    def vacuum_volume(self, vid: int,
+                      threshold: float | None = None) -> bool:
+        """Check -> Compact -> Commit one volume on every holder (the
+        lifecycle controller's vacuum jobs call this directly); a failed
+        phase rolls back with VacuumVolumeCleanup.  Returns True when
+        the volume was compacted."""
+        threshold = threshold or self.garbage_threshold
+        with self.topo.lock:
+            nodes = [n for n in self.topo.nodes.values()
+                     if vid in n.volumes]
+            # sealed (read-only) volumes are exempt, like the
+            # reference's vacuum: they are EC-encode/tier candidates,
+            # and a compact commit racing a lifecycle tier upload would
+            # swap the .dat mid-transfer
+            if any(n.volumes[vid].read_only for n in nodes):
+                return False
+        if not nodes:
+            return False
+        try:
+            ratios = [
+                rpclib.volume_server_stub(n.grpc_address, timeout=30)
+                .VacuumVolumeCheck(vs.VacuumVolumeCheckRequest(volume_id=vid))
+                .garbage_ratio
+                for n in nodes
+            ]
+            if not ratios or min(ratios) < threshold:
+                return False
+            for n in nodes:
+                rpclib.volume_server_stub(n.grpc_address, timeout=600).VacuumVolumeCompact(
+                    vs.VacuumVolumeCompactRequest(volume_id=vid)
+                )
+            for n in nodes:
+                rpclib.volume_server_stub(n.grpc_address, timeout=600).VacuumVolumeCommit(
+                    vs.VacuumVolumeCommitRequest(volume_id=vid)
+                )
+            return True
+        except grpc.RpcError:
+            for n in nodes:
+                try:
+                    rpclib.volume_server_stub(n.grpc_address, timeout=30).VacuumVolumeCleanup(
+                        vs.VacuumVolumeCleanupRequest(volume_id=vid)
+                    )
+                except grpc.RpcError:
+                    pass
+            return False
 
     # -- maintenance loop (ec.encode/rebuild/balance automation) ----------
 
@@ -820,6 +861,7 @@ _MASTER_OPS = {
     "/cluster/raft": "cluster.raft",
     "/cluster/metrics": "cluster.metrics",
     "/cluster/traces": "cluster.traces",
+    "/cluster/lifecycle": "cluster.lifecycle",
     "/vol/vacuum": "vol.vacuum", "/vol/grow": "vol.grow",
     "/vol/repair": "vol.repair",
     "/vol/status": "vol.status", "/col/delete": "col.delete",
@@ -997,6 +1039,9 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if u.path == "/cluster/lifecycle":
+            # lifecycle controller status: policies, journal, job states
+            return self._json(200, self.master.lifecycle.status())
         if u.path == "/cluster/traces":
             from ..telemetry import parse_trace_query
             from . import observability

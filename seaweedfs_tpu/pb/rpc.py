@@ -53,6 +53,7 @@ MASTER = Service("master_pb.Seaweed", {
     "ListMasterClients": _m(UU, master_pb2.ListMasterClientsRequest, master_pb2.ListMasterClientsResponse),
     "LeaseAdminToken": _m(UU, master_pb2.LeaseAdminTokenRequest, master_pb2.LeaseAdminTokenResponse),
     "ReleaseAdminToken": _m(UU, master_pb2.ReleaseAdminTokenRequest, master_pb2.ReleaseAdminTokenResponse),
+    "Lifecycle": _m(UU, master_pb2.LifecycleRequest, master_pb2.LifecycleResponse),
 })
 
 _V = volume_server_pb2

@@ -133,7 +133,13 @@ def ec_encode(env: CommandEnv, args: list[str]) -> str:
 
 
 def do_ec_encode(env: CommandEnv, topo, vid: int, collection: str,
-                 codec: str = "") -> str:
+                 codec: str = "", delete_source: bool = True) -> str:
+    """Encode one volume to EC shards and spread them.
+
+    `delete_source=False` (the lifecycle controller's tier pipeline)
+    keeps the sealed source volume mounted read-only after the shards
+    mount, so its .dat can still move to a remote tier — the reference
+    flow (and the default) deletes the original from every replica."""
     locations = _volume_locations(topo, vid)
     if not locations:
         # freshly grown volumes may not be in the heartbeat snapshot yet;
@@ -206,11 +212,13 @@ def do_ec_encode(env: CommandEnv, topo, vid: int, collection: str,
                 shard_ids=moved_from_source,
             )
         )
-    # 5. delete the original volume everywhere
-    for loc in locations:
-        env.volume_server(_node_grpc(loc)).VolumeDelete(
-            vs.VolumeDeleteRequest(volume_id=vid)
-        )
+    # 5. delete the original volume everywhere (unless the caller keeps
+    # the sealed source for a later tier move)
+    if delete_source:
+        for loc in locations:
+            env.volume_server(_node_grpc(loc)).VolumeDelete(
+                vs.VolumeDeleteRequest(volume_id=vid)
+            )
     return f"ec.encode {vid}: spread {dict((k, v) for k, v in plan.items())}"
 
 
@@ -290,90 +298,100 @@ def _rebuild_one(env: CommandEnv, vid: int, collection: str,
     return f"ec.rebuild {vid}: rebuilt {rebuilt} on {rebuilder}"
 
 
-@register("ec.balance")
-def ec_balance(env: CommandEnv, args: list[str]) -> str:
-    """Move shards from loaded nodes to nodes with more free EC slots;
-    -collection=NAME scopes both the counting and the moves
-    (command_ec_balance.go)."""
-    flags = _parse_flags(args)
-    collection = flags.get("collection", "")
-    topo = env.topology()
+def plan_ec_balance_moves(topo, collection: str = "") -> list[dict]:
+    """Pure shard-move planning from one topology snapshot (tier-3
+    testable; -collection scopes both the counting and the moves,
+    command_ec_balance.go)."""
     nodes = {dn.id: dn for _dc, _rack, dn in _iter_nodes(topo)}
     free = {nid: _free_ec_slots(dn) for nid, dn in nodes.items()}
-    shard_count = {
-        nid: sum(
-            ShardBits(e.ec_index_bits).count()
-            for disk in dn.disk_infos.values()
-            for e in disk.ec_shard_infos
-            if not collection or e.collection == collection
-        )
-        for nid, dn in nodes.items()
-    }
-    if not shard_count:
-        return "ec.balance: no ec shards"
-    moves = []
-    avg = sum(shard_count.values()) / max(len(shard_count), 1)
-    for nid, dn in nodes.items():
-        while shard_count[nid] > avg + 1:
-            target = max(free, key=lambda n: (free[n] - shard_count[n], n != nid))
-            if target == nid or free[target] <= 0:
-                break
-            moved = _move_one_shard(env, topo, nid, target, collection)
-            if not moved:
-                break
-            shard_count[nid] -= 1
-            shard_count[target] = shard_count.get(target, 0) + 1
-            free[target] -= 1
-            moves.append(f"{moved} {nid} -> {target}")
-            topo = env.topology()
-    if moves:
-        return "ec.balance: " + "; ".join(moves)
-    return (f"ec.balance: balanced (shards per node: {shard_count}, "
-            f"free slots: {free})")
-
-
-def _move_one_shard(env: CommandEnv, topo, source: str, target: str,
-                    collection: str = ""):
+    on_node: dict[str, list[tuple[int, int, str]]] = {n: [] for n in nodes}
     for _dc, _rack, dn in _iter_nodes(topo):
-        if dn.id != source:
-            continue
         for disk in dn.disk_infos.values():
             for e in disk.ec_shard_infos:
                 if collection and e.collection != collection:
                     continue
-                sids = ShardBits(e.ec_index_bits).shard_ids()
-                if not sids:
-                    continue
-                sid = sids[0]
-                tgt = env.volume_server(_node_grpc(target))
-                tgt.VolumeEcShardsCopy(
-                    vs.VolumeEcShardsCopyRequest(
-                        volume_id=e.id, collection=e.collection,
-                        shard_ids=[sid], copy_ecx_file=True,
-                        copy_ecj_file=True, copy_vif_file=True,
-                        copy_from_data_node=_node_grpc(source),
-                    )
-                )
-                tgt.VolumeEcShardsMount(
-                    vs.VolumeEcShardsMountRequest(
-                        volume_id=e.id, collection=e.collection,
-                        shard_ids=[sid],
-                    )
-                )
-                src = env.volume_server(_node_grpc(source))
-                src.VolumeEcShardsUnmount(
-                    vs.VolumeEcShardsUnmountRequest(
-                        volume_id=e.id, shard_ids=[sid]
-                    )
-                )
-                src.VolumeEcShardsDelete(
-                    vs.VolumeEcShardsDeleteRequest(
-                        volume_id=e.id, collection=e.collection,
-                        shard_ids=[sid],
-                    )
-                )
-                return f"{e.id}.{sid}"
-    return None
+                for sid in ShardBits(e.ec_index_bits).shard_ids():
+                    on_node[dn.id].append((e.id, sid, e.collection))
+    shard_count = {nid: len(s) for nid, s in on_node.items()}
+    if not any(shard_count.values()):
+        return []
+    moves: list[dict] = []
+    avg = sum(shard_count.values()) / max(len(shard_count), 1)
+    for nid in list(nodes):
+        while shard_count[nid] > avg + 1:
+            target = max(
+                free, key=lambda n: (free[n] - shard_count[n], n != nid))
+            if target == nid or free[target] <= 0 or not on_node[nid]:
+                break
+            vid, sid, coll = on_node[nid].pop(0)
+            moves.append({"volumeId": vid, "shardId": sid,
+                          "collection": coll,
+                          "source": nid, "target": target})
+            shard_count[nid] -= 1
+            shard_count[target] = shard_count.get(target, 0) + 1
+            free[target] -= 1
+    return moves
+
+
+def apply_ec_move(env: CommandEnv, move: dict) -> str:
+    """Execute one planned shard move: copy+mount on the target, then
+    unmount+delete on the source (the two-phase order keeps the shard
+    readable throughout)."""
+    vid, sid = move["volumeId"], move["shardId"]
+    coll = move.get("collection", "")
+    source, target = move["source"], move["target"]
+    tgt = env.volume_server(_node_grpc(target))
+    tgt.VolumeEcShardsCopy(
+        vs.VolumeEcShardsCopyRequest(
+            volume_id=vid, collection=coll, shard_ids=[sid],
+            copy_ecx_file=True, copy_ecj_file=True, copy_vif_file=True,
+            copy_from_data_node=_node_grpc(source),
+        )
+    )
+    tgt.VolumeEcShardsMount(
+        vs.VolumeEcShardsMountRequest(
+            volume_id=vid, collection=coll, shard_ids=[sid])
+    )
+    src = env.volume_server(_node_grpc(source))
+    src.VolumeEcShardsUnmount(
+        vs.VolumeEcShardsUnmountRequest(volume_id=vid, shard_ids=[sid])
+    )
+    src.VolumeEcShardsDelete(
+        vs.VolumeEcShardsDeleteRequest(
+            volume_id=vid, collection=coll, shard_ids=[sid])
+    )
+    return f"{vid}.{sid} {source} -> {target}"
+
+
+@register("ec.balance")
+def ec_balance(env: CommandEnv, args: list[str]) -> str:
+    """Move shards from loaded nodes to nodes with more free EC slots.
+
+    ec.balance [-apply] [-collection=NAME]  — default is a DRY RUN that
+    prints the planned moves; -apply (or the legacy -force) executes
+    them (command_ec_balance.go)."""
+    flags = _parse_flags(args)
+    apply_changes = "apply" in flags or "force" in flags
+    collection = flags.get("collection", "")
+    moves = plan_ec_balance_moves(env.topology(), collection)
+    if not moves:
+        return "ec.balance: balanced"
+    lines = [f"ec.balance: {len(moves)} move(s) planned"]
+    for mv in moves:
+        lines.append(
+            f"  {mv['volumeId']}.{mv['shardId']} {mv['source']} -> "
+            f"{mv['target']}"
+            + ("" if apply_changes else " (dry run, -apply to move)"))
+    if not apply_changes:
+        return "\n".join(lines)
+    for mv in moves:
+        try:
+            lines.append(apply_ec_move(env, mv))
+        except grpc.RpcError as e:
+            lines.append(f"  {mv['volumeId']}.{mv['shardId']} FAILED: "
+                         f"{e.code()}")
+            break
+    return "\n".join(lines)
 
 
 @register("ec.decode")

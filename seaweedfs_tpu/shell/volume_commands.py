@@ -353,50 +353,101 @@ def _free_slots(dn) -> int:
     return free
 
 
-@register("volume.balance")
-def volume_balance(env: CommandEnv, args: list[str]) -> str:
-    """Even out volume counts across nodes (greedy, like the reference)."""
-    topo = env.topology()
+def plan_volume_balance_moves(topo) -> list[dict]:
+    """Pure move planning (tier-3 testable, shared with the lifecycle
+    controller's rebalance jobs): greedy donor->recipient moves that even
+    out per-node volume counts, computed from ONE topology snapshot.
+    A target already holding a replica of the volume is never picked —
+    the copy would overwrite it and the source delete would silently
+    drop the cluster one replica short — and among a donor's movable
+    volumes, one whose REMAINING replicas sit outside the target's rack
+    is preferred, so rebalance restores rack diversity instead of
+    quietly collapsing a volume's replicas into one rack."""
     nodes = {dn.id: dn for _dc, _rack, dn in _iter_nodes(topo)}
+    racks = {dn.id: (dc, rack) for dc, rack, dn in _iter_nodes(topo)}
     counts = {
         nid: sum(d.volume_count for d in dn.disk_infos.values())
         for nid, dn in nodes.items()
     }
     if not counts:
-        return "volume.balance: no nodes"
-    moves = []
+        return []
+    holders: dict[int, set[str]] = {}
+    on_node: dict[str, list[int]] = {nid: [] for nid in nodes}
+    for _dc, _rack, dn in _iter_nodes(topo):
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                holders.setdefault(v.id, set()).add(dn.id)
+                on_node[dn.id].append(v.id)
+
+    def pick_vid(donor: str, target: str):
+        fallback = None
+        for v in on_node[donor]:
+            if target in holders.get(v, set()):
+                continue
+            sibling_racks = {racks[h] for h in holders.get(v, set())
+                             if h != donor and h in racks}
+            if racks.get(target) not in sibling_racks:
+                return v  # rack-diverse move: take it
+            if fallback is None:
+                fallback = v
+        return fallback
+
+    moves: list[dict] = []
     avg = sum(counts.values()) / len(counts)
     for nid in sorted(counts, key=counts.get, reverse=True):
         while counts[nid] > avg + 1:
             target = min(counts, key=counts.get)
             if counts[target] >= avg:
                 break
-            vid = _pick_volume_on(topo, nid)
+            vid = pick_vid(nid, target)
             if vid is None:
                 break
-            try:
-                run = volume_move(
-                    env,
-                    [f"-volumeId={vid}", f"-source={nid}",
-                     f"-target={target}"],
-                )
-                moves.append(run)
-                counts[nid] -= 1
-                counts[target] += 1
-                topo = env.topology()
-            except grpc.RpcError:
-                break
-    return "volume.balance: " + ("; ".join(moves) if moves else "balanced")
+            moves.append({"volumeId": vid, "source": nid,
+                          "target": target})
+            on_node[nid].remove(vid)
+            on_node[target].append(vid)
+            holders[vid].discard(nid)
+            holders[vid].add(target)
+            counts[nid] -= 1
+            counts[target] += 1
+    return moves
 
 
-def _pick_volume_on(topo, node_id: str):
-    for _dc, _rack, dn in _iter_nodes(topo):
-        if dn.id != node_id:
-            continue
-        for disk in dn.disk_infos.values():
-            for v in disk.volume_infos:
-                return v.id
-    return None
+def apply_volume_move(env: CommandEnv, move: dict) -> str:
+    """Execute one planned move (copy to target, delete from source)."""
+    return volume_move(env, [
+        f"-volumeId={move['volumeId']}",
+        f"-source={move['source']}",
+        f"-target={move['target']}",
+    ])
+
+
+@register("volume.balance")
+def volume_balance(env: CommandEnv, args: list[str]) -> str:
+    """Even out volume counts across nodes (greedy, like the reference).
+
+    volume.balance [-apply]  — default is a DRY RUN that prints the
+    planned moves; -apply (or the legacy -force) executes them.  The
+    lifecycle controller's rebalance jobs reuse the same planner."""
+    flags = _parse_flags(args)
+    apply_changes = "apply" in flags or "force" in flags
+    moves = plan_volume_balance_moves(env.topology())
+    if not moves:
+        return "volume.balance: balanced"
+    lines = [f"volume.balance: {len(moves)} move(s) planned"]
+    for mv in moves:
+        lines.append(f"  v{mv['volumeId']} {mv['source']} -> {mv['target']}"
+                     + ("" if apply_changes
+                        else " (dry run, -apply to move)"))
+    if not apply_changes:
+        return "\n".join(lines)
+    for mv in moves:
+        try:
+            lines.append(apply_volume_move(env, mv))
+        except (grpc.RpcError, RuntimeError) as e:
+            lines.append(f"  v{mv['volumeId']} FAILED: {e}")
+            break
+    return "\n".join(lines)
 
 
 @register("volume.evacuate")
@@ -707,6 +758,62 @@ def volume_tier_move(env: CommandEnv, args: list[str]) -> str:
         env.volume_server(_node_grpc(target)).VolumeMarkWritable(
             vs.VolumeMarkWritableRequest(volume_id=vid))
         lines.append(f"moved volume {vid} -> {target}")
+    return "\n".join(lines)
+
+
+@register("volume.lifecycle")
+def volume_lifecycle(env: CommandEnv, args: list[str]) -> str:
+    """Operate the master's lifecycle controller.
+
+    volume.lifecycle                      — controller status + job list
+    volume.lifecycle -dry-run [...]       — evaluate policies, print plan
+    volume.lifecycle -apply [...]         — evaluate AND execute now
+    volume.lifecycle -policy='<json>'     — install a policy set
+    Filters for -dry-run/-apply: -volumeId=N -transition=NAME."""
+    import json as _json
+
+    flags = _parse_flags(args)
+    if "policy" in flags:
+        resp = env.master().Lifecycle(master_pb2.LifecycleRequest(
+            action="policy", policy_json=flags["policy"]))
+        return "lifecycle policy updated:\n" + resp.report
+    if "apply" in flags or "dry-run" in flags or "run" in flags:
+        resp = env.master().Lifecycle(master_pb2.LifecycleRequest(
+            action="run",
+            apply="apply" in flags,
+            volume_id=int(flags.get("volumeId", "0") or 0),
+            transition=flags.get("transition", ""),
+        ))
+        doc = _json.loads(resp.report)
+        lines = []
+        planned = doc.get("planned", [])
+        lines.append(f"planned: {len(planned)} transition(s)"
+                     + ("" if "apply" in flags
+                        else " (dry run, -apply to execute)"))
+        for p in planned:
+            lines.append(
+                f"  v{p['volume_id']} {p['transition']}"
+                f" on {p.get('node', '?')} ({p.get('bytes', 0)} bytes)")
+        for r in doc.get("results", []):
+            lines.append(f"  {r.get('key')}: {r.get('state')}"
+                         + (f" — {r['detail']}" if r.get("detail") else "")
+                         + (f" — {r['error']}" if r.get("error") else ""))
+        return "\n".join(lines)
+    resp = env.master().Lifecycle(
+        master_pb2.LifecycleRequest(action="status"))
+    doc = _json.loads(resp.report)
+    lines = [
+        f"lifecycle: enabled={doc['enabled']} running={doc['running']}"
+        f" interval={doc['intervalSeconds']}s rate={doc['rateMBps']}MB/s",
+        f"journal: {doc['journalPath'] or '(memory only)'}"
+        f" states={doc['jobStates']}",
+        f"counts: {doc['counts']}",
+    ]
+    for j in doc.get("jobs", [])[-16:]:
+        lines.append(
+            f"  {j['key']}: {j['state']} attempts={j.get('attempts', 0)}"
+            + (f" — {j['detail']}" if j.get("detail") else "")
+            + (f" — {j['error']}" if j.get("error") else ""))
     return "\n".join(lines)
 
 

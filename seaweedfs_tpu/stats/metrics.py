@@ -596,6 +596,41 @@ VOLUME_UNDERREPLICATED = REGISTRY.gauge(
 )
 
 
+# -- storage lifecycle plane (maintenance/, ISSUE 9) ------------------------
+# the master-resident lifecycle controller turns per-collection policies
+# into journaled jobs: seal -> ec_encode -> tier -> vacuum -> rebalance ->
+# ttl_expire.  `jobs` counts job executions by outcome (ok | error |
+# parked | resumed), `transitions` counts completed volume state changes,
+# and bytes/seconds attribute the background I/O the shared token bucket
+# paces.
+
+LIFECYCLE_JOBS = REGISTRY.counter(
+    "seaweedfs_lifecycle_jobs_total",
+    "lifecycle job executions by transition and outcome",
+    labels=("transition", "result"),  # ok | error | parked | resumed
+)
+LIFECYCLE_BYTES = REGISTRY.counter(
+    "seaweedfs_lifecycle_bytes_total",
+    "bytes moved/processed by lifecycle jobs, by transition",
+    labels=("transition",),
+)
+LIFECYCLE_SECONDS = REGISTRY.histogram(
+    "seaweedfs_lifecycle_seconds",
+    "wall time per lifecycle job, throttle wait included",
+    labels=("transition",),
+    buckets=(0.01, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0),
+)
+LIFECYCLE_TRANSITIONS = REGISTRY.counter(
+    "seaweedfs_lifecycle_transitions_total",
+    "completed volume lifecycle transitions by result",
+    labels=("transition", "result"),  # ok | error
+)
+LIFECYCLE_QUEUE_DEPTH = REGISTRY.gauge(
+    "seaweedfs_lifecycle_queue_depth",
+    "lifecycle jobs journaled but not yet finished (pending + running)",
+)
+
+
 def serve_metrics(port: int, registry: Registry = REGISTRY,
                   host: str = "0.0.0.0") -> ThreadingHTTPServer:
     """Expose GET /metrics (Prometheus text) and GET /debug/traces (JSON)."""

@@ -323,6 +323,11 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
+        if v.is_remote or v._tier_in_progress:
+            # compacting would swap the .dat under a remote placement
+            # (or under an in-flight tier upload reading it by path)
+            raise ValueError(
+                f"volume {vid} is remote-tiered or tiering; not compactable")
         on_corrupt = None
         if self.scrubber is not None:
             # a needle the copy skipped as rotten leaves the compacted

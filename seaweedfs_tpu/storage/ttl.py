@@ -61,6 +61,25 @@ class TTL:
     def minutes(self) -> int:
         return self.count * _MINUTES_BY_UNIT.get(self.unit, 0)
 
+    def seconds(self) -> int:
+        return self.minutes() * 60
+
+    def expired(self, modified_at_second: float,
+                now: float | None = None) -> bool:
+        """Volume-granularity expiry (the lifecycle controller's
+        ttl_expire transition): a TTL volume whose last write is older
+        than the TTL is expired wholesale, like the reference's TTL
+        volume deletion."""
+        if self.count == 0 or self.unit == EMPTY:
+            return False
+        if modified_at_second <= 0:
+            return False  # never-written / unknown: do not expire
+        import time as _time
+
+        if now is None:
+            now = _time.time()
+        return now - modified_at_second > self.seconds()
+
     def __str__(self) -> str:
         if self.count == 0 or self.unit == EMPTY:
             return ""

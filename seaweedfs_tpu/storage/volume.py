@@ -60,6 +60,7 @@ class Volume:
         self.volume_id = volume_id
         self.disk_type = ""  # normalized; "" == hdd (set by DiskLocation)
         self.read_only = False
+        self._tier_in_progress = False
         self._lock = threading.RLock()
         # bumped on every append/delete (and fresh on vacuum re-init):
         # the needle cache's compare-before-put token (store.py)
@@ -246,38 +247,55 @@ class Volume:
                        progress=None) -> int:
         """Upload the .dat to a remote tier, record it in the .vif, and
         reopen through the remote file (volume.tier.upload;
-        volume_grpc_tier.go).  Returns bytes uploaded."""
+        volume_grpc_tier.go).  Returns bytes uploaded.
+
+        The upload itself runs OUTSIDE the volume lock: the volume is
+        read-only and the .dat append-only, so the bytes are immutable
+        while they move — reads keep being served throughout, which
+        matters when a throttled lifecycle tier job paces the upload
+        over many seconds (the progress callback is the token-bucket
+        hook)."""
         backend = get_backend(backend_name)
         if backend is None:
             raise IOError(f"backend {backend_name} not configured")
         with self._lock:
             if self.is_remote:
                 raise IOError(f"volume {self.volume_id} is already remote")
+            if self._tier_in_progress:
+                raise IOError(
+                    f"volume {self.volume_id}: tier move already running")
+            self._tier_in_progress = True
             self.read_only = True  # no appends while the bytes move
             self._dat.sync()
             base = self.file_name()
             key = f"{os.path.basename(base)}.dat"
             size = self._dat.file_size()
+        try:
             backend.upload_file(base + ".dat", key, progress=progress)
-            save_volume_info(
-                base + ".vif", self.version,
-                replication=str(self.super_block.replica_placement or ""),
-                dat_file_size=size,
-                remote_files=[{
-                    "backend_type": backend.backend_type,
-                    "backend_id": backend.backend_id,
-                    "key": key,
-                    "file_size": size,
-                    "modified_time": int(time.time()),
-                    "extension": ".dat",
-                }],
-            )
-            self.volume_info = load_volume_info(base + ".vif")
-            self._dat.close()
-            self._dat = backend.remote_file(key, size)
-            if not keep_local:
-                os.remove(base + ".dat")
-            return size
+            with self._lock:
+                save_volume_info(
+                    base + ".vif", self.version,
+                    replication=str(
+                        self.super_block.replica_placement or ""),
+                    dat_file_size=size,
+                    remote_files=[{
+                        "backend_type": backend.backend_type,
+                        "backend_id": backend.backend_id,
+                        "key": key,
+                        "file_size": size,
+                        "modified_time": int(time.time()),
+                        "extension": ".dat",
+                    }],
+                )
+                self.volume_info = load_volume_info(base + ".vif")
+                self._dat.close()
+                self._dat = backend.remote_file(key, size)
+                if not keep_local:
+                    os.remove(base + ".dat")
+                return size
+        finally:
+            with self._lock:
+                self._tier_in_progress = False
 
     def tier_to_local(self, progress=None) -> int:
         """Download the .dat back from its remote tier and reopen locally

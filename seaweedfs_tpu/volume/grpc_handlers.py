@@ -533,17 +533,24 @@ class VolumeGrpcService:
     def VolumeTierMoveDatToRemote(self, request, context):
         """Stream-upload a volume's .dat to the named remote tier backend
         and record it in the .vif (volume_grpc_tier.go; shell command
-        volume.tier.upload).  Progress is streamed back per part."""
+        volume.tier.upload).  Progress is streamed back per part, and
+        every uploaded byte is charged to the node's shared background
+        bucket (the scrubber's) so a tier move and a scrub pass together
+        stay within one budget."""
         v = self.store.find_volume(request.volume_id)
         if v is None:
             context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
         total = max(v.content_size, 1)
         sent: list[int] = [0]
         updates = []
+        scrubber = getattr(self.server, "scrubber", None)
 
         def progress(n):
+            delta = n - sent[0]
             sent[0] = n
             updates.append(n)
+            if scrubber is not None:
+                scrubber.throttle_background(delta)
 
         try:
             v.tier_to_remote(

@@ -259,6 +259,11 @@ class VolumeServer:
         for resp in stub.SendHeartbeat(requests()):
             if resp.volume_size_limit:
                 self.store.volume_size_limit = resp.volume_size_limit
+            # the cluster's shared background-I/O budget: scrub and
+            # lifecycle tier traffic drain one per-node bucket; a push
+            # of 0 WITHDRAWS a previously adopted budget (restores the
+            # node's local default), so it must reach the scrubber too
+            self.scrubber.set_shared_rate(resp.lifecycle_rate_mbps)
             if resp.leader_grpc and resp.leader_grpc != master:
                 self.current_leader = resp.leader_grpc
                 raise grpc.RpcError()  # reconnect to leader

@@ -2,6 +2,9 @@
 
 from __future__ import annotations
 
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
 import numpy as np
 
 from seaweedfs_tpu.storage import Needle, SuperBlock
@@ -35,6 +38,63 @@ def make_volume(
             n.mime = b"application/octet-stream"
         vol.append_needle(n)
     return vol
+
+
+class S3StubHandler(BaseHTTPRequestHandler):
+    """Minimal unsigned S3 endpoint: PUT/GET(Range)/DELETE over an
+    in-memory dict — enough surface for the remote-tier backend without
+    spinning a whole gateway cluster.  Use `start_s3_stub()`."""
+
+    protocol_version = "HTTP/1.1"
+    objects: dict[str, bytes] = {}
+    range_reads = 0
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _reply(self, code, body=b"", headers=()):
+        self.send_response(code)
+        for k, v in headers:
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        self.objects[self.path] = self.rfile.read(length)
+        self._reply(200, headers=[("ETag", '"stub"')])
+
+    def do_GET(self):
+        blob = self.objects.get(self.path)
+        if blob is None:
+            return self._reply(404)
+        rng = self.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            type(self).range_reads += 1
+            lo, _, hi = rng[len("bytes="):].partition("-")
+            lo = int(lo)
+            hi = int(hi) if hi else len(blob) - 1
+            part = blob[lo:hi + 1]
+            return self._reply(206, part, headers=[(
+                "Content-Range", f"bytes {lo}-{hi}/{len(blob)}")])
+        self._reply(200, blob)
+
+    def do_DELETE(self):
+        self.objects.pop(self.path, None)
+        self._reply(204)
+
+
+def start_s3_stub():
+    """-> (httpd, handler_class).  handler_class.objects is the live
+    object dict ('/bucket/key' -> bytes); handler_class.range_reads
+    counts ranged GETs.  Caller shuts down via httpd.shutdown()."""
+    handler = type("BoundS3Stub", (S3StubHandler,),
+                   {"objects": {}, "range_reads": 0})
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, handler
 
 
 _used_ports: set[int] = set()

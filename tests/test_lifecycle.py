@@ -1,0 +1,594 @@
+"""Lifecycle plane units (ISSUE 9): policy parsing, the crash-safe job
+journal, controller planning against fake topology state, TTL expiry
+wiring, and the pure balance-move planners the shell and the controller
+share.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from helpers import free_port
+
+from seaweedfs_tpu.maintenance import JobJournal, PolicySet
+from seaweedfs_tpu.maintenance.journal import job_key
+from seaweedfs_tpu.pb import master_pb2
+from seaweedfs_tpu.storage.ttl import TTL
+from seaweedfs_tpu.topology.topology import DataNode, VolumeInfo
+from seaweedfs_tpu.util import faultpoint
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+
+def test_policy_defaults():
+    p = PolicySet()
+    pol = p.for_collection("anything")
+    assert pol.seal_full_percent == 95.0
+    assert pol.ec_cooldown_seconds < 0  # EC disabled by default
+    assert pol.tier_backend == ""
+    assert pol.vacuum_garbage_ratio == 0.3
+    assert pol.ttl_expire
+
+
+def test_policy_per_collection_override():
+    p = PolicySet.parse({
+        "*": {"seal_full_percent": 80},
+        "photos": {"ec_cooldown_seconds": 10, "tier_backend": "s3.cold"},
+    })
+    assert p.for_collection("photos").ec_cooldown_seconds == 10
+    assert p.for_collection("photos").tier_backend == "s3.cold"
+    # photos does NOT inherit the '*' seal override (whole-policy wins)
+    assert p.for_collection("other").seal_full_percent == 80
+
+
+def test_policy_rejects_unknown_fields():
+    with pytest.raises(ValueError, match="unknown lifecycle policy"):
+        PolicySet.parse({"*": {"not_a_field": 1}})
+    with pytest.raises(ValueError):
+        PolicySet.parse({"*": "not an object"})
+
+
+def test_policy_parse_string_and_roundtrip():
+    p = PolicySet.parse('{"*": {"rebalance_skew": 2}}')
+    assert p.for_collection("x").rebalance_skew == 2
+    again = PolicySet.parse(p.dumps())
+    assert again.to_dict() == p.to_dict()
+
+
+# ---------------------------------------------------------------------------
+# TTL expiry helper (satellite: ttl.py wired into the lifecycle)
+# ---------------------------------------------------------------------------
+
+
+def test_ttl_seconds_and_expired():
+    t = TTL.parse("3m")
+    assert t.seconds() == 180
+    now = time.time()
+    assert t.expired(now - 181, now=now)
+    assert not t.expired(now - 60, now=now)
+    # empty TTL never expires, nor does an unknown modified time
+    assert not TTL().expired(now - 10**9, now=now)
+    assert not t.expired(0, now=now)
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+
+def _mk_job(vid, transition, state="pending", **extra):
+    return {"key": job_key(vid, transition), "volume_id": vid,
+            "transition": transition, "state": state,
+            "created_ms": int(time.time() * 1000), "attempts": 0, **extra}
+
+
+def test_journal_roundtrip_and_replay(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = JobJournal(path)
+    j.put(_mk_job(1, "seal"))
+    j.put(_mk_job(2, "ec_encode"))
+    j.update(job_key(1, "seal"), state="done")
+    j.update(job_key(2, "ec_encode"), state="running")
+
+    j2 = JobJournal(path)
+    assert j2.get(job_key(1, "seal"))["state"] == "done"
+    # running replays as pending (idempotent RPCs, safe to re-run) and
+    # is flagged resumed
+    rec = j2.get(job_key(2, "ec_encode"))
+    assert rec["state"] == "pending"
+    assert rec["resumed"] == 1
+    assert len(j2.active()) == 1
+
+
+def test_journal_memory_only_mode():
+    j = JobJournal(None)
+    j.put(_mk_job(1, "vacuum"))
+    assert j.get(job_key(1, "vacuum"))["state"] == "pending"
+    assert j.counts() == {"pending": 1}
+
+
+def test_journal_survives_torn_tail(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = JobJournal(path)
+    j.put(_mk_job(1, "seal"))
+    with open(path, "a") as f:
+        f.write('{"key": "2:seal", "state": "pe')  # torn write, no \n
+    j2 = JobJournal(path)
+    assert j2.get(job_key(1, "seal")) is not None
+    assert j2.get(job_key(2, "seal")) is None
+
+
+def test_journal_compaction_bounds_file(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = JobJournal(path)
+    j.COMPACT_SLACK = 8
+    j.put(_mk_job(1, "vacuum"))
+    for i in range(40):
+        j.update(job_key(1, "vacuum"),
+                 state="done" if i % 2 else "pending")
+    with open(path) as f:
+        lines = [ln for ln in f if ln.strip()]
+    assert len(lines) <= 10  # compacted to ~live keys, not 41 lines
+    assert JobJournal(path).get(job_key(1, "vacuum")) is not None
+
+
+def test_journal_write_fault_fails_loud(tmp_path):
+    j = JobJournal(str(tmp_path / "j.jsonl"))
+    faultpoint.set_fault("lifecycle.journal.write", "error", count=1)
+    try:
+        with pytest.raises(Exception):
+            j.put(_mk_job(1, "seal"))
+        # the failed put must not half-register the job
+        assert j.get(job_key(1, "seal")) is None
+    finally:
+        faultpoint.clear_fault("all")
+    j.put(_mk_job(1, "seal"))  # works once the fault is gone
+    assert j.get(job_key(1, "seal"))["state"] == "pending"
+
+
+# ---------------------------------------------------------------------------
+# controller planning (fake topology, no sockets)
+# ---------------------------------------------------------------------------
+
+
+def _mk_master(tmp_path=None, policy=None, **kw):
+    from seaweedfs_tpu.master.server import MasterServer
+
+    return MasterServer(
+        ip="127.0.0.1", port=free_port(), volume_size_limit_mb=1,
+        lifecycle_dir=str(tmp_path) if tmp_path else "",
+        lifecycle_policy=policy, **kw)
+
+
+def _add_node(master, nid, volumes, ec_vids=()):
+    n = DataNode(id=nid, public_url=nid,
+                 grpc_address=f"{nid.rsplit(':', 1)[0]}:"
+                              f"{int(nid.rsplit(':', 1)[1]) + 10000}")
+    n.volumes = volumes
+    n.ec_shards = {vid: 0x3FFF for vid in ec_vids}
+    master.topo.nodes[nid] = n
+    return n
+
+
+def test_evaluate_seal_vacuum_ttl(tmp_path):
+    m = _mk_master(tmp_path)
+    now = int(time.time())
+    _add_node(m, "127.0.0.1:9001", {
+        1: VolumeInfo(1, size=1 << 20, modified_at_second=now - 100),
+        2: VolumeInfo(2, size=500_000, deleted_byte_count=250_000,
+                      modified_at_second=now - 10),
+        3: VolumeInfo(3, size=1000, ttl=TTL.parse("1m").to_uint32(),
+                      modified_at_second=now - 7200),
+        4: VolumeInfo(4, size=10, modified_at_second=now - 5),  # healthy
+    })
+    plans = {p["key"]: p for p in m.lifecycle.evaluate()}
+    assert plans["1:seal"]["transition"] == "seal"
+    assert plans["2:vacuum"]["bytes"] == 500_000
+    assert "3:ttl_expire" in plans
+    assert not any(p["volume_id"] == 4 for p in plans.values())
+
+
+def test_evaluate_ec_cooldown_gate(tmp_path):
+    m = _mk_master(tmp_path, policy={"*": {"ec_cooldown_seconds": 300}})
+    now = int(time.time())
+    _add_node(m, "127.0.0.1:9001", {
+        1: VolumeInfo(1, size=1 << 19, read_only=True,
+                      modified_at_second=now - 100),   # too fresh
+        2: VolumeInfo(2, size=1 << 19, read_only=True,
+                      modified_at_second=now - 400),   # cold enough
+    })
+    keys = {p["key"] for p in m.lifecycle.evaluate()}
+    assert "2:ec_encode" in keys
+    assert "1:ec_encode" not in keys
+
+
+def test_evaluate_tier_follows_ec_and_keeps_source(tmp_path):
+    m = _mk_master(tmp_path, policy={"*": {
+        "ec_cooldown_seconds": 0, "tier_backend": "s3.cold"}})
+    now = int(time.time())
+    _add_node(m, "127.0.0.1:9001", {
+        1: VolumeInfo(1, size=1 << 19, read_only=True,
+                      modified_at_second=now - 50),
+        2: VolumeInfo(2, size=1 << 19, read_only=True,
+                      modified_at_second=now - 50),
+    }, ec_vids=(2,))
+    plans = {p["key"]: p for p in m.lifecycle.evaluate()}
+    # v1 not yet encoded -> ec first, and the tier stage pins the source
+    assert plans["1:ec_encode"]["keep_source"] is True
+    # v2 already encoded -> its .dat tiers now
+    assert plans["2:tier"]["backend"] == "s3.cold"
+
+
+def test_evaluate_half_sealed_volume_replans_seal(tmp_path):
+    m = _mk_master(tmp_path)
+    now = int(time.time())
+    _add_node(m, "127.0.0.1:9001",
+              {1: VolumeInfo(1, size=1 << 20, read_only=True,
+                             modified_at_second=now - 10)})
+    _add_node(m, "127.0.0.1:9002",
+              {1: VolumeInfo(1, size=1 << 20, read_only=False,
+                             modified_at_second=now - 10)})
+    keys = {p["key"] for p in m.lifecycle.evaluate()}
+    assert "1:seal" in keys  # sealed means sealed on EVERY replica
+
+
+def test_submit_dedups_and_serializes_per_volume(tmp_path):
+    m = _mk_master(tmp_path)
+    now = int(time.time())
+    _add_node(m, "127.0.0.1:9001", {
+        1: VolumeInfo(1, size=1 << 20, deleted_byte_count=900_000,
+                      modified_at_second=now - 100),
+    })
+    plans = m.lifecycle.evaluate()
+    accepted = m.lifecycle.submit(plans)
+    assert [j["key"] for j in accepted] == ["1:seal"]
+    # same plan again: active job suppresses the duplicate; and a
+    # second transition for the same volume is serialized behind it
+    assert m.lifecycle.submit(plans) == []
+    assert m.lifecycle.submit([
+        {"key": "1:vacuum", "volume_id": 1, "transition": "vacuum",
+         "collection": "", "node": "127.0.0.1:9001", "holders": [],
+         "bytes": 10},
+    ]) == []
+
+
+def test_submit_reissue_cooldown_for_vacuum(tmp_path):
+    m = _mk_master(tmp_path)
+    plan = {"key": "7:vacuum", "volume_id": 7, "transition": "vacuum",
+            "collection": "", "node": "n1", "holders": ["n1"],
+            "bytes": 10}
+    assert m.lifecycle.submit([plan])
+    m.lifecycle.journal.update("7:vacuum", state="done")
+    # freshly done: suppressed
+    assert m.lifecycle.submit([plan]) == []
+    # pretend it finished long ago (backdate under the journal lock —
+    # put() always re-stamps updated_ms): reissued
+    with m.lifecycle.journal._lock:
+        m.lifecycle.journal._jobs["7:vacuum"]["updated_ms"] = (
+            int(time.time() * 1000) - 10_000_000)
+    assert m.lifecycle.submit([plan])
+
+
+def test_failed_job_resubmit_preserves_attempts_then_parks(tmp_path):
+    """A failing transition keeps its attempt counter across
+    re-submissions, so MAX_ATTEMPTS really parks it instead of retrying
+    forever with a fresh counter."""
+    m = _mk_master(tmp_path)
+    plan = {"key": "8:seal", "volume_id": 8, "transition": "seal",
+            "collection": "", "node": "127.0.0.1:9001",
+            "holders": ["127.0.0.1:9001"], "bytes": 0}
+    assert m.lifecycle.submit([plan])
+    m.lifecycle.journal.update("8:seal", state="failed", attempts=2)
+    accepted = m.lifecycle.submit([plan])
+    assert accepted and accepted[0]["attempts"] == 2  # preserved
+    # no volume server behind 9001: the 3rd attempt fails -> parked
+    res = m.lifecycle.run_pending(wait=True)
+    assert res and res[0]["state"] == "parked", res
+    assert m.lifecycle.journal.get("8:seal")["attempts"] == 3
+    # parked jobs are never resubmitted
+    assert m.lifecycle.submit([plan]) == []
+
+
+def test_run_pending_scoped_by_keys(tmp_path):
+    m = _mk_master(tmp_path)
+    for vid in (31, 32):
+        m.lifecycle.submit([
+            {"key": f"{vid}:seal", "volume_id": vid,
+             "transition": "seal", "collection": "",
+             "node": "127.0.0.1:9001", "holders": ["127.0.0.1:9001"],
+             "bytes": 0}])
+    res = m.lifecycle.run_pending(wait=True, keys={"31:seal"})
+    assert [r["key"] for r in res] == ["31:seal"]
+    # the unscoped job is untouched
+    assert m.lifecycle.journal.get("32:seal")["state"] == "pending"
+
+
+def test_done_seal_never_reissued(tmp_path):
+    m = _mk_master(tmp_path)
+    plan = {"key": "9:tier", "volume_id": 9, "transition": "tier",
+            "collection": "", "node": "n1", "holders": ["n1"],
+            "bytes": 10, "backend": "s3.x"}
+    assert m.lifecycle.submit([plan])
+    m.lifecycle.journal.update("9:tier", state="done")
+    rec = m.lifecycle.journal.get("9:tier")
+    rec["updated_ms"] = 0  # even "long ago" done tier stays done
+    m.lifecycle.journal.put(rec)
+    assert m.lifecycle.submit([plan]) == []
+
+
+def test_journal_replay_resumes_into_controller(tmp_path):
+    m = _mk_master(tmp_path)
+    m.lifecycle.submit([
+        {"key": "5:ec_encode", "volume_id": 5, "transition": "ec_encode",
+         "collection": "", "node": "n1", "holders": ["n1"], "bytes": 10},
+    ])
+    m.lifecycle.journal.update("5:ec_encode", state="running")
+    # new controller over the same dir (a restarted master)
+    m2 = _mk_master(tmp_path)
+    active = m2.lifecycle.journal.active()
+    assert [j["key"] for j in active] == ["5:ec_encode"]
+    assert active[0]["state"] == "pending"
+
+
+def test_status_shape(tmp_path):
+    m = _mk_master(tmp_path)
+    st = m.lifecycle.status()
+    assert st["enabled"] is False
+    assert "policies" in st and "*" in st["policies"]
+    assert st["journalPath"].endswith("lifecycle.journal.jsonl")
+
+
+def test_vacuum_plan_carries_policy_ratio(tmp_path):
+    """Execution must gate on the POLICY's garbage ratio, not the
+    master's global default — otherwise a 0.1 policy against the 0.3
+    default plans forever and compacts never."""
+    m = _mk_master(tmp_path, policy={"*": {"vacuum_garbage_ratio": 0.1}})
+    now = int(time.time())
+    _add_node(m, "127.0.0.1:9001", {
+        2: VolumeInfo(2, size=500_000, deleted_byte_count=100_000,
+                      modified_at_second=now - 10),  # 20% garbage
+    })
+    plans = {p["key"]: p for p in m.lifecycle.evaluate()}
+    assert plans["2:vacuum"]["ratio"] == 0.1
+
+
+def test_master_vacuum_skips_read_only_volumes(tmp_path):
+    """Sealed volumes are EC/tier candidates; a vacuum commit racing a
+    tier upload would swap the .dat mid-transfer, so read-only volumes
+    are exempt from the vacuum sweep (reference behavior)."""
+    m = _mk_master(tmp_path)
+    now = int(time.time())
+    _add_node(m, "127.0.0.1:9001", {
+        3: VolumeInfo(3, size=100, deleted_byte_count=90, read_only=True,
+                      modified_at_second=now - 10),
+    })
+    assert m.vacuum_volume(3, threshold=0.1) is False
+
+
+def test_ttl_expire_with_no_live_holder_fails_not_done(tmp_path):
+    """ttl_expire is done-forever once journaled: succeeding vacuously
+    while every holder is offline would retain expired data for good."""
+    m = _mk_master(tmp_path)
+    assert m.lifecycle.submit([
+        {"key": "6:ttl_expire", "volume_id": 6,
+         "transition": "ttl_expire", "collection": "",
+         "node": "127.0.0.1:9001", "holders": ["127.0.0.1:9001"],
+         "bytes": 0}])
+    res = m.lifecycle.run_pending(wait=True)
+    assert res and res[0]["state"] == "failed", res
+    assert "no live holder" in m.lifecycle.journal.get(
+        "6:ttl_expire")["error"]
+
+
+def test_shared_budget_withdrawable(tmp_path):
+    """A master push of 0 restores the node's local scrub default
+    instead of latching a stale cluster budget forever."""
+    from seaweedfs_tpu.storage.scrub import Scrubber
+    from seaweedfs_tpu.storage.store import Store
+
+    store = Store([str(tmp_path)], needle_cache_mb=0)
+    s = Scrubber(store, rate_mbps=4, interval_s=9999)
+    local = s.bucket.rate
+    s.set_shared_rate(2.0)
+    assert s.bucket.rate == 2.0 * (1 << 20)
+    assert s._shared_budget
+    s.throttle_background(1)  # charges while the budget is active
+    s.set_shared_rate(0.0)
+    assert s.bucket.rate == local
+    assert not s._shared_budget
+    store.close()
+
+
+def test_compact_refuses_remote_or_tiering_volume(tmp_path):
+    from helpers import start_s3_stub
+
+    from seaweedfs_tpu.storage.backend_s3 import make_s3_backend
+    from seaweedfs_tpu.storage.store import Store
+
+    stub, _handler = start_s3_stub()
+    try:
+        endpoint = f"http://127.0.0.1:{stub.server_address[1]}"
+        make_s3_backend("vacrt", {"endpoint": endpoint, "bucket": "b"})
+        from helpers import make_volume
+
+        make_volume(str(tmp_path), volume_id=23, n_needles=5).close()
+        store = Store([str(tmp_path)], needle_cache_mb=0)
+        v = store.find_volume(23)
+        v.tier_to_remote("s3.vacrt")
+        with pytest.raises(ValueError, match="remote-tiered or tiering"):
+            store.compact_volume(23)
+        store.close()
+    finally:
+        stub.shutdown()
+        stub.server_close()
+
+
+# ---------------------------------------------------------------------------
+# pure balance planners (satellite: shared shell/controller planning)
+# ---------------------------------------------------------------------------
+
+
+def _topo(node_vols: dict[str, list[int]],
+          max_count: int = 10) -> master_pb2.TopologyInfo:
+    info = master_pb2.TopologyInfo(id="topo")
+    dc = info.data_center_infos.add(id="dc1")
+    rack = dc.rack_infos.add(id="r1")
+    for nid, vids in node_vols.items():
+        dn = rack.data_node_infos.add(id=nid)
+        disk = dn.disk_infos[""]
+        disk.volume_count = len(vids)
+        disk.max_volume_count = max_count
+        for vid in vids:
+            disk.volume_infos.add(id=vid, size=10)
+    return info
+
+
+def test_plan_volume_balance_moves_evens_counts():
+    from seaweedfs_tpu.shell.volume_commands import (
+        plan_volume_balance_moves,
+    )
+
+    moves = plan_volume_balance_moves(_topo({
+        "n1:80": [1, 2, 3, 4, 5, 6], "n2:80": [], "n3:80": [7],
+    }))
+    assert moves, "skewed cluster must plan moves"
+    for mv in moves:
+        assert mv["source"] == "n1:80"
+    # model convergence: donor sheds down to ~avg+1
+    assert len(moves) >= 2
+
+
+def test_plan_volume_balance_skips_replica_holding_target():
+    from seaweedfs_tpu.shell.volume_commands import (
+        plan_volume_balance_moves,
+    )
+
+    # n2 already holds replicas of everything n1 has: no legal move
+    moves = plan_volume_balance_moves(_topo({
+        "n1:80": [1, 2, 3], "n2:80": [1, 2, 3], "n3:80": [],
+    }))
+    for mv in moves:
+        assert mv["target"] != "n2:80" or mv["volumeId"] not in (1, 2, 3)
+
+
+def test_plan_volume_balance_prefers_rack_diverse_move():
+    from seaweedfs_tpu.shell.volume_commands import (
+        plan_volume_balance_moves,
+    )
+
+    # two racks: donor n1 (r1) holds v1 (sibling replica on n3, which is
+    # in the TARGET's rack r2) and v2 (sibling on n4 in r1).  Moving v2
+    # to the r2 target adds rack diversity; moving v1 would stack both
+    # of its replicas into r2.  The planner must prefer v2.
+    info = master_pb2.TopologyInfo(id="topo")
+    dc = info.data_center_infos.add(id="dc1")
+    r1 = dc.rack_infos.add(id="r1")
+    r2 = dc.rack_infos.add(id="r2")
+
+    def add(rack, nid, vids):
+        dn = rack.data_node_infos.add(id=nid)
+        disk = dn.disk_infos[""]
+        disk.volume_count = len(vids)
+        disk.max_volume_count = 10
+        for vid in vids:
+            disk.volume_infos.add(id=vid, size=10)
+
+    add(r1, "n1:80", [1, 2, 5, 6])
+    add(r2, "n2:80", [])          # the underloaded target
+    add(r2, "n3:80", [1, 5, 6])   # sibling of v1 already in r2
+    add(r1, "n4:80", [2, 7])      # sibling of v2 in r1
+    moves = plan_volume_balance_moves(info)
+    to_n2 = [mv for mv in moves if mv["target"] == "n2:80"]
+    assert to_n2, moves
+    assert to_n2[0]["volumeId"] == 2, moves
+
+
+def test_plan_volume_balance_balanced_is_empty():
+    from seaweedfs_tpu.shell.volume_commands import (
+        plan_volume_balance_moves,
+    )
+
+    assert plan_volume_balance_moves(_topo({
+        "n1:80": [1, 2], "n2:80": [3, 4],
+    })) == []
+    assert plan_volume_balance_moves(_topo({})) == []
+
+
+def test_plan_ec_balance_moves():
+    from seaweedfs_tpu.shell.ec_commands import plan_ec_balance_moves
+
+    info = master_pb2.TopologyInfo(id="topo")
+    dc = info.data_center_infos.add(id="dc1")
+    rack = dc.rack_infos.add(id="r1")
+    d1 = rack.data_node_infos.add(id="n1:80").disk_infos[""]
+    d1.max_volume_count = 10
+    d1.ec_shard_infos.add(id=5, ec_index_bits=0x3FFF)  # all 14 shards
+    d2 = rack.data_node_infos.add(id="n2:80").disk_infos[""]
+    d2.max_volume_count = 10
+    moves = plan_ec_balance_moves(info)
+    assert moves, "one node holding all 14 shards must shed"
+    assert all(mv["source"] == "n1:80" and mv["target"] == "n2:80"
+               for mv in moves)
+    sids = {mv["shardId"] for mv in moves}
+    assert len(sids) == len(moves), "each shard moved at most once"
+    # collection scoping filters everything out
+    assert plan_ec_balance_moves(info, collection="other") == []
+
+
+def test_rebalance_plans_from_controller(tmp_path):
+    m = _mk_master(tmp_path, policy={"*": {"rebalance_skew": 2,
+                                           "seal_full_percent": 0,
+                                           "vacuum_garbage_ratio": 0,
+                                           "ttl_expire": False}})
+    now = int(time.time())
+    vols = {i: VolumeInfo(i, size=100, modified_at_second=now - 5)
+            for i in range(1, 7)}
+    _add_node(m, "127.0.0.1:9001", vols)
+    _add_node(m, "127.0.0.1:9002", {})
+    plans = [p for p in m.lifecycle.evaluate()
+             if p["transition"] == "rebalance"]
+    assert plans, "6-0 skew with skew=2 must plan rebalance jobs"
+    for p in plans:
+        assert p["source"] == "127.0.0.1:9001"
+        assert p["target"] == "127.0.0.1:9002"
+
+
+def test_default_policy_plans_no_rebalance(tmp_path):
+    m = _mk_master(tmp_path)
+    now = int(time.time())
+    vols = {i: VolumeInfo(i, size=100, modified_at_second=now - 5)
+            for i in range(1, 7)}
+    _add_node(m, "127.0.0.1:9001", vols)
+    _add_node(m, "127.0.0.1:9002", {})
+    assert [p for p in m.lifecycle.evaluate()
+            if p["transition"] == "rebalance"] == []
+
+
+# ---------------------------------------------------------------------------
+# policy file persistence
+# ---------------------------------------------------------------------------
+
+
+def test_policy_file_persists_across_restart(tmp_path):
+    m = _mk_master(tmp_path)
+    m.lifecycle.set_policies({"*": {"rebalance_skew": 3}})
+    assert os.path.exists(str(tmp_path / "lifecycle.policy.json"))
+    m2 = _mk_master(tmp_path)
+    assert m2.lifecycle.policies.for_collection("x").rebalance_skew == 3
+
+
+def test_constructor_policy_overrides_file(tmp_path):
+    m = _mk_master(tmp_path)
+    m.lifecycle.set_policies({"*": {"rebalance_skew": 3}})
+    m2 = _mk_master(tmp_path, policy={"*": {"rebalance_skew": 5}})
+    assert m2.lifecycle.policies.for_collection("x").rebalance_skew == 5
+    # and the explicit policy becomes the persisted one
+    with open(str(tmp_path / "lifecycle.policy.json")) as f:
+        assert json.load(f)["*"]["rebalance_skew"] == 5

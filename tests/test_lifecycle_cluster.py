@@ -1,0 +1,602 @@
+"""Lifecycle plane acceptance (ISSUE 9): the full policy-driven
+seal -> EC-encode -> tier -> vacuum pipeline under concurrent client
+reads, the master-SIGKILL-mid-EC-encode journal resume, and the shared
+token-bucket throughput bound.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from helpers import free_port, make_volume, start_s3_stub
+
+from seaweedfs_tpu.storage.backend import BackendStorage, register_backend
+
+
+class _DirBackend(BackendStorage):
+    """Local-directory tier backend for the throughput test."""
+
+    def __init__(self, backend_id, directory):
+        super().__init__("dir", backend_id)
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _p(self, key):
+        return os.path.join(self.directory, key.replace("/", "_"))
+
+    def upload_file(self, local_path, key, progress=None):
+        shutil.copyfile(local_path, self._p(key))
+        size = os.path.getsize(local_path)
+        if progress:
+            progress(size)
+        return size
+
+    def download_file(self, key, local_path, progress=None):
+        shutil.copyfile(self._p(key), local_path)
+        return os.path.getsize(local_path)
+
+    def delete_file(self, key):
+        if os.path.exists(self._p(key)):
+            os.remove(self._p(key))
+
+    def read_range(self, key, offset, size):
+        with open(self._p(key), "rb") as f:
+            f.seek(offset)
+            return f.read(size)
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _http(method, url, data=None, headers=None, timeout=30.0):
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _put_needle(url: str, fid: str, payload: bytes) -> bool:
+    body = (b"--bb\r\nContent-Disposition: form-data; "
+            b'name="file"; filename="b.bin"\r\n\r\n'
+            + payload + b"\r\n--bb--\r\n")
+    code, _ = _http("POST", f"http://{url}/{fid}", data=body, headers={
+        "Content-Type": "multipart/form-data; boundary=bb"})
+    return code < 300
+
+
+def _derived_fids(base_fid: str, n: int) -> list[str]:
+    vid_s, _, rest = base_fid.partition(",")
+    base_key = int(rest[:-8], 16)
+    cookie = rest[-8:]
+    return [f"{vid_s},{base_key + i:x}{cookie}" for i in range(n)]
+
+
+def _assign(master_port: int) -> tuple[str, str]:
+    code, body = _http(
+        "GET", f"http://127.0.0.1:{master_port}/dir/assign")
+    assert code == 200, body
+    a = json.loads(body)
+    return a["fid"], a["url"]
+
+
+# ---------------------------------------------------------------------------
+# chaos: full pipeline under concurrent reads
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_pipeline_seal_ec_tier_vacuum_under_reads(tmp_path_factory):
+    """Fill a volume hot -> the controller seals it, EC-encodes it
+    (shards spread + mounted), tiers the .dat into the S3 stub, and
+    vacuums a garbage-heavy sibling — while concurrent client GETs stay
+    byte-identical with zero 5xx at every stage."""
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.storage.backend_s3 import make_s3_backend
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    stub, stub_handler = start_s3_stub()
+    stub_objects = stub_handler.objects
+    endpoint = f"http://127.0.0.1:{stub.server_address[1]}"
+    make_s3_backend("lifestub", {"endpoint": endpoint,
+                                 "bucket": "cold"})
+
+    jd = str(tmp_path_factory.mktemp("lifecycle-journal"))
+    master = MasterServer(
+        ip="127.0.0.1", port=free_port(), volume_size_limit_mb=4,
+        lifecycle_dir=jd,
+        lifecycle_policy={"*": {
+            "seal_full_percent": 10.0,
+            "ec_cooldown_seconds": 0.5,
+            "tier_backend": "s3.lifestub",
+            "tier_idle_seconds": 0.0,
+            "vacuum_garbage_ratio": 0.25,
+        }})
+    master.start()
+    vols = []
+    for i in range(2):
+        v = VolumeServer(
+            directories=[str(tmp_path_factory.mktemp(f"lcvol{i}"))],
+            master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+            ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+            max_volume_count=16)
+        v.start()
+        vols.append(v)
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and len(master.topo.nodes) < 2:
+            time.sleep(0.1)
+
+        # seed the target volume past the seal threshold (~420KB)
+        rng = np.random.default_rng(3)
+        first_fid, url = _assign(master.port)
+        target_vid = int(first_fid.split(",")[0])
+        known: dict[str, bytes] = {}
+        for fid in _derived_fids(first_fid, 10):
+            payload = rng.integers(0, 256, 64 << 10).astype(
+                np.uint8).tobytes()
+            assert _put_needle(url, fid, payload)
+            known[fid] = payload
+
+        # garbage-heavy sibling: write 10, delete 8
+        g_base = None
+        for _ in range(30):
+            fid2, url2 = _assign(master.port)
+            if int(fid2.split(",")[0]) != target_vid:
+                g_base = (fid2, url2)
+                break
+        assert g_base is not None
+        g_vid = int(g_base[0].split(",")[0])
+        g_fids = _derived_fids(g_base[0], 10)
+        g_keep: dict[str, bytes] = {}
+        for i, fid2 in enumerate(g_fids):
+            payload = os.urandom(32 << 10)
+            assert _put_needle(g_base[1], fid2, payload)
+            if i >= 8:
+                g_keep[fid2] = payload
+        for fid2 in g_fids[:8]:
+            code, _ = _http("DELETE", f"http://{g_base[1]}/{fid2}")
+            assert code < 300
+
+        # concurrent readers: byte-identity + zero 5xx across ALL stages
+        stop = threading.Event()
+        errors: list[str] = []
+        reads = [0]
+
+        def reader():
+            items = list(known.items())
+            i = 0
+            while not stop.is_set():
+                fid, want = items[i % len(items)]
+                i += 1
+                code, body = _http("GET", f"http://{url}/{fid}",
+                                   timeout=15)
+                if code >= 500:
+                    errors.append(f"{fid}: {code}")
+                elif code == 200 and body != want:
+                    errors.append(f"{fid}: wrong bytes")
+                reads[0] += 1
+
+        threads = [threading.Thread(target=reader, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+
+        # drive the controller until the full pipeline lands
+        done: dict[str, str] = {}
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            master.lifecycle.run_once()
+            done = {j["key"]: j["state"]
+                    for j in master.lifecycle.journal.jobs(("done",))}
+            if (f"{target_vid}:tier" in done
+                    and f"{g_vid}:vacuum" in done):
+                break
+            time.sleep(0.5)
+        time.sleep(1.0)  # post-transition read traffic
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        assert f"{target_vid}:seal" in done, done
+        assert f"{target_vid}:ec_encode" in done, done
+        assert f"{target_vid}:tier" in done, done
+        assert f"{g_vid}:vacuum" in done, done
+        assert not errors, (
+            f"clients saw {len(errors)} errors over {reads[0]} reads: "
+            f"{errors[:5]}")
+        assert reads[0] > 0
+
+        # the .dat landed in the S3 stub and the holder serves remote
+        assert any(k.endswith(f"{target_vid}.dat") for k in stub_objects)
+        holder = next(v for v in vols
+                      if v.store.find_volume(target_vid) is not None)
+        assert holder.store.find_volume(target_vid).is_remote
+        # EC shards exist cluster-wide (the encode kept the source)
+        shard_map = master.topo.lookup_ec_shards(target_vid)
+        assert len(shard_map) == 14, sorted(shard_map)
+
+        # reads still byte-identical from the REMOTE tier + EC state
+        for fid, want in known.items():
+            code, body = _http("GET", f"http://{url}/{fid}")
+            assert code == 200 and body == want
+        # vacuumed sibling: survivors intact, deleted stay gone
+        for fid2, want in g_keep.items():
+            code, body = _http("GET", f"http://{g_base[1]}/{fid2}")
+            assert code == 200 and body == want
+        code, _ = _http("GET", f"http://{g_base[1]}/{g_fids[0]}")
+        assert code == 404
+        g_vol = None
+        for v in vols:
+            g_vol = g_vol or v.store.find_volume(g_vid)
+        assert g_vol is not None and g_vol.garbage_level() < 0.05
+
+        # operator surface: shell command + /cluster/lifecycle agree
+        from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+        env = CommandEnv(f"127.0.0.1:{master.grpc_port}")
+        out = run_command(env, "volume.lifecycle")
+        assert f"{target_vid}:tier: done" in out, out
+        code, body = _http(
+            "GET", f"http://127.0.0.1:{master.port}/cluster/lifecycle")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["jobStates"].get("done", 0) >= 4
+        code, body = _http(
+            "GET", f"http://127.0.0.1:{master.port}/cluster/status")
+        assert json.loads(body)["Lifecycle"]["jobStates"]
+    finally:
+        stop = locals().get("stop")
+        if stop is not None:
+            stop.set()
+        for v in vols:
+            v.stop()
+        master.stop()
+        stub.shutdown()
+        stub.server_close()
+
+
+@pytest.mark.chaos
+def test_chaos_ttl_expired_volume_deleted(tmp_path_factory):
+    """A TTL volume whose last write is older than its TTL is deleted
+    wholesale by the ttl_expire transition (storage/ttl.py enforced by
+    the controller, not just stored on the write path)."""
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.storage.super_block import SuperBlock
+    from seaweedfs_tpu.storage.ttl import TTL
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    vol_dir = str(tmp_path_factory.mktemp("ttlvol"))
+    v = make_volume(vol_dir, volume_id=21, n_needles=5)
+    v.close()
+    # stamp a 1-minute TTL in the super block and age the .dat 2 hours
+    sb = SuperBlock(ttl=TTL.parse("1m"))
+    with open(os.path.join(vol_dir, "21.dat"), "r+b") as f:
+        f.write(sb.to_bytes())
+    old = time.time() - 7200
+    os.utime(os.path.join(vol_dir, "21.dat"), (old, old))
+
+    jd = str(tmp_path_factory.mktemp("ttl-journal"))
+    master = MasterServer(ip="127.0.0.1", port=free_port(),
+                          volume_size_limit_mb=64, lifecycle_dir=jd)
+    master.start()
+    vs_ = VolumeServer(
+        directories=[vol_dir],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+        max_volume_count=16)
+    vs_.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and len(master.topo.nodes) < 1:
+            time.sleep(0.1)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            master.lifecycle.run_once()
+            if "21:ttl_expire" in {
+                    j["key"] for j in
+                    master.lifecycle.journal.jobs(("done",))}:
+                break
+            time.sleep(0.3)
+        assert "21:ttl_expire" in {
+            j["key"] for j in master.lifecycle.journal.jobs(("done",))}
+        assert vs_.store.find_volume(21) is None
+        assert not os.path.exists(os.path.join(vol_dir, "21.dat"))
+    finally:
+        vs_.stop()
+        master.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGKILL the master mid-EC-encode, journal resumes
+# ---------------------------------------------------------------------------
+
+
+def _spawn_master(mport, jd, policy_path, extra_env=None):
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": os.path.dirname(os.path.dirname(
+               os.path.abspath(__file__)))}
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", "master",
+         "-port", str(mport),
+         "-volumeSizeLimitMB", "4",
+         "-lifecycleInterval", "0.3",
+         "-lifecycleDir", jd,
+         "-lifecyclePolicy", policy_path],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, env=env)
+
+
+def _journal_jobs(jd) -> dict[str, dict]:
+    jobs: dict[str, dict] = {}
+    try:
+        with open(os.path.join(jd, "lifecycle.journal.jsonl")) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if "key" in rec:
+                    jobs[rec["key"]] = rec
+    except FileNotFoundError:
+        pass
+    return jobs
+
+
+@pytest.mark.chaos
+def test_chaos_master_sigkill_mid_ec_encode_resumes(tmp_path_factory):
+    """SIGKILL the master while an ec_encode job is RUNNING (held open
+    by a delay fault): the restarted master replays the journal and
+    finishes the transition exactly once — no duplicate, no loss, and
+    every needle byte-identical through the EC-served volume."""
+    jd = str(tmp_path_factory.mktemp("kill-journal"))
+    policy_path = os.path.join(jd, "policy.json")
+    with open(policy_path, "w") as f:
+        json.dump({"*": {"seal_full_percent": 10.0,
+                         "ec_cooldown_seconds": 0.5,
+                         "vacuum_garbage_ratio": 0.0}}, f)
+    mport = free_port()
+    # every lifecycle job pauses 5s at lifecycle.job.run: the window in
+    # which the kill lands while ec_encode is journaled as RUNNING
+    master_proc = _spawn_master(
+        mport, jd, policy_path,
+        extra_env={"SEAWEEDFS_TPU_FAULTS": "lifecycle.job.run=delay:5"})
+
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    vols = []
+    second = None
+    try:
+        for i in range(2):
+            v = VolumeServer(
+                directories=[str(tmp_path_factory.mktemp(f"kvol{i}"))],
+                master_addresses=[f"127.0.0.1:{mport + 10000}"],
+                ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+                max_volume_count=16)
+            v.start()
+            vols.append(v)
+        # generous: the subprocess master pays the full interpreter +
+        # jax import tax, which stretches under a loaded CI host
+        deadline = time.time() + 90
+        first_fid = url = None
+        while time.time() < deadline and first_fid is None:
+            try:
+                first_fid, url = _assign(mport)
+            except (OSError, AssertionError):
+                time.sleep(0.3)
+        assert first_fid is not None, "master never came up"
+        target_vid = int(first_fid.split(",")[0])
+        rng = np.random.default_rng(11)
+        known: dict[str, bytes] = {}
+        for fid in _derived_fids(first_fid, 10):
+            payload = rng.integers(0, 256, 64 << 10).astype(
+                np.uint8).tobytes()
+            assert _put_needle(url, fid, payload)
+            known[fid] = payload
+
+        # wait for the ec_encode job to be journaled as RUNNING (the
+        # delay fault holds it there), then SIGKILL the master
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            rec = _journal_jobs(jd).get(f"{target_vid}:ec_encode")
+            if rec is not None and rec["state"] == "running":
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail(f"ec_encode never reached running: "
+                        f"{_journal_jobs(jd)}")
+        master_proc.kill()
+        master_proc.wait(timeout=10)
+
+        # restart WITHOUT the fault: the journal replays the running
+        # job as pending and the controller finishes it
+        second = _spawn_master(mport, jd, policy_path)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            rec = _journal_jobs(jd).get(f"{target_vid}:ec_encode")
+            if rec is not None and rec["state"] == "done":
+                break
+            time.sleep(0.3)
+        else:
+            pytest.fail(f"ec_encode never finished after restart: "
+                        f"{_journal_jobs(jd)}")
+        rec = _journal_jobs(jd)[f"{target_vid}:ec_encode"]
+        assert rec.get("resumed", 0) >= 1, rec
+
+        # exactly one transition: one ec_encode key, all 14 shards
+        # mounted exactly once across the cluster, source volume gone
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            bits = [v.store.status()["ec_volumes"].get(target_vid, [])
+                    for v in vols]
+            flat = [s for b in bits for s in b]
+            if (sorted(flat) == list(range(14))
+                    and all(v.store.find_volume(target_vid) is None
+                            for v in vols)):
+                break
+            time.sleep(0.3)
+        flat = [s for v in vols
+                for s in v.store.status()["ec_volumes"].get(
+                    target_vid, [])]
+        assert sorted(flat) == list(range(14)), (
+            f"shards duplicated or lost: {flat}")
+
+        # byte-identity through the EC-served reads
+        for fid, want in known.items():
+            code, body = _http("GET", f"http://{url}/{fid}", timeout=20)
+            assert code == 200 and body == want, (fid, code, len(body))
+    finally:
+        for v in vols:
+            v.stop()
+        for p in (master_proc, second):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# chaos: shared token bucket bounds lifecycle throughput
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_chaos_lifecycle_throughput_respects_token_bucket(
+        tmp_path_factory):
+    """Three ~1.5MB tier moves at a 2 MB/s budget: measured lifecycle
+    throughput stays within ~2x of the configured rate (the PR 8 scrub
+    bound) while a foreground read load keeps being served."""
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    tier_dir = str(tmp_path_factory.mktemp("tier-objects"))
+    register_backend(_DirBackend("lifethrottle", tier_dir))
+
+    vol_dir = str(tmp_path_factory.mktemp("tvol"))
+    sizes = {}
+    known = {}
+    for vid in (11, 12, 13):
+        v = make_volume(vol_dir, volume_id=vid, n_needles=76, seed=vid,
+                        max_size=20000, collection="cold")
+        known[vid] = {i: bytes(v.read_needle(i).data)
+                      for i in (1, 40, 76)}
+        sizes[vid] = v.content_size
+        v.close()
+    fg = make_volume(vol_dir, volume_id=14, n_needles=20, seed=99)
+    fg_want = {}
+    for i in range(1, 21):
+        n = fg.read_needle(i)
+        fg_want[f"14,{i:x}{n.cookie:08x}"] = bytes(n.data)
+    fg.close()
+
+    rate_mbps = 2.0
+    jd = str(tmp_path_factory.mktemp("throttle-journal"))
+    master = MasterServer(
+        ip="127.0.0.1", port=free_port(), volume_size_limit_mb=64,
+        lifecycle_dir=jd, lifecycle_rate_mbps=rate_mbps,
+        lifecycle_policy={
+            "*": {"seal_full_percent": 0.0, "vacuum_garbage_ratio": 0.0,
+                  "ttl_expire": False},
+            "cold": {"seal_full_percent": 0.0,
+                     "seal_age_seconds": 0.1,
+                     "tier_backend": "dir.lifethrottle",
+                     "tier_idle_seconds": 0.0,
+                     "vacuum_garbage_ratio": 0.0,
+                     "ttl_expire": False},
+        })
+    master.start()
+    vs_ = VolumeServer(
+        directories=[vol_dir],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+        max_volume_count=16)
+    vs_.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and len(master.topo.nodes) < 1:
+            time.sleep(0.1)
+        # wait until the node adopted the pushed shared budget
+        deadline = time.time() + 10
+        while (time.time() < deadline
+               and vs_.scrubber.bucket.rate != rate_mbps * (1 << 20)):
+            time.sleep(0.1)
+        assert vs_.scrubber.bucket.rate == rate_mbps * (1 << 20), (
+            "heartbeat ack never delivered the shared budget")
+
+        stop = threading.Event()
+        fg_errors: list[str] = []
+        fg_reads = [0]
+
+        fg_items = list(fg_want.items())
+
+        def fg_reader():
+            i = 0
+            while not stop.is_set():
+                fid, want = fg_items[i % len(fg_items)]
+                i += 1
+                code, body = _http(
+                    "GET", f"http://127.0.0.1:{vs_.port}/{fid}",
+                    timeout=15)
+                if code >= 500:
+                    fg_errors.append(f"{fid}: {code}")
+                elif code == 200 and body != want:
+                    fg_errors.append(f"{fid}: wrong bytes")
+                elif code != 200:
+                    fg_errors.append(f"{fid}: {code}")
+                fg_reads[0] += 1
+
+        t = threading.Thread(target=fg_reader, daemon=True)
+        t.start()
+
+        total = sum(sizes.values())
+        t0 = time.monotonic()
+        deadline = time.time() + 120
+        done: dict[str, str] = {}
+        while time.time() < deadline:
+            master.lifecycle.run_once()
+            done = {j["key"]: j["state"]
+                    for j in master.lifecycle.journal.jobs(("done",))}
+            if all(f"{vid}:tier" in done for vid in (11, 12, 13)):
+                break
+            time.sleep(0.2)
+        elapsed = time.monotonic() - t0
+        stop.set()
+        t.join(timeout=10)
+
+        assert all(f"{vid}:tier" in done for vid in (11, 12, 13)), done
+        measured = total / elapsed
+        budget = 2.0 * rate_mbps * (1 << 20)
+        burst_grace = 2 * rate_mbps * (1 << 20)  # master+node cold buckets
+        assert measured <= budget + burst_grace / elapsed, (
+            f"lifecycle moved {measured / (1 << 20):.2f} MB/s against a "
+            f"{rate_mbps} MB/s budget ({total} B in {elapsed:.2f}s)")
+        assert not fg_errors, fg_errors[:5]
+        assert fg_reads[0] > 0
+
+        # tiered volumes serve byte-identical from the remote backend
+        for vid, wants in known.items():
+            assert vs_.store.find_volume(vid).is_remote
+            for nid, want in wants.items():
+                assert bytes(
+                    vs_.store.read_needle(vid, nid).data) == want
+    finally:
+        vs_.stop()
+        master.stop()

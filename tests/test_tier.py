@@ -148,6 +148,73 @@ def test_volume_tier_keep_local(tmp_path):
     vol.close()
 
 
+def test_volume_tier_roundtrip_s3_stub(tmp_path):
+    """Volume.tier_to_remote/tier_to_local against the S3 backend stub:
+    signed-path-shaped HTTP all the way (PUT, ranged GET, DELETE)
+    without a whole gateway cluster — the lifecycle controller's tier
+    jobs drive exactly this surface (ISSUE 9 satellite: this round-trip
+    was previously only reachable through shell commands)."""
+    from helpers import start_s3_stub
+
+    from seaweedfs_tpu.storage.backend_s3 import make_s3_backend
+
+    stub, handler = start_s3_stub()
+    try:
+        endpoint = f"http://127.0.0.1:{stub.server_address[1]}"
+        make_s3_backend("stubrt", {"endpoint": endpoint,
+                                   "bucket": "tier-rt"})
+        vol = make_volume(str(tmp_path), volume_id=17, n_needles=30)
+        want = {i: vol.read_needle(i).data for i in range(1, 31)}
+        size = vol.tier_to_remote("s3.stubrt")
+        # keep_local defaults False: the local .dat is gone, the bytes
+        # live in the bucket
+        assert not os.path.exists(vol.file_name() + ".dat")
+        assert len(handler.objects["/tier-rt/17.dat"]) == size
+        # reads are served from the remote tier through ranged GETs
+        before = handler.range_reads
+        for i in (1, 15, 30):
+            assert vol.read_needle(i).data == want[i]
+        assert handler.range_reads > before
+        vol.close()
+
+        # a fresh load finds the remote placement via the .vif and the
+        # download brings it back local + deletes the remote object
+        vol2 = Volume(str(tmp_path), "", 17)
+        assert vol2.is_remote
+        got = vol2.tier_to_local()
+        assert got == size
+        assert "/tier-rt/17.dat" not in handler.objects
+        assert not vol2.is_remote and not vol2.read_only
+        for i in (2, 29):
+            assert vol2.read_needle(i).data == want[i]
+        vol2.close()
+    finally:
+        stub.shutdown()
+        stub.server_close()
+
+
+def test_volume_tier_s3_stub_keep_local(tmp_path):
+    from helpers import start_s3_stub
+
+    from seaweedfs_tpu.storage.backend_s3 import make_s3_backend
+
+    stub, handler = start_s3_stub()
+    try:
+        endpoint = f"http://127.0.0.1:{stub.server_address[1]}"
+        make_s3_backend("stubkeep", {"endpoint": endpoint,
+                                     "bucket": "tier-keep"})
+        vol = make_volume(str(tmp_path), volume_id=18, n_needles=5)
+        want = vol.read_needle(3).data
+        vol.tier_to_remote("s3.stubkeep", keep_local=True)
+        assert os.path.exists(vol.file_name() + ".dat")
+        assert "/tier-keep/18.dat" in handler.objects
+        assert vol.read_needle(3).data == want
+        vol.close()
+    finally:
+        stub.shutdown()
+        stub.server_close()
+
+
 def test_unconfigured_backend_fails_loud(tmp_path):
     backend = DirBackend("gone", str(tmp_path / "tier"))
     register_backend(backend)
