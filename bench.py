@@ -409,9 +409,113 @@ def _rebuild_only_rates(codec_name: str | None = None) -> dict:
             if h.hexdigest() != digests[sid]:
                 return {"error": f"rebuilt shard {sid} not byte-identical"}
         result["rebuild_byte_identical"] = True
+
+        # ISSUE 10: partial-sum vs full-fetch A/B on ONE lost shard with
+        # all 10 sources remote — the wire-reduction headline, measured
+        # by the locality-labeled rebuild-ingress counters
+        ab = _rebuild_ab_rates(base, tmp, codec_name, slice_bytes,
+                               lose[0], digests[lose[0]])
+        result["rebuild_ab"] = ab
+        emit()
+        if not ab.get("byte_identical"):
+            result["error"] = "partial-sum A/B not byte-identical"
         return result
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _rebuild_ab_rates(src_base: str, tmp: str, codec_name: str,
+                      slice_bytes: int, lost: int, want_digest: str) -> dict:
+    """Rebuild one lost shard twice with ALL 10 sources remote — once
+    streaming full shard intervals, once through the partial-sum
+    protocol (10 sources on 10 fake nodes across 2 racks; one rack is
+    the rebuilder's, so exactly one combined partial crosses each rack
+    boundary and one arrives rack-locally).  Network-in per leg comes
+    from the seaweedfs_ec_rebuild_bytes_total{source=rack|dc} deltas;
+    byte-identity against the original shard digest gates the result."""
+    import hashlib
+    import os
+
+    from seaweedfs_tpu.stats.metrics import REGISTRY
+    from seaweedfs_tpu.storage.ec import partial as P
+    from seaweedfs_tpu.storage.ec.constants import TOTAL_SHARDS, to_ext
+    from seaweedfs_tpu.storage.ec.encoder import rebuild_ec_files
+
+    shard_size = os.path.getsize(src_base + to_ext(lost))
+
+    def counters() -> dict:
+        return {k: v for k, v in REGISTRY.snapshot_samples()
+                if "ec_rebuild_bytes" in k or "ec_partial" in k}
+
+    def delta(before: dict, after: dict, name: str) -> float:
+        return sum(after.get(k, 0.0) - before.get(k, 0.0)
+                   for k in after if k.startswith(name))
+
+    nodes, holders = {}, {}
+    for sid in range(TOTAL_SHARDS):
+        if sid == lost:
+            continue
+        addr = f"bench-src-{sid}:0"
+        nodes[addr] = (src_base, [sid])
+        # rack0 == the rebuilder's rack, rack1 crosses the boundary
+        holders[sid] = [(addr, f"rack{sid % 2}", "dc1")]
+
+    def remote_fetch(sid, off, length):
+        if sid == lost:
+            return None
+        with open(src_base + to_ext(sid), "rb") as f:
+            f.seek(off)
+            return f.read(length)
+
+    remote_fetch.locality_of = (
+        lambda sid: "rack" if sid % 2 == 0 else "dc")
+
+    out: dict = {"lost_shard": lost, "shard_size": shard_size}
+    legs = {
+        "full": dict(remote_fetch=remote_fetch, shard_size=shard_size),
+        "partial": dict(
+            remote_fetch=remote_fetch,
+            partial=P.PartialRepairClient(
+                1, "", lambda: holders, P.local_source_network(nodes),
+                my_rack="rack0", my_dc="dc1")),
+    }
+    for leg, kw in legs.items():
+        rdir = os.path.join(tmp, f"ab-{leg}")
+        os.makedirs(rdir, exist_ok=True)
+        rbase = os.path.join(rdir, "1")
+        before = counters()
+        t0 = time.perf_counter()
+        rebuilt = rebuild_ec_files(rbase, codec_name=codec_name,
+                                   slice_size=slice_bytes, **kw)
+        dt = time.perf_counter() - t0
+        after = counters()
+        if rebuilt != [lost]:
+            return {"error": f"{leg} leg rebuilt {rebuilt}, want [{lost}]"}
+        h = hashlib.sha256()
+        with open(rbase + to_ext(lost), "rb") as f:
+            for chunk in iter(lambda: f.read(8 << 20), b""):
+                h.update(chunk)
+        if h.hexdigest() != want_digest:
+            return {"error": f"{leg} leg not byte-identical"}
+        rack_in = delta(before, after,
+                        'seaweedfs_ec_rebuild_bytes_total{source="rack"}')
+        dc_in = delta(before, after,
+                      'seaweedfs_ec_rebuild_bytes_total{source="dc"}')
+        out[leg] = {
+            "seconds": round(dt, 3),
+            "bytes_in": int(rack_in + dc_in),
+            "bytes_in_rack": int(rack_in),
+            "bytes_in_dc": int(dc_in),
+            "fallbacks": int(delta(
+                before, after, "seaweedfs_ec_partial_fallback_total")),
+        }
+    full_in = out["full"]["bytes_in"]
+    part_in = out["partial"]["bytes_in"]
+    out["wire_reduction"] = round(full_in / part_in, 2) if part_in else 0.0
+    out["bytes_in_per_rebuilt_shard"] = {
+        "full": full_in, "partial": part_in}
+    out["byte_identical"] = True
+    return out
 
 
 def _degraded_read_rate(n_needles: int = 600, needle_kb: int = 64,

@@ -237,7 +237,10 @@ class MasterGrpcService:
         for sid in sorted(shard_map):
             e = resp.shard_id_locations.add(shard_id=sid)
             for n in shard_map[sid]:
-                e.locations.add(url=n.id, public_url=n.public_url)
+                # rack/dc ride along so rebuilders can prefer same-rack
+                # sources and aggregate one cross-rack partial per rack
+                e.locations.add(url=n.id, public_url=n.public_url,
+                                data_center=n.data_center, rack=n.rack)
         return resp
 
     # -- cluster info -----------------------------------------------------

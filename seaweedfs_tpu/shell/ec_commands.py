@@ -224,13 +224,25 @@ def do_ec_encode(env: CommandEnv, topo, vid: int, collection: str,
 
 @register("ec.rebuild")
 def ec_rebuild(env: CommandEnv, args: list[str]) -> str:
+    """ec.rebuild [-plan] [-gather] [-codec=NAME]
+
+    Default: the rebuilder regenerates missing shards IN PLACE, sourcing
+    remote intervals through the partial-sum protocol (or full interval
+    streams when partials are unavailable) — no shard files are staged.
+    `-gather` restores the legacy copy-everything-first flow.  `-plan`
+    is a DRY RUN: print the chosen sources per lost shard with rack/DC
+    and the expected bytes over each hop, touch nothing."""
     flags = _parse_flags(args)
     codec = flags.get("codec", "")
+    plan_only = "plan" in flags
+    gather = "gather" in flags
     topo = env.topology()
+    node_locality: dict[str, tuple[str, str]] = {}
     # vid -> {node_id: bits}
     holdings: dict[int, dict[str, ShardBits]] = {}
     collections: dict[int, str] = {}
-    for _dc, _rack, dn in _iter_nodes(topo):
+    for dc, rack, dn in _iter_nodes(topo):
+        node_locality[dn.id] = (rack, dc)
         for disk in dn.disk_infos.values():
             for e in disk.ec_shard_infos:
                 holdings.setdefault(e.id, {})[dn.id] = ShardBits(e.ec_index_bits)
@@ -246,33 +258,134 @@ def ec_rebuild(env: CommandEnv, args: list[str]) -> str:
         if count < 10:
             out.append(f"ec.rebuild {vid}: unrepairable ({count} shards)")
             continue
-        out.append(_rebuild_one(
-            env, vid, collections.get(vid, ""), by_node, have, codec))
+        if plan_only:
+            out.append(_plan_one(
+                env, vid, by_node, have, node_locality))
+        else:
+            out.append(_rebuild_one(
+                env, vid, collections.get(vid, ""), by_node, have, codec,
+                gather=gather))
     return "\n".join(out) if out else "ec.rebuild: nothing to do"
+
+
+def _rebuild_plan(vid: int, by_node: dict[str, ShardBits], have: ShardBits,
+                  node_locality: dict[str, tuple[str, str]]) -> dict:
+    """Pure planning for one volume's partial-sum rebuild (tier-3
+    testable): rebuilder, lost shards, locality-ordered sources, and the
+    per-rack aggregation groups the protocol will form."""
+    from ..topology.placement import (
+        best_ec_holder,
+        group_partial_sources,
+        order_ec_sources,
+    )
+
+    rebuilder = max(by_node, key=lambda n: by_node[n].count())
+    my_rack, my_dc = node_locality.get(rebuilder, ("", ""))
+    local = sorted(by_node[rebuilder].shard_ids())
+    lost = [s for s in range(TOTAL_SHARDS) if not have.has(s)]
+    # best holder per non-local shard: same-rack holders win
+    candidates: dict[int, list[tuple[str, str, str]]] = {}
+    for node, bits in by_node.items():
+        if node == rebuilder:
+            continue
+        rack, dc = node_locality.get(node, ("", ""))
+        for sid in bits.shard_ids():
+            if sid not in local:
+                candidates.setdefault(sid, []).append((node, rack, dc))
+    holders = {sid: best_ec_holder(cands, my_rack, my_dc)
+               for sid, cands in candidates.items()}
+    sources = local[:10]
+    chosen: dict[int, tuple[str, str, str]] = {}
+    for sid in order_ec_sources(holders, my_rack, my_dc):
+        if len(sources) >= 10:
+            break
+        sources.append(sid)
+        chosen[sid] = holders[sid]
+    return {
+        "rebuilder": rebuilder,
+        "rebuilder_rack": my_rack,
+        "rebuilder_dc": my_dc,
+        "lost": lost,
+        "local_sources": sources[: len(sources) - len(chosen)],
+        "remote_sources": chosen,
+        "groups": group_partial_sources(chosen),
+    }
+
+
+def _plan_one(env: CommandEnv, vid: int, by_node: dict[str, ShardBits],
+              have: ShardBits,
+              node_locality: dict[str, tuple[str, str]]) -> str:
+    from ..storage.ec.partial import probe_shard_size
+    from ..topology.placement import ec_source_locality
+
+    plan = _rebuild_plan(vid, by_node, have, node_locality)
+    rebuilder = plan["rebuilder"]
+    m = len(plan["lost"])
+    try:
+        shard_size = probe_shard_size(
+            env.volume_server(_node_grpc(rebuilder)), vid)
+    except grpc.RpcError:
+        shard_size = 0
+
+    def mb(n: int) -> str:
+        return f"{n / 1e6:.1f} MB" if shard_size else f"{n}x shard"
+
+    unit = shard_size if shard_size else 1
+    lines = [
+        f"ec.rebuild {vid} (plan): lost {plan['lost']} -> rebuilder "
+        f"{rebuilder} ({plan['rebuilder_dc']}/{plan['rebuilder_rack']})"
+        + (f", shard {mb(unit)}" if shard_size else ""),
+        f"  local sources {plan['local_sources']}: 0 B over the wire",
+    ]
+    ingress = 0
+    for g in plan["groups"]:
+        label = ec_source_locality(
+            g["rack"], g["dc"], plan["rebuilder_rack"], plan["rebuilder_dc"])
+        member_s = " + ".join(
+            f"{addr}{sids}" for addr, sids in sorted(g["members"].items()))
+        intra = sum(len(s) for a, s in g["members"].items()
+                    if a != g["aggregator"])
+        lines.append(
+            f"  {label:4s} {g['dc']}/{g['rack']}: {member_s} -> agg "
+            f"{g['aggregator']}, {mb(m * unit)} to rebuilder"
+            + (f" (+{mb(m * unit * intra)} intra-rack)" if intra else ""))
+        ingress += m * unit
+    full = len(plan["remote_sources"]) * unit
+    if plan["remote_sources"]:
+        ratio = full / ingress if ingress else 0.0
+        lines.append(
+            f"  partial ingress {mb(ingress)} vs full fetch {mb(full)} "
+            f"({ratio:.1f}x)"
+            + ("" if ratio >= 1.0 else
+               " — full fetch preferred (rebuilder chooses it)"))
+    return "\n".join(lines)
 
 
 def _rebuild_one(env: CommandEnv, vid: int, collection: str,
                  by_node: dict[str, ShardBits], have: ShardBits,
-                 codec: str = "") -> str:
+                 codec: str = "", gather: bool = False) -> str:
     # rebuilder = node already holding the most shards
     rebuilder = max(by_node, key=lambda n: by_node[n].count())
     stub = env.volume_server(_node_grpc(rebuilder))
-    # pull every shard the rebuilder lacks
     local = by_node[rebuilder]
-    for node, bits in by_node.items():
-        if node == rebuilder:
-            continue
-        need = [s for s in bits.shard_ids() if not local.has(s)]
-        if not need:
-            continue
-        stub.VolumeEcShardsCopy(
-            vs.VolumeEcShardsCopyRequest(
-                volume_id=vid, collection=collection, shard_ids=need,
-                copy_from_data_node=_node_grpc(node),
+    if gather:
+        # legacy flow: pull every shard the rebuilder lacks before the
+        # local rebuild (moves full shard widths; kept for operators on
+        # clusters with partial-apply disabled)
+        for node, bits in by_node.items():
+            if node == rebuilder:
+                continue
+            need = [s for s in bits.shard_ids() if not local.has(s)]
+            if not need:
+                continue
+            stub.VolumeEcShardsCopy(
+                vs.VolumeEcShardsCopyRequest(
+                    volume_id=vid, collection=collection, shard_ids=need,
+                    copy_from_data_node=_node_grpc(node),
+                )
             )
-        )
-        for s in need:
-            local = local.add(s)
+            for s in need:
+                local = local.add(s)
     resp = stub.VolumeEcShardsRebuild(
         vs.VolumeEcShardsRebuildRequest(
             volume_id=vid, collection=collection, codec=codec)

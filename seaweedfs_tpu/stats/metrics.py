@@ -364,8 +364,29 @@ EC_REBUILD_SECONDS = REGISTRY.histogram(
 )
 EC_REBUILD_BYTES = REGISTRY.counter(
     "seaweedfs_ec_rebuild_bytes_total",
-    "source bytes consumed by EC shard rebuilds, by origin",
-    labels=("source",),  # local | remote
+    "source bytes consumed by EC shard rebuilds, by origin locality",
+    labels=("source",),  # local (this node) | rack (same rack) | dc (beyond)
+)
+
+# partial-sum repair protocol (VolumeEcShardPartialApply): sources stream
+# coefficient-weighted GF(2^8) sums instead of raw shard intervals, so
+# rebuild ingress drops ~sources/racks-fold; `serve` counts bytes a
+# source computed+streamed out, `recv` counts aggregated partial bytes a
+# rebuilder/aggregator pulled in
+EC_PARTIAL_BYTES = REGISTRY.counter(
+    "seaweedfs_ec_partial_bytes_total",
+    "partial-sum repair bytes by direction",
+    labels=("op",),  # serve | recv
+)
+EC_PARTIAL_JOBS = REGISTRY.counter(
+    "seaweedfs_ec_partial_jobs_total",
+    "partial-sum repair requests by role and outcome",
+    labels=("kind", "result"),  # kind: serve|fetch; result: ok|error
+)
+EC_PARTIAL_FALLBACK = REGISTRY.counter(
+    "seaweedfs_ec_partial_fallback_total",
+    "partial-sum repairs that degraded to the full-shard fetch path",
+    labels=("path",),  # rebuild | degraded
 )
 EC_REBUILD_SHARDS = REGISTRY.counter(
     "seaweedfs_ec_rebuild_shards_total", "shard files reconstructed",

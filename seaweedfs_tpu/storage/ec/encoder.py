@@ -23,6 +23,7 @@ import numpy as np
 from ...ops import codec_service, gf256
 from ...ops.codec import get_codec
 from ...stats.metrics import (
+    EC_PARTIAL_FALLBACK,
     EC_PIPELINE_STAGE,
     EC_REBUILD_BYTES,
     EC_REBUILD_RESULT,
@@ -553,21 +554,45 @@ def _pread_into(fd: int, dest, offset: int) -> None:
 
 
 def _pick_rebuild_sources(
-    base_name: str, local: list[int], remote_fetch
+    base_name: str, local: list[int], remote_fetch, partial=None
 ) -> tuple[list[int], set[int], set[int]]:
     """-> (DATA_SHARDS source ids local-first, the remote subset of those,
     ALL remotely-available shard ids).
 
-    Remote availability is probed with a 1-byte interval read through
-    the same fetch hook the streaming loop uses.  Every non-local shard
-    is probed (14 tiny reads worst case) so the caller can limit the
-    rebuild to GLOBALLY missing shards — regenerating a local copy of a
-    shard that is healthy on a peer would double the repair traffic and
-    register duplicate holders with the master."""
+    With a partial-repair client, remote availability and ORDER come
+    from its holder map — same-rack sources are drawn before cross-rack
+    ones (topology.placement.order_ec_sources), so the expensive links
+    carry as few partials as possible.  Without one, remote availability
+    is probed with a 1-byte interval read through the same fetch hook
+    the streaming loop uses.  Either way every non-local shard is
+    covered so the caller can limit the rebuild to GLOBALLY missing
+    shards — regenerating a local copy of a shard that is healthy on a
+    peer would double the repair traffic and register duplicate holders
+    with the master."""
     sources = list(local[:DATA_SHARDS])
     remote: set[int] = set()
     remote_available: set[int] = set()
-    if remote_fetch is not None:
+    if partial is not None:
+        holders = {sid: h for sid, h in partial.remote_shards().items()
+                   if sid not in local}
+        remote_available = set(holders)
+        for sid in partial.order(holders):
+            if len(sources) >= DATA_SHARDS:
+                break
+            if remote_fetch is not None:
+                # the holder map can list a dead node (heartbeat not yet
+                # timed out); a 1-byte probe of each CHOSEN source keeps
+                # that from sinking the whole rebuild when a live
+                # alternate shard exists — the map still decides what is
+                # globally missing, exactly like the shell's planning
+                try:
+                    if not remote_fetch(sid, 0, 1):
+                        continue
+                except Exception:
+                    continue
+            sources.append(sid)
+            remote.add(sid)
+    elif remote_fetch is not None:
         for sid in range(TOTAL_SHARDS):
             if sid in local:
                 continue
@@ -592,7 +617,7 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
                      slice_size: int = DEFAULT_SLICE,
                      progress=None, remote_fetch=None,
                      shard_size: int | None = None,
-                     service=None) -> list[int]:
+                     service=None, partial=None) -> list[int]:
     """Regenerate whichever .ecNN files are missing (ec_encoder.go:61-62).
 
     Runs the same three-stage pipeline as the encode path: a prefetch
@@ -608,7 +633,18 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
     contract as EcVolume.remote_fetch) lets a node holding fewer than
     DATA_SHARDS local shards stream missing source intervals from peers
     instead of failing; `shard_size` must be given when no local shard
-    exists to size the stream from.
+    exists to size the stream from (a partial client's probe can answer
+    it too).
+
+    `partial` (a storage.ec.partial.PartialRepairClient) switches remote
+    sourcing to the partial-sum protocol: remote sources multiply their
+    intervals by their decode-plan columns locally and this node pulls
+    ONE aggregated (missing x width) partial per rack instead of every
+    raw interval — the local shards' plan columns are applied here and
+    XOR'd in, so output bytes are identical by GF linearity.  Any
+    partial failure (source death mid-stream, stale holder) degrades
+    permanently to the full-fetch path for the rest of the rebuild
+    (seaweedfs_ec_partial_fallback_total{path="rebuild"}).
 
     On any error the partial .ecNN outputs are REMOVED — a failed
     rebuild leaves no truncated shard for a later mount to trust.
@@ -627,8 +663,19 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
              if os.path.exists(base_name + to_ext(i))]
     if len(local) == TOTAL_SHARDS:
         return []
-    sources, remote, remote_available = _pick_rebuild_sources(
-        base_name, local, remote_fetch)
+    picked = None
+    if partial is not None:
+        try:
+            picked = _pick_rebuild_sources(
+                base_name, local, remote_fetch, partial)
+        except ValueError:
+            # the holder map cannot supply 10 sources (stale locations):
+            # let the probing path have a try before giving up
+            EC_PARTIAL_FALLBACK.labels("rebuild").inc()
+            partial = None
+    if picked is None:
+        picked = _pick_rebuild_sources(base_name, local, remote_fetch)
+    sources, remote, remote_available = picked
     # rebuild only GLOBALLY missing shards: a shard healthy on a peer
     # needs a copy rpc, not a decode (see _pick_rebuild_sources)
     missing = [i for i in range(TOTAL_SHARDS)
@@ -638,13 +685,58 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
     if local:
         shard_size = os.path.getsize(base_name + to_ext(local[0]))
     elif shard_size is None:
-        raise ValueError(
-            "cannot rebuild: no local shard and no shard_size given")
+        if partial is not None:
+            shard_size = partial.shard_size() or None
+        if shard_size is None:
+            raise ValueError(
+                "cannot rebuild: no local shard and no shard_size given")
 
     # the whole decode program for this loss pattern, from the shared
     # plan cache: one 10x10 inversion per survivor set, not per slice
     rows = gf256.decode_plan_for(
         codec.matrix, DATA_SHARDS, sources, tuple(missing))
+
+    # partial mode: split the plan by source locality — columns for
+    # local sources are applied HERE, columns for remote sources ship to
+    # them as coefficient rows and come back pre-multiplied + pre-XOR'd
+    local_srcs = [s for s in sources if s not in remote]
+    n_local = len(local_srcs)
+    use_partial = partial is not None and bool(remote)
+    if use_partial and remote_fetch is not None:
+        # the protocol pulls racks x missing x width; when that exceeds
+        # the plain sources x width (many lost shards, few remote
+        # sources), full fetch IS the bandwidth-optimal path.  Without
+        # a full-fetch transport the partial path stays on regardless —
+        # it is the only remote sourcing available.
+        try:
+            use_partial = partial.ingress_advantage(
+                remote, len(missing)) >= 1.0
+        except Exception:  # noqa: BLE001 — fetch failures fall back anyway
+            pass
+    local_plan = None
+    coef_by_shard: dict[int, np.ndarray] = {}
+    if use_partial:
+        local_cols = [i for i, s in enumerate(sources) if s not in remote]
+        if local_cols:
+            local_plan = np.ascontiguousarray(rows[:, local_cols])
+        coef_by_shard = {s: rows[:, i] for i, s in enumerate(sources)
+                         if s in remote}
+    # ingress locality labels for the full-fetch path (the partial
+    # client labels its own aggregated pulls).  Evaluated per fetch, not
+    # precomputed: the fetcher reports the holder it ACTUALLY read from,
+    # which can shift cross-rack mid-rebuild when a same-rack peer dies.
+    loc_of = getattr(remote_fetch, "locality_of", None)
+    if loc_of is None and partial is not None:
+        loc_of = partial.locality_of
+
+    def _src_label(sid: int) -> str:
+        try:
+            return loc_of(sid) if loc_of is not None else "dc"
+        except Exception:  # noqa: BLE001 — labels must never fail a read
+            return "dc"
+
+    label_child = {lab: EC_REBUILD_BYTES.labels(lab)
+                   for lab in ("local", "rack", "dc")}
     if service is None:
         service = codec_service.service_for_codec(codec_name)
     is_device_codec = hasattr(codec, "apply_rows_device") and hasattr(
@@ -697,6 +789,22 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
                 continue
         return None
 
+    part_on = [use_partial]  # sticky: one failure drops to full fetch
+
+    def _fetch_partial(off: int, width: int) -> "np.ndarray | None":
+        """-> (missing, width) aggregated remote partial, or None after
+        a clean, PERMANENT fallback to the full-fetch path."""
+        if not part_on[0]:
+            return None
+        try:
+            return partial.fetch(coef_by_shard, len(missing), off, width)
+        except Exception:
+            if remote_fetch is None:
+                raise  # no fallback transport: surface the clean error
+            part_on[0] = False
+            EC_PARTIAL_FALLBACK.labels("rebuild").inc()
+            return None
+
     def reader(fetch_pool: ThreadPoolExecutor) -> None:
         try:
             for off in range(0, shard_size, slice_size):
@@ -705,16 +813,26 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
                 buf = _get_buffer()
                 if buf is None:
                     return
-                view = buf[:, :width]
                 with _STAGE_PREFETCH.time():
-                    remote_bytes = sum(fetch_pool.map(
-                        lambda j: _read_source(sources[j], off, view[j]),
-                        range(DATA_SHARDS)))
-                if remote_bytes:
-                    EC_REBUILD_BYTES.labels("remote").inc(remote_bytes)
-                EC_REBUILD_BYTES.labels("local").inc(
-                    DATA_SHARDS * width - remote_bytes)
-                if not _put((buf, view, off, width)):
+                    part = _fetch_partial(off, width)
+                    if part is not None:
+                        # only the LOCAL source rows are read here; the
+                        # remote contribution arrived pre-combined
+                        view = buf[:n_local, :width]
+                        for j, sid in enumerate(local_srcs):
+                            _pread_into(ins[sid].fileno(), view[j], off)
+                        label_child["local"].inc(n_local * width)
+                    else:
+                        view = buf[:, :width]
+                        fetched = list(fetch_pool.map(
+                            lambda j: _read_source(sources[j], off, view[j]),
+                            range(DATA_SHARDS)))
+                        for j, nb in enumerate(fetched):
+                            if nb:
+                                label_child[_src_label(sources[j])].inc(nb)
+                        label_child["local"].inc(
+                            DATA_SHARDS * width - sum(fetched))
+                if not _put((buf, view, off, width, part)):
                     return
         except Exception as e:  # surfaced by the consumer
             _put(e)
@@ -749,13 +867,16 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
                           daemon=True)
 
     def drain(pending) -> None:
-        buf, dev, off, width = pending
+        buf, dev, off, width, part = pending
         with _STAGE_DECODE.time():  # readback/wait = decode completion
             if hasattr(dev, "result"):  # codec-service future -> row list
                 rebuilt = dev.result()
             else:
                 rebuilt = np.ascontiguousarray(
                     np.asarray(dev, dtype=np.uint8))
+            if part is not None:  # GF addition completes the decode
+                rebuilt = np.bitwise_xor(
+                    np.asarray(rebuilt, dtype=np.uint8), part)
         wq.put((buf, rebuilt, off, width))
         if write_err:
             raise write_err[0]
@@ -789,20 +910,31 @@ def rebuild_ec_files(base_name: str, codec_name: str = "cpu",
                 raise item
             if item is None:
                 break
-            buf, view, off, width = item
+            buf, view, off, width, part = item
+            if part is not None and n_local == 0:
+                # every source was remote: the aggregated partial IS the
+                # rebuilt rows — zero GF compute at the rebuilder
+                wq.put((buf, list(part), off, width))
+                if write_err:
+                    raise write_err[0]
+                continue
+            plan_mtx = local_plan if part is not None else rows
             if not async_mode:
                 # host codec: SIMD decode inline, overlap only the I/O
                 with _STAGE_DECODE.time():
-                    rebuilt = codec.apply_rows(rows, list(view))
+                    rebuilt = codec.apply_rows(plan_mtx, list(view))
+                    if part is not None:
+                        rebuilt = np.bitwise_xor(
+                            np.asarray(rebuilt, dtype=np.uint8), part)
                 wq.put((buf, rebuilt, off, width))
                 if write_err:
                     raise write_err[0]
                 continue
             if service is not None:
-                dev = service.submit_apply(rows, list(view))
+                dev = service.submit_apply(plan_mtx, list(view))
             else:
-                dev = codec.apply_rows_device(rows, jnp.asarray(view))
-            pending_q.append((buf, dev, off, width))
+                dev = codec.apply_rows_device(plan_mtx, jnp.asarray(view))
+            pending_q.append((buf, dev, off, width, part))
             if len(pending_q) > max_pending:
                 drain(pending_q.popleft())  # k reads back while k+1 computes
         while pending_q:

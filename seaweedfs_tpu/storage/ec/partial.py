@@ -1,0 +1,399 @@
+"""Partial-sum EC repair protocol (VolumeEcShardPartialApply).
+
+Rebuild and degraded reads used to stream DATA_SHARDS full shard
+intervals across the network to one rebuilder; with PR 4/6 having made
+the local GF compute cheap, the wire became the bottleneck (Rashmi et
+al., arXiv:1309.0186, measure repair traffic dominating cross-rack
+bandwidth; product-matrix regenerating codes, arXiv:1412.3022, formalize
+the bandwidth floor).  This module moves the decode-plan matmul to the
+data: each SOURCE multiplies its local shard intervals by its columns of
+the shared decode plan (through the PR 6 codec service, so device codecs
+batch and hosts hit the SIMD kernel) and streams the GF(2^8) partial
+sum; partials XOR-combine at a rack-level aggregator so exactly one
+(rows x width) block crosses each rack boundary, and the rebuilder's
+network-in drops from sources x width to racks x rows x width.
+
+GF linearity makes byte-identity structural: the XOR of the sources'
+coefficient-weighted rows IS the decode-plan matmul over the gathered
+rows, term for term — same plan cache, same kernels, same bytes.
+
+Any failure (a source dying mid-stream, a stale location, a missing
+holder) raises :class:`PartialUnavailable` and the caller degrades to
+the existing full-shard fetch path — the protocol is an optimization,
+never a new way to fail a repair.
+
+Three layers live here so the real gRPC path and the in-process test /
+bench network share one implementation:
+
+* ``serve_partial``   — source-side core (the gRPC handler's body);
+* ``PartialRepairClient`` — rebuilder-side planning + fan-out + XOR;
+* ``local_source_network`` — an in-process fleet of sources for unit
+  tests and ``bench.py --rebuild-only``'s A/B leg.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from ...ops import codec_service
+from ...pb import volume_server_pb2 as vs
+from ...stats.metrics import (
+    EC_PARTIAL_BYTES,
+    EC_PARTIAL_JOBS,
+    EC_REBUILD_BYTES,
+)
+from ...topology.placement import (
+    best_ec_holder,
+    ec_source_locality,
+    group_partial_sources,
+    order_ec_sources,
+)
+from ...util import faultpoint
+from .constants import to_ext
+
+# fires on every source serve of a partial-sum request, BEFORE the local
+# shard reads, ctx = the serving node's address — chaos tests kill one
+# source mid-protocol here and assert the rebuilder's clean fallback
+FP_PARTIAL_APPLY = faultpoint.register("ec.partial.apply")
+
+PARTIAL_CHUNK = 1024 * 1024
+
+
+class PartialUnavailable(IOError):
+    """The protocol could not produce a combined partial (dead source,
+    missing holder, bad stream) — degrade to the full-fetch path."""
+
+
+# one bounded process-wide executor for the rebuilder's per-rack group
+# fan-out (flat: group rpcs land on OTHER servers' handler threads, and
+# serve-side delegate fan-out uses short-lived threads, so this pool
+# never waits on itself)
+_POOL = None
+_POOL_LOCK = threading.Lock()
+
+
+def _pool():
+    global _POOL
+    if _POOL is None:
+        with _POOL_LOCK:
+            if _POOL is None:
+                from ...util.executors import MeteredThreadPoolExecutor
+
+                workers = int(os.environ.get(
+                    "SEAWEEDFS_TPU_EC_PARTIAL_WORKERS", "8"))
+                _POOL = MeteredThreadPoolExecutor(
+                    max_workers=workers, name="ec_partial",
+                    thread_name_prefix="ec-partial")
+    return _POOL
+
+
+def compute_partial(coef: np.ndarray, rows: list) -> np.ndarray:
+    """(M, K) GF coefficient rows x K equal-length byte rows -> (M, W).
+
+    Routed through the shared codec service — concurrent partial serves
+    from many rebuilds coalesce into one batched kernel call (device
+    matmul when the probe finds an accelerator, host SIMD otherwise);
+    falls back to the direct host codec when the service is disabled."""
+    coef = np.ascontiguousarray(coef, dtype=np.uint8)
+    svc = codec_service.get_service("cpu")
+    if svc is not None:
+        out = svc.submit_apply(coef, rows).result()
+    else:
+        from ...ops.codec import get_codec
+
+        out = get_codec("cpu").apply_rows(coef, list(rows))
+    return np.ascontiguousarray(np.asarray(out, dtype=np.uint8))
+
+
+def pack_coefficients(coef_by_shard: "dict[int, np.ndarray]",
+                      shard_ids: list[int]) -> bytes:
+    """Row-major (row_count x len(shard_ids)) coefficient block whose
+    column j weights shard_ids[j] — the wire layout of `coefficients`."""
+    return np.ascontiguousarray(
+        np.stack([np.asarray(coef_by_shard[s], dtype=np.uint8)
+                  for s in shard_ids], axis=1)).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# Source side
+# ---------------------------------------------------------------------------
+
+
+def serve_partial(request, read_interval, stub_for=None, ctx: str = "",
+                  throttle=None) -> np.ndarray:
+    """Compute one server's combined partial for a request: the local
+    shards' coefficient-weighted sum, XOR'd with every delegate's
+    partial (fetched concurrently).  Returns the (row_count, size)
+    uint8 array.
+
+    Raises on ANY missing contribution — a partial missing one term is
+    silently wrong bytes, so the rpc must fail loudly and let the
+    rebuilder fall back to full fetches.
+
+    ``read_interval(shard_id, offset, length) -> bytes|None`` supplies
+    local shard bytes; ``throttle(n)`` (optional) charges the node's
+    shared background-I/O budget before the compute."""
+    try:
+        faultpoint.inject(FP_PARTIAL_APPLY, ctx=ctx)
+        m = int(request.row_count)
+        sids = list(request.shard_ids)
+        width = int(request.size)
+        coef = np.frombuffer(bytes(request.coefficients), dtype=np.uint8)
+        if m <= 0 or width <= 0 or coef.size != m * len(sids):
+            raise ValueError(
+                f"bad partial-apply geometry: rows={m} width={width} "
+                f"coef={coef.size} shards={len(sids)}")
+        if throttle is not None:
+            throttle(len(sids) * width)
+        rows = []
+        for sid in sids:
+            buf = read_interval(sid, int(request.offset), width)
+            if buf is None or len(buf) != width:
+                raise IOError(
+                    f"shard {sid} interval unreadable for partial apply")
+            rows.append(np.frombuffer(buf, dtype=np.uint8))
+        if sids:
+            acc = compute_partial(coef.reshape(m, len(sids)), rows)
+        else:
+            acc = np.zeros((m, width), dtype=np.uint8)
+        if len(request.delegates):
+            if stub_for is None:
+                raise IOError("delegates present but no delegate transport")
+            # short-lived threads: delegate counts are bounded by rack
+            # size and this runs once per served slice, so spawn cost is
+            # noise next to the rpc RTT — and it cannot deadlock the
+            # shared client pool from inside a handler
+            parts: list = [None] * len(request.delegates)
+            errs: list = []
+
+            def fetch_one(i: int, d) -> None:
+                try:
+                    parts[i] = fetch_partial_once(
+                        stub_for(d.grpc_address), request.volume_id,
+                        request.collection, int(request.offset), width, m,
+                        list(d.shard_ids), bytes(d.coefficients))
+                except Exception as e:  # noqa: BLE001 — joined below
+                    errs.append(e)
+
+            threads = [threading.Thread(target=fetch_one, args=(i, d),
+                                        daemon=True)
+                       for i, d in enumerate(request.delegates)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errs:
+                raise IOError(f"delegate partial failed: {errs[0]}")
+            for p in parts:
+                np.bitwise_xor(acc, p, out=acc)
+        EC_PARTIAL_BYTES.labels("serve").inc(m * width)
+        EC_PARTIAL_JOBS.labels("serve", "ok").inc()
+        return acc
+    except Exception:
+        EC_PARTIAL_JOBS.labels("serve", "error").inc()
+        raise
+
+
+# ---------------------------------------------------------------------------
+# Rebuilder side
+# ---------------------------------------------------------------------------
+
+
+def fetch_partial_once(stub, volume_id: int, collection: str, offset: int,
+                       size: int, row_count: int, shard_ids: list[int],
+                       coefficients: bytes, delegates=()) -> np.ndarray:
+    """One VolumeEcShardPartialApply rpc -> the (row_count, size) block."""
+    req = vs.VolumeEcShardPartialApplyRequest(
+        volume_id=volume_id, collection=collection, offset=offset,
+        size=size, row_count=row_count, shard_ids=shard_ids,
+        coefficients=coefficients)
+    for addr, sids, coef in delegates:
+        req.delegates.add(grpc_address=addr, shard_ids=sids,
+                          coefficients=coef)
+    blob = b"".join(bytes(r.data) for r in
+                    stub.VolumeEcShardPartialApply(req) if r.data)
+    if len(blob) != row_count * size:
+        raise IOError(
+            f"short partial stream: want {row_count * size} got {len(blob)}")
+    return np.frombuffer(blob, dtype=np.uint8).reshape(row_count, size)
+
+
+def probe_shard_size(stub, volume_id: int, collection: str = "") -> int:
+    """size=0 probe: a holder answers with its shard file size (what a
+    rebuilder with zero local shards needs to size the stream from)."""
+    req = vs.VolumeEcShardPartialApplyRequest(
+        volume_id=volume_id, collection=collection, size=0)
+    for r in stub.VolumeEcShardPartialApply(req):
+        return int(r.shard_size)
+    return 0
+
+
+class PartialRepairClient:
+    """Rebuilder-side orchestration: locate holders, prefer same-rack
+    sources, issue one aggregated request per rack, XOR the per-rack
+    partials, and label the ingress bytes by locality.
+
+    ``locate() -> {shard_id: [(grpc_address, rack, dc), ...]}`` resolves
+    holders (the caller excludes itself); ``stub_for(addr)`` returns the
+    rpc stub for an address.  Lookups ride a TieredLocationCache so a
+    rebuild storm does not hammer the master.
+    """
+
+    def __init__(self, volume_id: int, collection: str, locate, stub_for,
+                 my_rack: str = "", my_dc: str = ""):
+        from ...wdclient.location_cache import TieredLocationCache
+
+        self.volume_id = volume_id
+        self.collection = collection
+        self._stub_for = stub_for
+        self._cache = TieredLocationCache(locate)
+        self.my_rack = my_rack
+        self.my_dc = my_dc
+
+    def remote_shards(self) -> "dict[int, tuple[str, str, str]]":
+        """Best holder per shard id — same-rack holders win, address as
+        tiebreak so the choice is stable across slices."""
+        out: dict[int, tuple[str, str, str]] = {}
+        for sid, holders in self._cache.get().items():
+            if holders:
+                out[sid] = best_ec_holder(holders, self.my_rack, self.my_dc)
+        return out
+
+    def invalidate(self) -> None:
+        self._cache.invalidate()
+
+    def order(self, holders: "dict[int, tuple[str, str, str]]") -> list[int]:
+        return order_ec_sources(holders, self.my_rack, self.my_dc)
+
+    def ingress_advantage(self, remote_sids, row_count: int) -> float:
+        """full-fetch ingress / partial ingress for this source set:
+        partial pulls (racks x row_count x width) vs full's
+        (sources x width).  Below 1.0 the protocol would MOVE MORE
+        bytes than it saves (e.g. 4 lost shards against 3 remote
+        sources) — callers then keep the full-fetch path."""
+        holders = self.remote_shards()
+        chosen = {sid: holders[sid] for sid in remote_sids
+                  if sid in holders}
+        if not chosen or row_count <= 0:
+            return 0.0
+        racks = len(group_partial_sources(chosen))
+        return len(chosen) / float(racks * row_count)
+
+    def locality_of(self, sid: int) -> str:
+        h = self.remote_shards().get(sid)
+        if h is None:
+            return "dc"
+        return ec_source_locality(h[1], h[2], self.my_rack, self.my_dc)
+
+    def shard_size(self) -> int:
+        """Probe any reachable holder for the shard file size."""
+        for _sid, (addr, _r, _d) in sorted(self.remote_shards().items()):
+            try:
+                n = probe_shard_size(
+                    self._stub_for(addr), self.volume_id, self.collection)
+            except Exception:  # noqa: BLE001 — try the next holder
+                continue
+            if n:
+                return n
+        return 0
+
+    def fetch(self, coef_by_shard: "dict[int, np.ndarray]", row_count: int,
+              offset: int, length: int) -> np.ndarray:
+        """One aggregated (row_count, length) partial over the given
+        remote source shards.  Raises PartialUnavailable on ANY failure
+        — the caller falls back to full fetches (and this client drops
+        its location cache, so the retry sees fresh holders)."""
+        holders = self.remote_shards()
+        chosen: dict[int, tuple[str, str, str]] = {}
+        for sid in coef_by_shard:
+            h = holders.get(sid)
+            if h is None:
+                raise PartialUnavailable(f"no holder for source shard {sid}")
+            chosen[sid] = h
+        groups = group_partial_sources(chosen)
+
+        def one_group(g: dict) -> "tuple[dict, np.ndarray]":
+            agg = g["aggregator"]
+            agg_sids = g["members"][agg]
+            delegates = [
+                (addr, sids, pack_coefficients(coef_by_shard, sids))
+                for addr, sids in sorted(g["members"].items())
+                if addr != agg
+            ]
+            part = fetch_partial_once(
+                self._stub_for(agg), self.volume_id, self.collection,
+                offset, length, row_count, agg_sids,
+                pack_coefficients(coef_by_shard, agg_sids),
+                delegates=delegates)
+            return g, part
+
+        try:
+            if len(groups) == 1:
+                results = [one_group(groups[0])]
+            else:
+                results = list(_pool().map(one_group, groups))
+        except Exception as e:
+            EC_PARTIAL_JOBS.labels("fetch", "error").inc()
+            self._cache.invalidate()
+            raise PartialUnavailable(str(e)) from e
+        acc = np.zeros((row_count, length), dtype=np.uint8)
+        for g, part in results:
+            label = ec_source_locality(
+                g["rack"], g["dc"], self.my_rack, self.my_dc)
+            EC_REBUILD_BYTES.labels(label).inc(part.nbytes)
+            EC_PARTIAL_BYTES.labels("recv").inc(part.nbytes)
+            np.bitwise_xor(acc, part, out=acc)
+        EC_PARTIAL_JOBS.labels("fetch", "ok").inc()
+        return acc
+
+
+# ---------------------------------------------------------------------------
+# In-process source fleet (unit tests + bench --rebuild-only A/B leg)
+# ---------------------------------------------------------------------------
+
+
+def local_source_network(nodes: "dict[str, tuple[str, list[int]]]"):
+    """Drive the REAL serve/fetch code without sockets: ``nodes`` maps a
+    fake grpc address -> (base_name, shard_ids it "holds").  Returns
+    ``stub_for`` usable by PartialRepairClient — each stub executes
+    serve_partial inline, including delegate fan-out through the same
+    fleet, and streams the result in PARTIAL_CHUNK chunks like the wire
+    handler does."""
+    from types import SimpleNamespace
+
+    class _Stub:
+        def __init__(self, addr: str):
+            self._addr = addr
+
+        def VolumeEcShardPartialApply(self, request):
+            base, sids = nodes[self._addr]
+
+            if int(request.size) == 0:
+                first = next((s for s in sids
+                              if os.path.exists(base + to_ext(s))), None)
+                size = (os.path.getsize(base + to_ext(first))
+                        if first is not None else 0)
+                yield SimpleNamespace(data=b"", shard_size=size)
+                return
+
+            def read_interval(sid, off, length):
+                if sid not in sids:
+                    return None
+                with open(base + to_ext(sid), "rb") as f:
+                    f.seek(off)
+                    return f.read(length)
+
+            acc = serve_partial(request, read_interval, stub_for=stub_for,
+                                ctx=self._addr)
+            blob = acc.tobytes()
+            for at in range(0, len(blob), PARTIAL_CHUNK):
+                yield SimpleNamespace(
+                    data=blob[at:at + PARTIAL_CHUNK], shard_size=0)
+
+    def stub_for(addr: str) -> _Stub:
+        return _Stub(addr)
+
+    return stub_for

@@ -81,6 +81,10 @@ class Store:
         # vid -> FetchFn factory, injected by the volume server so EcVolumes
         # can read remote shards (store_ec.go's readRemoteEcShardInterval)
         self.ec_fetcher_factory = None
+        # vid -> PartialRepairClient factory (storage/ec/partial.py):
+        # rebuilds and degraded reads pull coefficient-weighted partial
+        # sums from the sources instead of raw shard intervals
+        self.partial_client_factory = None
         # self-healing integrity plane (storage/scrub.py): the volume
         # server installs its Scrubber here; the read path feeds CRC
         # failures into its quarantine + confirm queue
@@ -398,16 +402,26 @@ class Store:
         hook)."""
         base = self._ec_base(vid, collection)
         remote_fetch = None
+        partial = None
         shard_size = None
         ev = self.find_ec_volume(vid)
         if ev is not None:
             remote_fetch = ev.remote_fetch
+            partial = ev.partial_client
             try:
                 shard_size = ev.shard_size or None
             except (OSError, IOError):
                 shard_size = None
-        elif self.ec_fetcher_factory is not None:
-            remote_fetch = self.ec_fetcher_factory(vid)
+        else:
+            if self.ec_fetcher_factory is not None:
+                remote_fetch = self.ec_fetcher_factory(vid)
+            if self.partial_client_factory is not None:
+                partial = self.partial_client_factory(vid)
+        if partial is not None:
+            # a rebuild decides which shards are GLOBALLY missing from
+            # the holder map — it must never trust a TTL-cached view
+            # that predates the loss (or the repair becomes a no-op)
+            partial.invalidate()
         requested = codec_name or self.codec_name
         effective, reason = effective_codec(requested)
         if reason:
@@ -416,7 +430,8 @@ class Store:
                 vid, requested, reason, effective)
         return rebuild_ec_files(
             base, codec_name=requested,
-            remote_fetch=remote_fetch, shard_size=shard_size)
+            remote_fetch=remote_fetch, shard_size=shard_size,
+            partial=partial)
 
     def _ec_base(self, vid: int, collection: str = "") -> str:
         for loc in self.locations:
@@ -441,6 +456,8 @@ class Store:
                 ev.collection = collection
                 if self.ec_fetcher_factory is not None:
                     ev.remote_fetch = self.ec_fetcher_factory(vid)
+                if self.partial_client_factory is not None:
+                    ev.partial_client = self.partial_client_factory(vid)
                 if self.scrubber is not None:
                     ev.corruption_hook = self.scrubber.suspect_shard
                 # keep only the requested shards mounted

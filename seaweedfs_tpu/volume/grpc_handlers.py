@@ -382,6 +382,72 @@ class VolumeGrpcService:
             offset += len(chunk)
             remaining -= len(chunk)
 
+    def VolumeEcShardPartialApply(self, request, context):
+        """Partial-sum repair source: multiply the requested LOCAL shard
+        intervals by the decode-plan coefficient rows (through the
+        shared codec service, so concurrent repairs batch), fold in any
+        delegated same-rack partials, and stream ONE combined GF(2^8)
+        sum — the rebuilder pulls rows x size bytes instead of every
+        raw interval.  size=0 is a probe answered with the shard size.
+
+        Served bytes are charged to the node's shared background-I/O
+        bucket and back off while the PR 5 saturation gauges fire, so a
+        rebuild storm never starves foreground reads."""
+        from ..storage.ec.partial import serve_partial
+        from ..storage.scrub import _saturation
+
+        ev = self.store.find_ec_volume(request.volume_id)
+        if ev is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not found")
+        if request.size == 0:  # probe: shard size only
+            try:
+                size = ev.shard_size
+            except (OSError, IOError):
+                size = 0
+            yield vs.VolumeEcShardPartialApplyResponse(shard_size=size)
+            return
+        import time as _time
+
+        server = self.server
+        scrubber = getattr(server, "scrubber", None)
+        backoff_depth = getattr(scrubber, "backoff_depth", 8) or 8
+
+        def throttle(n: int) -> None:
+            # bounded saturation backoff (deep foreground pools mean
+            # this node is busy serving clients) + the PR 9 shared
+            # bucket: repair reads and tier/scrub traffic drain ONE
+            # per-node budget, so a rebuild storm cannot starve reads
+            deadline = 2.0
+            while _saturation() >= backoff_depth and deadline > 0:
+                _time.sleep(0.05)
+                deadline -= 0.05
+            if scrubber is not None:
+                scrubber.throttle_background(n)
+
+        def read_interval(sid: int, offset: int, length: int):
+            sh = ev.shards.get(sid)
+            if sh is None:
+                return None
+            buf = sh.read_at(offset, length)
+            return buf if len(buf) == length else None
+
+        me = f"{server.ip}:{server.port}" if server else ""
+        try:
+            acc = serve_partial(
+                request, read_interval,
+                stub_for=lambda addr: rpclib.volume_server_stub(
+                    addr, timeout=30),
+                ctx=me, throttle=throttle)
+        except (IOError, ValueError) as e:
+            # a missing local shard / dead delegate means the combined
+            # partial would be silently wrong — fail loudly so the
+            # rebuilder degrades to full fetches
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+        blob = acc.tobytes()
+        for at in range(0, len(blob), COPY_CHUNK):
+            yield vs.VolumeEcShardPartialApplyResponse(
+                data=blob[at:at + COPY_CHUNK])
+
     def VolumeEcBlobDelete(self, request, context):
         ev = self.store.find_ec_volume(request.volume_id)
         if ev is None:

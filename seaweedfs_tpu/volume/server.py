@@ -114,9 +114,11 @@ class VolumeServer:
 
     def start(self) -> None:
         self.store.ec_fetcher_factory = self._make_ec_fetcher
+        self.store.partial_client_factory = self._make_partial_client
         for loc in self.store.locations:
             for vid, ev in loc.ec_volumes.items():
                 ev.remote_fetch = self._make_ec_fetcher(vid)
+                ev.partial_client = self._make_partial_client(vid)
                 ev.corruption_hook = self.scrubber.suspect_shard
         self.scrubber.start()
         self._httpd = serve_http(self, "0.0.0.0", self.port)
@@ -272,33 +274,49 @@ class VolumeServer:
 
     # -- remote EC shard access ------------------------------------------
 
+    def _ec_shard_lookup(self, vid: int):
+        """-> {shard_id: [(url, rack, dc), ...]} from the master (self
+        excluded) — one lookup shape shared by the full-interval fetcher
+        and the partial-repair client."""
+        me = f"{self.ip}:{self.port}"
+        master = self.current_leader or self.master_addresses[0]
+        resp = rpclib.master_stub(master, timeout=5).LookupEcVolume(
+            master_pb2.LookupEcVolumeRequest(volume_id=vid)
+        )
+        locations: dict[int, list[tuple[str, str, str]]] = {}
+        for e in resp.shard_id_locations:
+            held = [(loc.url, loc.rack, loc.data_center)
+                    for loc in e.locations if loc.url != me]
+            if held:
+                locations[e.shard_id] = held
+        return locations
+
     def _make_ec_fetcher(self, vid: int):
         """FetchFn for EcVolume: resolve shard locations via the master
         through a tiered-TTL cache (found/empty/error tiers, negative
         caching — store_ec.go:223-264) and stream the interval from the
-        owning peer via VolumeEcShardRead."""
+        owning peer via VolumeEcShardRead.  The returned callable also
+        exposes ``locality_of(shard_id)`` so rebuild ingress counters
+        label full-interval fetches by rack/dc."""
         from ..pb import volume_server_pb2 as vs
+        from ..topology.placement import ec_source_locality
         from ..wdclient.location_cache import TieredLocationCache
 
-        me = f"{self.ip}:{self.port}"
-
-        def lookup() -> dict[int, list[str]]:
-            master = self.current_leader or self.master_addresses[0]
-            resp = rpclib.master_stub(master, timeout=5).LookupEcVolume(
-                master_pb2.LookupEcVolumeRequest(volume_id=vid)
-            )
-            locations: dict[int, list[str]] = {}
-            for e in resp.shard_id_locations:
-                locations[e.shard_id] = [loc.url for loc in e.locations]
-            return locations
-
-        cache = TieredLocationCache(lookup)
+        cache = TieredLocationCache(lambda: self._ec_shard_lookup(vid))
+        # locality of the holder each shard was LAST actually read from
+        # (a same-rack peer can be down, silently shifting the read
+        # cross-rack — the ingress counters must not lie about that)
+        used_locality: dict[int, str] = {}
 
         def fetch(shard_id: int, offset: int, length: int) -> bytes | None:
-            urls = cache.get().get(shard_id, [])
-            for url in urls:
-                if url == me:
-                    continue
+            # same-rack holders first: the fallback full fetch obeys the
+            # same locality preference as partial source selection
+            holders = sorted(
+                cache.get().get(shard_id, []),
+                key=lambda h: 0 if ec_source_locality(
+                    h[1], h[2], self.store.rack,
+                    self.store.data_center) == "rack" else 1)
+            for url, rack, dc in holders:
                 host, port = url.rsplit(":", 1)
                 grpc_addr = f"{host}:{int(port) + GRPC_PORT_OFFSET}"
                 try:
@@ -310,16 +328,59 @@ class VolumeServer:
                     )
                     data = b"".join(r.data for r in stream)
                     if len(data) == length:
+                        used_locality[shard_id] = ec_source_locality(
+                            rack, dc, self.store.rack,
+                            self.store.data_center)
                         return data
                 except grpc.RpcError:
                     continue
-            if urls:
+            if holders:
                 # every cached location failed — the shard likely moved;
                 # force a fresh master lookup for the next attempt
                 cache.invalidate()
             return None
 
+        def locality_of(shard_id: int) -> str:
+            used = used_locality.get(shard_id)
+            if used is not None:
+                return used
+            holders = cache.get().get(shard_id, [])
+            if any(ec_source_locality(r, d, self.store.rack,
+                                      self.store.data_center) == "rack"
+                   for _u, r, d in holders):
+                return "rack"
+            return "dc"
+
+        fetch.locality_of = locality_of
         return fetch
+
+    def _make_partial_client(self, vid: int):
+        """PartialRepairClient for rebuilds/degraded reads on this node,
+        or None when the protocol is disabled
+        (SEAWEEDFS_TPU_EC_PARTIAL=0)."""
+        import os
+
+        from ..storage.ec.partial import PartialRepairClient
+
+        if os.environ.get("SEAWEEDFS_TPU_EC_PARTIAL", "1").lower() in (
+                "0", "false", "off", "no"):
+            return None
+
+        def locate():
+            out = {}
+            for sid, holders in self._ec_shard_lookup(vid).items():
+                out[sid] = [
+                    (f"{url.rsplit(':', 1)[0]}:"
+                     f"{int(url.rsplit(':', 1)[1]) + GRPC_PORT_OFFSET}",
+                     rack, dc)
+                    for url, rack, dc in holders
+                ]
+            return out
+
+        return PartialRepairClient(
+            vid, "", locate,
+            lambda addr: rpclib.volume_server_stub(addr, timeout=30),
+            my_rack=self.store.rack, my_dc=self.store.data_center)
 
     def delete_ec_needle_distributed(self, vid: int, needle_id: int) -> int:
         """Tombstone an EC needle locally, then fan VolumeEcBlobDelete out to

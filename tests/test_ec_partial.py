@@ -1,0 +1,579 @@
+"""Partial-sum repair protocol (ISSUE 10): rack-aware source planning,
+serve/fetch byte identity against full-fetch rebuilds across loss
+patterns and codecs, clean fallback on source death (faultpoint
+`ec.partial.apply`), degraded reads through the partial path, and the
+locality-labeled wire-reduction counters.
+
+The in-process source fleet (storage.ec.partial.local_source_network)
+drives the REAL serve/fetch code without sockets; the chaos test at the
+bottom runs the whole thing through a live master + 4 volume servers
+across two racks and kills one source mid-protocol.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.stats.metrics import (
+    EC_PARTIAL_BYTES,
+    EC_PARTIAL_FALLBACK,
+    EC_PARTIAL_JOBS,
+    EC_REBUILD_BYTES,
+)
+from seaweedfs_tpu.storage.ec import constants as ecc
+from seaweedfs_tpu.storage.ec import partial as P
+from seaweedfs_tpu.storage.ec.encoder import (
+    generate_ec_files,
+    rebuild_ec_files,
+    write_sorted_file_from_idx,
+)
+from seaweedfs_tpu.storage.ec.volume import EcVolume
+from seaweedfs_tpu.topology.placement import (
+    ec_source_locality,
+    group_partial_sources,
+    order_ec_sources,
+)
+from seaweedfs_tpu.util import faultpoint
+
+from helpers import make_volume
+
+LARGE = 10000
+SMALL = 100
+
+
+# -- pure planning --------------------------------------------------------
+
+
+def test_ec_source_locality():
+    assert ec_source_locality("r1", "d1", "r1", "d1") == "rack"
+    assert ec_source_locality("r2", "d1", "r1", "d1") == "dc"
+    assert ec_source_locality("r1", "d2", "r1", "d1") == "dc"
+    # unknown rack can never claim rack-locality
+    assert ec_source_locality("", "d1", "r1", "d1") == "dc"
+
+
+def test_order_ec_sources_prefers_rack_then_dc():
+    holders = {
+        0: ("n0", "r2", "d1"),   # same dc, other rack
+        1: ("n1", "r1", "d1"),   # same rack
+        2: ("n2", "r9", "d9"),   # other dc
+        3: ("n3", "r1", "d1"),   # same rack
+    }
+    assert order_ec_sources(holders, "r1", "d1") == [1, 3, 0, 2]
+
+
+def test_group_partial_sources_one_group_per_rack():
+    holders = {
+        0: ("a", "r1", "d1"),
+        1: ("a", "r1", "d1"),
+        2: ("b", "r1", "d1"),
+        3: ("c", "r2", "d1"),
+    }
+    groups = group_partial_sources(holders)
+    assert len(groups) == 2
+    g1 = next(g for g in groups if g["rack"] == "r1")
+    # aggregator holds the most shards; the single-shard member delegates
+    assert g1["aggregator"] == "a"
+    assert g1["members"] == {"a": [0, 1], "b": [2]}
+    g2 = next(g for g in groups if g["rack"] == "r2")
+    assert g2["members"] == {"c": [3]}
+
+
+def test_pack_coefficients_layout():
+    coef = {3: np.array([1, 2], dtype=np.uint8),
+            7: np.array([5, 6], dtype=np.uint8)}
+    # rows x shards, column j == shard_ids[j]
+    assert P.pack_coefficients(coef, [3, 7]) == bytes([1, 5, 2, 6])
+
+
+# -- fixtures -------------------------------------------------------------
+
+
+@pytest.fixture()
+def encoded_base(tmp_path):
+    vol = make_volume(str(tmp_path), n_needles=60, seed=33, max_size=3000)
+    base = vol.file_name()
+    vol.close()
+    generate_ec_files(base, large_block_size=LARGE, small_block_size=SMALL,
+                      codec_name="cpu", slice_size=1 << 20)
+    write_sorted_file_from_idx(base)
+    return base
+
+
+def _shard_bytes(base):
+    return {i: open(base + ecc.to_ext(i), "rb").read()
+            for i in range(ecc.TOTAL_SHARDS)}
+
+
+def _fleet(base, lost, rack_of=lambda sid: f"rack{sid % 2}"):
+    """One fake node per surviving shard; returns (client kwargs)."""
+    nodes, holders = {}, {}
+    for sid in range(ecc.TOTAL_SHARDS):
+        if sid in lost:
+            continue
+        addr = f"src-{sid}:0"
+        nodes[addr] = (base, [sid])
+        holders[sid] = [(addr, rack_of(sid), "dc1")]
+    stub_for = P.local_source_network(nodes)
+    return P.PartialRepairClient(
+        1, "", lambda: holders, stub_for, my_rack="rack0", my_dc="dc1")
+
+
+def _full_fetch(base, lost):
+    def fetch(sid, off, length):
+        if sid in lost:
+            return None
+        with open(base + ecc.to_ext(sid), "rb") as f:
+            f.seek(off)
+            return f.read(length)
+
+    return fetch
+
+
+# -- rebuild byte identity ------------------------------------------------
+
+LOSS_PATTERNS = [
+    (0,),
+    (13,),
+    (0, 1, 2, 3),          # worst case: 4 data shards
+    (10, 11, 12, 13),      # all parity
+    (2, 7, 11, 13),        # mixed
+]
+
+
+@pytest.mark.parametrize("lost", LOSS_PATTERNS)
+@pytest.mark.parametrize("codec_name", ["cpu", "tpu"])
+def test_partial_rebuild_byte_identity(encoded_base, tmp_path, lost,
+                                       codec_name):
+    """All 10 sources remote: the aggregated partials must reproduce the
+    full-fetch rebuild bytes exactly for data/parity/mixed losses on the
+    host AND device codec paths."""
+    originals = _shard_bytes(encoded_base)
+    rdir = tmp_path / "rebuilder"
+    rdir.mkdir()
+    rbase = str(rdir / "1")
+    client = _fleet(encoded_base, set(lost))
+    rebuilt = rebuild_ec_files(
+        rbase, codec_name=codec_name, slice_size=1000,
+        remote_fetch=_full_fetch(encoded_base, set(lost)), partial=client)
+    assert sorted(rebuilt) == sorted(lost)
+    for sid in lost:
+        got = open(rbase + ecc.to_ext(sid), "rb").read()
+        assert got == originals[sid], f"shard {sid} differs via partial"
+
+
+def test_partial_rebuild_with_local_sources(encoded_base, tmp_path):
+    """Mixed sourcing: local shards' plan columns applied at the
+    rebuilder, remote columns via partials — XOR must close the GF sum."""
+    originals = _shard_bytes(encoded_base)
+    rdir = tmp_path / "mixed"
+    rdir.mkdir()
+    rbase = str(rdir / "1")
+    # rebuilder already holds shards 0-5; 2 is lost cluster-wide
+    for sid in (0, 1, 3, 4, 5):
+        os.link(encoded_base + ecc.to_ext(sid), rbase + ecc.to_ext(sid))
+    lost = {2}
+    client = _fleet(encoded_base, lost | {0, 1, 3, 4, 5})
+    before = EC_PARTIAL_JOBS.labels("fetch", "ok").value
+    rebuilt = rebuild_ec_files(
+        rbase, codec_name="cpu", slice_size=1000,
+        remote_fetch=_full_fetch(encoded_base, lost), partial=client)
+    assert rebuilt == [2]
+    assert open(rbase + ecc.to_ext(2), "rb").read() == originals[2]
+    assert EC_PARTIAL_JOBS.labels("fetch", "ok").value > before
+
+
+def test_partial_rebuild_wire_reduction_counters(encoded_base, tmp_path):
+    """The acceptance headline: one lost shard, all 10 sources remote on
+    2 racks -> partial ingress is 5x below full-fetch ingress, visible in
+    the locality-labeled rebuild counters."""
+    lost = {0}
+    shard_size = os.path.getsize(encoded_base + ecc.to_ext(1))
+
+    def leg(name, **kw):
+        rdir = tmp_path / name
+        rdir.mkdir()
+        before = {lab: EC_REBUILD_BYTES.labels(lab).value
+                  for lab in ("local", "rack", "dc")}
+        rebuilt = rebuild_ec_files(
+            str(rdir / "1"), codec_name="cpu", slice_size=1000,
+            shard_size=shard_size, **kw)
+        assert rebuilt == [0]
+        return {lab: EC_REBUILD_BYTES.labels(lab).value - before[lab]
+                for lab in ("local", "rack", "dc")}
+
+    fetch = _full_fetch(encoded_base, lost)
+    fetch.locality_of = lambda sid: "rack" if sid % 2 == 0 else "dc"
+    full = leg("full", remote_fetch=fetch)
+    part = leg("partial", remote_fetch=_full_fetch(encoded_base, lost),
+               partial=_fleet(encoded_base, lost))
+    assert full["rack"] + full["dc"] == 10 * shard_size
+    # 2 racks -> 2 aggregated partials of (1 x shard_size) each
+    assert part["rack"] + part["dc"] == 2 * shard_size
+    assert (full["rack"] + full["dc"]) / (part["rack"] + part["dc"]) >= 5.0
+    assert part["rack"] == shard_size and part["dc"] == shard_size
+
+
+def test_partial_source_death_falls_back_clean(encoded_base, tmp_path):
+    """faultpoint ec.partial.apply kills one source mid-protocol: the
+    rebuild degrades to full fetches in place (fallback counter), output
+    stays byte-identical, and no partial .ecNN survives a TOTAL failure."""
+    originals = _shard_bytes(encoded_base)
+    lost = {0, 13}
+    rdir = tmp_path / "fb"
+    rdir.mkdir()
+    rbase = str(rdir / "1")
+    faultpoint.set_fault("ec.partial.apply", "error", match="src-1:0")
+    try:
+        before = EC_PARTIAL_FALLBACK.labels("rebuild").value
+        rebuilt = rebuild_ec_files(
+            rbase, codec_name="cpu", slice_size=1000,
+            remote_fetch=_full_fetch(encoded_base, lost),
+            partial=_fleet(encoded_base, lost, rack_of=lambda sid: "rack0"))
+        assert sorted(rebuilt) == sorted(lost)
+        assert EC_PARTIAL_FALLBACK.labels("rebuild").value == before + 1
+        for sid in lost:
+            got = open(rbase + ecc.to_ext(sid), "rb").read()
+            assert got == originals[sid]
+    finally:
+        faultpoint.clear_fault("ec.partial.apply")
+
+    # total failure (no fallback transport): clean error, outputs removed
+    rdir2 = tmp_path / "fb2"
+    rdir2.mkdir()
+    rbase2 = str(rdir2 / "1")
+    faultpoint.set_fault("ec.partial.apply", "error")
+    try:
+        with pytest.raises((IOError, ValueError)):
+            rebuild_ec_files(
+                rbase2, codec_name="cpu", slice_size=1000,
+                partial=_fleet(encoded_base, lost,
+                               rack_of=lambda sid: "rack0"))
+        for sid in lost:
+            assert not os.path.exists(rbase2 + ecc.to_ext(sid)), \
+                "partial output must not survive a failed rebuild"
+    finally:
+        faultpoint.clear_fault("ec.partial.apply")
+
+
+def test_partial_skipped_when_full_fetch_is_cheaper(encoded_base,
+                                                    tmp_path):
+    """4 lost shards with only 3 remote sources: partial would pull
+    racks x 4 x width > 3 x width, so the rebuilder must choose the
+    full-fetch path outright (no partial jobs, no fallback counted as
+    an error path)."""
+    originals = _shard_bytes(encoded_base)
+    lost = (0, 1, 2, 3)
+    rdir = tmp_path / "cheaper"
+    rdir.mkdir()
+    rbase = str(rdir / "1")
+    # rebuilder holds 7 shards locally; only 3 sources are remote
+    for sid in (4, 5, 6, 7, 8, 9, 13):
+        os.link(encoded_base + ecc.to_ext(sid), rbase + ecc.to_ext(sid))
+    client = _fleet(encoded_base, set(lost) | {4, 5, 6, 7, 8, 9, 13})
+    assert client.ingress_advantage([10, 11, 12], 4) < 1.0
+    fetched_ok = EC_PARTIAL_JOBS.labels("fetch", "ok").value
+    rebuilt = rebuild_ec_files(
+        rbase, codec_name="cpu", slice_size=1000,
+        remote_fetch=_full_fetch(encoded_base, set(lost)), partial=client)
+    assert sorted(rebuilt) == sorted(lost)
+    assert EC_PARTIAL_JOBS.labels("fetch", "ok").value == fetched_ok
+    for sid in lost:
+        assert open(rbase + ecc.to_ext(sid), "rb").read() == originals[sid]
+
+
+def test_partial_probe_answers_shard_size(encoded_base):
+    client = _fleet(encoded_base, {0})
+    assert client.shard_size() == os.path.getsize(
+        encoded_base + ecc.to_ext(1))
+
+
+def test_serve_partial_rejects_bad_geometry(encoded_base):
+    from types import SimpleNamespace
+
+    req = SimpleNamespace(row_count=2, shard_ids=[1], coefficients=b"\x01",
+                          size=10, offset=0, delegates=[], volume_id=1,
+                          collection="")
+    with pytest.raises(ValueError):
+        P.serve_partial(req, lambda sid, off, ln: b"\0" * ln)
+    # a missing local shard must fail the serve, not zero-fill it
+    req2 = SimpleNamespace(row_count=1, shard_ids=[1],
+                           coefficients=b"\x01", size=10, offset=0,
+                           delegates=[], volume_id=1, collection="")
+    with pytest.raises(IOError):
+        P.serve_partial(req2, lambda sid, off, ln: None)
+
+
+# -- degraded reads -------------------------------------------------------
+
+
+def test_degraded_read_partial_byte_identity(tmp_path):
+    """Needles whose intervals live on LOST shards reconstruct through
+    one 1 x W partial per rack, byte-identical to the gathered path."""
+    vol = make_volume(str(tmp_path), n_needles=50, seed=5)
+    vol.sync()
+    base = vol.file_name()
+    generate_ec_files(base, large_block_size=LARGE, small_block_size=SMALL)
+    write_sorted_file_from_idx(base)
+    wants = {i: bytes(vol.read_needle(i).data) for i in range(1, 51)}
+    vol.close()
+    full = tmp_path / "fullcopy"
+    full.mkdir()
+    fbase = str(full / "1")
+    for sid in range(ecc.TOTAL_SHARDS):
+        os.link(base + ecc.to_ext(sid), fbase + ecc.to_ext(sid))
+    # shard 0 lost cluster-wide, 1-7 remote, 8-13 local
+    for sid in range(0, 8):
+        os.remove(base + ecc.to_ext(sid))
+    nodes, holders = {}, {}
+    for sid in range(1, 8):
+        addr = f"deg-{sid}:0"
+        nodes[addr] = (fbase, [sid])
+        holders[sid] = [(addr, f"rack{sid % 2}", "dc1")]
+    ev = EcVolume(base, 1, large_block_size=LARGE, small_block_size=SMALL)
+    ev.partial_client = P.PartialRepairClient(
+        1, "", lambda: holders, P.local_source_network(nodes),
+        my_rack="rack0", my_dc="dc1")
+    before = EC_PARTIAL_JOBS.labels("fetch", "ok").value
+    for i in range(1, 51):
+        assert bytes(ev.read_needle(i).data) == wants[i], f"needle {i}"
+    assert EC_PARTIAL_JOBS.labels("fetch", "ok").value > before
+    ev.close()
+
+
+def test_degraded_read_partial_falls_back(tmp_path):
+    """A dead partial client must not fail the read — the gather path
+    serves it and the degraded fallback counter moves."""
+    vol = make_volume(str(tmp_path), n_needles=20, seed=6)
+    vol.sync()
+    base = vol.file_name()
+    generate_ec_files(base, large_block_size=LARGE, small_block_size=SMALL)
+    write_sorted_file_from_idx(base)
+    wants = {i: bytes(vol.read_needle(i).data) for i in range(1, 21)}
+    vol.close()
+    full = tmp_path / "fullcopy"
+    full.mkdir()
+    fbase = str(full / "1")
+    for sid in range(ecc.TOTAL_SHARDS):
+        os.link(base + ecc.to_ext(sid), fbase + ecc.to_ext(sid))
+    for sid in range(0, 8):
+        os.remove(base + ecc.to_ext(sid))
+
+    class Dead:
+        def remote_shards(self):
+            raise IOError("master unreachable")
+
+    ev = EcVolume(base, 1, large_block_size=LARGE, small_block_size=SMALL)
+    ev.partial_client = Dead()
+    # shard 0 is lost cluster-wide, so reads MUST reconstruct
+    ev.remote_fetch = _full_fetch(fbase, {0})
+    before = EC_PARTIAL_FALLBACK.labels("degraded").value
+    for i in range(1, 21):
+        assert bytes(ev.read_needle(i).data) == wants[i]
+    assert EC_PARTIAL_FALLBACK.labels("degraded").value > before
+    ev.close()
+
+
+# -- shell plan (pure) ----------------------------------------------------
+
+
+def test_rebuild_plan_prefers_same_rack_sources():
+    from seaweedfs_tpu.shell.ec_commands import _rebuild_plan
+    from seaweedfs_tpu.storage.ec.shard_bits import ShardBits
+
+    def bits(*sids):
+        b = ShardBits(0)
+        for s in sids:
+            b = b.add(s)
+        return b
+
+    by_node = {
+        "reb:80": bits(0, 1, 2, 3),
+        "a:80": bits(4, 5, 6),
+        "b:80": bits(7, 8, 9),
+        "c:80": bits(10, 11, 12),   # shard 13 lost
+    }
+    have = bits(*range(13))
+    locality = {
+        "reb:80": ("rack0", "dc1"),
+        "a:80": ("rack0", "dc1"),
+        "b:80": ("rack1", "dc1"),
+        "c:80": ("rack2", "dc2"),
+    }
+    plan = _rebuild_plan(13, by_node, have, locality)
+    assert plan["rebuilder"] == "reb:80"
+    assert plan["lost"] == [13]
+    assert plan["local_sources"] == [0, 1, 2, 3]
+    # 6 remote sources topped up same-rack first: all of a's, then b/c
+    assert set(plan["remote_sources"]) == {4, 5, 6, 7, 8, 9}
+    racks = {g["rack"] for g in plan["groups"]}
+    assert racks == {"rack0", "rack1"}
+
+
+# -- chaos: live cluster, source killed mid-partial-stream ----------------
+
+
+def _http(method, url, data=None):
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+@pytest.mark.chaos
+def test_partial_rebuild_cluster_chaos(tmp_path):
+    """Master + 4 volume servers across 2 racks: encode, lose one shard
+    cluster-wide, kill one SOURCE mid-partial-protocol (faultpoint
+    ec.partial.apply scoped to that node), and assert the shell rebuild
+    still completes with byte-identical reads and ZERO client 5xx while
+    concurrent GETs hammer the EC volume.  A second loss then rebuilds
+    with no fault and must ride the partial path end to end."""
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    from helpers import free_port
+
+    master = MasterServer(ip="127.0.0.1", port=free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    servers = []
+    try:
+        for i in range(4):
+            d = tmp_path / f"vol{i}"
+            d.mkdir()
+            s = VolumeServer(
+                directories=[str(d)],
+                master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+                ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+                rack=f"rack{i % 2}", data_center="dc1",
+                max_volume_count=50,
+            )
+            s.start()
+            servers.append(s)
+        deadline = time.time() + 15
+        while time.time() < deadline and len(master.topo.nodes) < 4:
+            time.sleep(0.1)
+        assert len(master.topo.nodes) == 4
+
+        # write one collection and EC-encode it
+        payloads = {}
+        for i in range(24):
+            code, body = _http(
+                "GET",
+                f"http://127.0.0.1:{master.port}/dir/assign?collection=pc")
+            a = json.loads(body)
+            payload = (f"pc-needle-{i}-".encode() * 331)[:4000]
+            code, _ = _http("POST", f"http://{a['url']}/{a['fid']}", payload)
+            assert code == 201
+            payloads[a["fid"]] = payload
+        vid = int(next(iter(payloads)).split(",")[0])
+        env = CommandEnv(f"127.0.0.1:{master.grpc_port}")
+        out = run_command(env, f"ec.encode -volumeId={vid} -collection=pc")
+        assert f"ec.encode {vid}" in out
+        deadline = time.time() + 20
+        while time.time() < deadline and len(
+                master.topo.lookup_ec_shards(vid)) < 14:
+            time.sleep(0.2)
+        assert len(master.topo.lookup_ec_shards(vid)) == 14
+
+        def lose_one_shard():
+            holders = [s for s in servers if s.store.find_ec_volume(vid)]
+            victim = min(holders, key=lambda s: len(
+                s.store.find_ec_volume(vid).shard_ids()))
+            sid = victim.store.find_ec_volume(vid).shard_ids()[0]
+            victim.store.delete_ec_shards(vid, "pc", [sid])
+            deadline = time.time() + 15
+            while time.time() < deadline and len(
+                    master.topo.lookup_ec_shards(vid)) == 14:
+                time.sleep(0.2)
+            return sid
+
+        def all_mounted():
+            # the MASTER's view gates progress: the next loss/rebuild
+            # round plans from it, so a server-only check would race the
+            # mount registration delta and plan against a stale map
+            if len(master.topo.lookup_ec_shards(vid)) != 14:
+                return False
+            total = set()
+            for s in servers:
+                ev = s.store.find_ec_volume(vid)
+                if ev:
+                    total.update(ev.shard_ids())
+            return len(total) == 14
+
+        def check_reads() -> int:
+            bad = 0
+            holder = next(s for s in servers
+                          if s.store.find_ec_volume(vid) is not None)
+            for fid, want in list(payloads.items())[:6]:
+                code, got = _http(
+                    "GET", f"http://127.0.0.1:{holder.port}/{fid}")
+                if code >= 500:
+                    bad += 1
+                elif code == 200:
+                    assert got == want, f"corrupt read for {fid}"
+            return bad
+
+        lose_one_shard()
+
+        # the plan dry-run names sources with racks and touches nothing
+        plan_out = run_command(env, "ec.rebuild -plan")
+        assert "(plan)" in plan_out and "rack" in plan_out
+        assert len(master.topo.lookup_ec_shards(vid)) < 14
+
+        # kill ONE source mid-partial-protocol; concurrent reads must
+        # see zero 5xx and the rebuild must complete via fallback
+        victim_src = next(
+            s for s in servers if s.store.find_ec_volume(vid) is not None)
+        faultpoint.set_fault(
+            "ec.partial.apply", "error",
+            match=f"127.0.0.1:{victim_src.port}")
+        errs_5xx = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                errs_5xx.append(check_reads())
+                time.sleep(0.05)
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            out = run_command(env, "ec.rebuild")
+            assert "rebuilt" in out
+        finally:
+            stop.set()
+            t.join(timeout=10)
+            faultpoint.clear_fault("ec.partial.apply")
+        deadline = time.time() + 15
+        while time.time() < deadline and not all_mounted():
+            time.sleep(0.2)
+        assert all_mounted(), "rebuild did not restore all 14 shards"
+        assert sum(errs_5xx) == 0, "client 5xx during chaos rebuild"
+        assert check_reads() == 0
+
+        # clean second loss: the partial path itself must carry it
+        before_ok = EC_PARTIAL_JOBS.labels("fetch", "ok").value
+        before_bytes = EC_PARTIAL_BYTES.labels("recv").value
+        lose_one_shard()
+        out = run_command(env, "ec.rebuild")
+        assert "rebuilt" in out
+        deadline = time.time() + 15
+        while time.time() < deadline and not all_mounted():
+            time.sleep(0.2)
+        assert all_mounted()
+        assert EC_PARTIAL_JOBS.labels("fetch", "ok").value > before_ok
+        assert EC_PARTIAL_BYTES.labels("recv").value > before_bytes
+        assert check_reads() == 0
+    finally:
+        for s in servers:
+            s.stop()
+        master.stop()
