@@ -444,7 +444,7 @@ def _rebuild_ab_rates(src_base: str, tmp: str, codec_name: str,
     shard_size = os.path.getsize(src_base + to_ext(lost))
 
     def counters() -> dict:
-        return {k: v for k, v in REGISTRY.snapshot_samples()
+        return {k: v for k, v in REGISTRY.snapshot_samples(max_samples=1 << 20)
                 if "ec_rebuild_bytes" in k or "ec_partial" in k}
 
     def delta(before: dict, after: dict, name: str) -> float:
@@ -516,6 +516,300 @@ def _rebuild_ab_rates(src_base: str, tmp: str, codec_name: str,
         "full": full_in, "partial": part_in}
     out["byte_identical"] = True
     return out
+
+
+def _mass_repair_rates() -> dict:
+    """ISSUE 11 A/B over a LIVE loopback cluster (real gRPC sockets,
+    the shipped code path end to end): one dead node's worth of EC
+    volumes (default 32, SEAWEEDFS_TPU_BENCH_MASS_VOLUMES) each missing
+    one shard, rebuilt twice on the same planned targets —
+
+      * per_volume: the PR 10 status quo — one VolumeEcShardsRebuild +
+        Mount rpc pair per volume IN SEQUENCE, each rebuild doing its
+        own holder lookup, liveness probes and per-rack partial rpcs;
+      * batched: the mass-repair transport — one
+        VolumeEcShardsBatchRebuild rpc per target node (fired
+        concurrently), every volume sourcing remote columns through one
+        cross-volume MassPartialSession with plan-supplied size hints.
+
+    Reported per leg: wall seconds, gRPC rpcs served (request_total
+    deltas over the EC repair surface), and rebuilder-boundary wire
+    bytes (partial request + received-partial counters).  Byte-identity
+    against the staged shard digests gates the result; interleaved
+    best-of-2 per the noisy-host discipline.  Per-volume .dat MB via
+    SEAWEEDFS_TPU_BENCH_MASS_MB (default 2); EC block sizes are scaled
+    down (SMALL=64KB) so shards carry real data instead of 1MB padding.
+    """
+    import hashlib
+    import os
+    import shutil
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.pb import rpc as rpclib
+    from seaweedfs_tpu.pb import volume_server_pb2 as vs
+    from seaweedfs_tpu.stats.metrics import REGISTRY
+    from seaweedfs_tpu.storage.ec.constants import (
+        DATA_SHARDS,
+        TOTAL_SHARDS,
+        to_ext,
+    )
+    from seaweedfs_tpu.storage.ec.encoder import generate_ec_files
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    n_vols = int(os.environ.get("SEAWEEDFS_TPU_BENCH_MASS_VOLUMES", "32"))
+    vol_mb = float(os.environ.get("SEAWEEDFS_TPU_BENCH_MASS_MB", "2"))
+    n_srv = 5
+    large, small = 1 << 20, 64 << 10
+    dat_size = max(small * DATA_SHARDS, int(vol_mb * (1 << 20)))
+    result: dict = {"mass_volumes": n_vols, "volume_bytes": dat_size}
+
+    def emit(**kv) -> None:
+        result.update(kv)
+        print(json.dumps({"partial": True, **result}), flush=True)
+
+    def free_port() -> int:
+        import socket
+
+        with socket.socket() as sk:
+            sk.bind(("127.0.0.1", 0))
+            return sk.getsockname()[1]
+
+    tmp = tempfile.mkdtemp(prefix="swfs-mass-")
+    master = None
+    servers: list = []
+    try:
+        master = MasterServer(ip="127.0.0.1", port=free_port(),
+                              volume_size_limit_mb=64, pulse_seconds=1.0)
+        # the A/B drives the repair transport by hand; the autonomous
+        # orchestrator would race it and heal the staged volumes first
+        master.mass_repair.enabled = False
+        master.start()
+        for i in range(n_srv):
+            d = os.path.join(tmp, f"vol{i}")
+            os.makedirs(d)
+            srv = VolumeServer(
+                directories=[d],
+                master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+                ip="127.0.0.1", port=free_port(), pulse_seconds=1.0,
+                rack=f"rack{i % 2}", data_center="dc1",
+                max_volume_count=max(64, n_vols))
+            srv.start()
+            servers.append(srv)
+        deadline = time.time() + 30
+        while time.time() < deadline and len(master.topo.nodes) < n_srv:
+            time.sleep(0.1)
+        if len(master.topo.nodes) < n_srv:
+            return {**result, "error": "cluster never formed"}
+
+        # stage: every volume misses shard (vid % 14) cluster-wide (the
+        # dead node is already gone); survivors spread over all servers
+        rng = np.random.default_rng(23)
+        block = rng.integers(0, 256, min(dat_size, 8 << 20),
+                             dtype=np.uint8).tobytes()
+        digests: dict = {}
+        lost_of: dict = {}
+        stage = os.path.join(tmp, "stage")
+        for v in range(1, n_vols + 1):
+            d = os.path.join(stage, str(v))
+            os.makedirs(d)
+            base = os.path.join(d, str(v))
+            with open(base + ".dat", "wb") as f:
+                left = dat_size
+                while left > 0:
+                    n = min(len(block), left)
+                    f.write(block[:n])
+                    left -= n
+            generate_ec_files(base, codec_name="cpu",
+                              large_block_size=large,
+                              small_block_size=small,
+                              slice_size=4 << 20)
+            lost = v % TOTAL_SHARDS
+            lost_of[v] = lost
+            h = hashlib.sha256()
+            with open(base + to_ext(lost), "rb") as f:
+                for chunk in iter(lambda: f.read(8 << 20), b""):
+                    h.update(chunk)
+            digests[v] = h.hexdigest()
+            assign: dict = {j: [] for j in range(n_srv)}
+            for k, sid in enumerate(
+                    s for s in range(TOTAL_SHARDS) if s != lost):
+                assign[k % n_srv].append(sid)
+            for j, sids in assign.items():
+                tbase = servers[j].store.locations[0].base_name(v, "")
+                # synthetic volume: no needle index exists (the bench
+                # never reads needles) — mount only requires the file
+                open(tbase + ".ecx", "ab").close()
+                for sid in sids:
+                    shutil.copy(base + to_ext(sid), tbase + to_ext(sid))
+                servers[j].store.mount_ec_shards(v, "", sids)
+        deadline = time.time() + 60
+        while time.time() < deadline and any(
+                len(master.topo.lookup_ec_shards(v)) < 13
+                for v in range(1, n_vols + 1)):
+            time.sleep(0.3)
+        shard_size = os.path.getsize(
+            os.path.join(stage, "1", "1" + to_ext(lost_of[1] or 1)))
+        result["shard_bytes"] = shard_size
+        emit(setup_done=True)
+
+        # one plan, both legs: identical targets (the orchestrator's
+        # exposure-ranked, cap-spread assignment)
+        plans = master.mass_repair.plan()
+        if len(plans) != n_vols:
+            return {**result,
+                    "error": f"planned {len(plans)} of {n_vols}"}
+        by_node = {s.store.public_url: s for s in servers}
+
+        def stub_of(node_id):
+            host, port = node_id.rsplit(":", 1)
+            return rpclib.volume_server_stub(
+                f"{host}:{int(port) + 10000}", timeout=600)
+
+        RPC_OPS = ("VolumeEcShardPartialApply", "VolumeEcShardRead",
+                   "VolumeEcShardsRebuild", "VolumeEcShardsBatchRebuild",
+                   "VolumeEcShardsMount", "LookupEcVolume")
+        # background chatter present in both legs but not repair traffic
+        BG_OPS = ("SendHeartbeat", "KeepConnected")
+
+        def counters() -> dict:
+            """Total wire = every serialized gRPC byte the cluster moved
+            (seaweedfs_grpc_bytes_total, counted at the codec boundary),
+            heartbeat/keepalive chatter excluded; rpcs = repair-surface
+            request counts."""
+            out: dict = {"wire": 0.0, "rpcs": 0.0}
+            for k, val in REGISTRY.snapshot_samples(max_samples=1 << 20):
+                if (k.startswith("seaweedfs_grpc_bytes_total")
+                        and not any(f'op="{op}"' in k for op in BG_OPS)):
+                    out["wire"] += val
+                if k.startswith("seaweedfs_request_total") and any(
+                        f'op="{op}"' in k for op in RPC_OPS):
+                    out["rpcs"] += val
+            return out
+
+        def verify() -> bool:
+            for p in plans:
+                v = p["volume_id"]
+                srv = by_node[p["node"]]
+                path = srv.store._ec_base(v, "") + to_ext(lost_of[v])
+                h = hashlib.sha256()
+                with open(path, "rb") as f:
+                    for chunk in iter(lambda: f.read(8 << 20), b""):
+                        h.update(chunk)
+                if h.hexdigest() != digests[v]:
+                    return False
+            return True
+
+        def reset() -> None:
+            """Drop the rebuilt shards so the next leg starts degraded,
+            and wait for the deletion deltas to reach the master — the
+            next leg's holder lookups must not see the dead shard as
+            alive (rebuild would no-op)."""
+            for p in plans:
+                v = p["volume_id"]
+                stub_of(p["node"]).VolumeEcShardsDelete(
+                    vs.VolumeEcShardsDeleteRequest(
+                        volume_id=v, collection="",
+                        shard_ids=[lost_of[v]]))
+            deadline = time.time() + 30
+            while time.time() < deadline and any(
+                    lost_of[p["volume_id"]] in master.topo.lookup_ec_shards(
+                        p["volume_id"])
+                    for p in plans):
+                time.sleep(0.2)
+
+        def leg_per_volume() -> dict:
+            before = counters()
+            t0 = time.perf_counter()
+            for p in plans:
+                v = p["volume_id"]
+                stub = stub_of(p["node"])
+                resp = stub.VolumeEcShardsRebuild(
+                    vs.VolumeEcShardsRebuildRequest(
+                        volume_id=v, collection=""))
+                rebuilt = list(resp.rebuilt_shard_ids)
+                assert rebuilt == [lost_of[v]], (v, rebuilt)
+                stub.VolumeEcShardsMount(
+                    vs.VolumeEcShardsMountRequest(
+                        volume_id=v, collection="", shard_ids=rebuilt))
+            dt = time.perf_counter() - t0
+            after = counters()
+            return {"seconds": round(dt, 3),
+                    "rpcs": int(after.get("rpcs", 0)
+                                - before.get("rpcs", 0)),
+                    "wire_bytes": int(after.get("wire", 0)
+                                      - before.get("wire", 0))}
+
+        def leg_batched() -> dict:
+            groups: dict = {}
+            for p in plans:
+                groups.setdefault(p["node"], []).append(p)
+            before = counters()
+            t0 = time.perf_counter()
+
+            def run_target(item):
+                node, tjobs = item
+                resp = stub_of(node).VolumeEcShardsBatchRebuild(
+                    vs.VolumeEcShardsBatchRebuildRequest(
+                        jobs=[vs.BatchRebuildJob(
+                            volume_id=p["volume_id"], collection="",
+                            shard_size=p["shard_size"]) for p in tjobs]))
+                for r in resp.results:
+                    assert not r.error, (r.volume_id, r.error)
+
+            with ThreadPoolExecutor(max_workers=len(groups)) as pool:
+                list(pool.map(run_target, groups.items()))
+            dt = time.perf_counter() - t0
+            after = counters()
+            return {"seconds": round(dt, 3),
+                    "rpcs": int(after.get("rpcs", 0)
+                                - before.get("rpcs", 0)),
+                    "wire_bytes": int(after.get("wire", 0)
+                                      - before.get("wire", 0))}
+
+        # interleaved best-of-2: each leg's best trial faces the same
+        # background-interference lottery on a noisy host
+        legs: dict = {}
+        order = (("per_volume", leg_per_volume),
+                 ("batched", leg_batched))
+        for trial in range(2):
+            for name, fn in order:
+                r = fn()
+                if not verify():
+                    return {**result,
+                            "error": f"{name} leg not byte-identical"}
+                reset()
+                if (name not in legs
+                        or r["seconds"] < legs[name]["seconds"]):
+                    legs[name] = r
+                emit(**{name: legs[name], "trials": trial + 1})
+        pv, bt = legs["per_volume"], legs["batched"]
+        rebuilt_bytes = n_vols * shard_size
+        result.update(
+            per_volume=pv, batched=bt, byte_identical=True,
+            speedup=round(pv["seconds"] / bt["seconds"], 2)
+            if bt["seconds"] else 0.0,
+            rpc_reduction=round(pv["rpcs"] / bt["rpcs"], 2)
+            if bt["rpcs"] else 0.0,
+            wire_bytes_saved=pv["wire_bytes"] - bt["wire_bytes"],
+            # reconstructed shard bytes / wall time: the same quantity
+            # seaweedfs_repair_batch_bytes_total over _seconds measures,
+            # so bench and Prometheus rates compare 1:1
+            aggregate_repair_GBps=round(
+                rebuilt_bytes / bt["seconds"] / 1e9, 3)
+            if bt["seconds"] else 0.0,
+            batch_faster=bt["seconds"] < pv["seconds"],
+            batch_fewer_wire_bytes=bt["wire_bytes"] < pv["wire_bytes"],
+        )
+        emit()
+        return result
+    finally:
+        for srv in servers:
+            srv.stop()
+        if master is not None:
+            master.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _degraded_read_rate(n_needles: int = 600, needle_kb: int = 64,
@@ -1456,6 +1750,12 @@ def main() -> None:
     if "--rebuild-only" in sys.argv:
         try:
             print(json.dumps(_rebuild_only_rates()))
+        except Exception as exc:  # noqa: BLE001
+            print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
+        return
+    if "--mass-repair-only" in sys.argv or "--mass-repair" in sys.argv:
+        try:
+            print(json.dumps(_mass_repair_rates()))
         except Exception as exc:  # noqa: BLE001
             print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
         return

@@ -46,6 +46,7 @@ def cmd_master(args) -> None:
         lifecycle_dir=args.lifecycleDir,
         lifecycle_rate_mbps=args.lifecycleRateMBps,
         lifecycle_policy=lifecycle_policy,
+        repair_deadline_s=args.repairDeadlineS,
         sequencer=sequencer,
         sequencer_node_id=node_id,
         sequencer_etcd_urls=mconf.get_string(
@@ -691,6 +692,15 @@ def main(argv=None) -> None:
                         "unthrottled)")
     m.add_argument("-lifecyclePolicy", default="",
                    help="JSON policy file: {collection: {field: value}}")
+    m.add_argument("-repairDeadlineS", type=float, default=None,
+                   help="total-repair-time bound for dead-node mass "
+                        "repair; when a -lifecycleRateMBps budget is "
+                        "set, the pushed background rate is raised to "
+                        "what the bound requires (without a budget "
+                        "repair traffic is unthrottled, so the bound "
+                        "needs no boost).  None = env "
+                        "SEAWEEDFS_TPU_MASS_REPAIR_DEADLINE_S, 0 = "
+                        "no bound")
     m.add_argument("-metricsPort", type=int, default=0)
     m.add_argument("-jwtKey", default="")
     m.add_argument("-peers", default="",

@@ -16,12 +16,14 @@ operational failure mode arXiv:1309.0186 documents for EC clusters.
 
 from .controller import LifecycleController, TRANSITIONS
 from .journal import JobJournal
+from .mass_repair import MassRepairOrchestrator
 from .policy import LifecyclePolicy, PolicySet
 
 __all__ = [
     "JobJournal",
     "LifecycleController",
     "LifecyclePolicy",
+    "MassRepairOrchestrator",
     "PolicySet",
     "TRANSITIONS",
 ]

@@ -59,8 +59,11 @@ BACKOFF_DEPTH_ENV = "SEAWEEDFS_TPU_LIFECYCLE_BACKOFF_QUEUE_DEPTH"
 
 POLICY_FILE = "lifecycle.policy.json"
 
+# "mass_repair" jobs share this journal (so dedup + crash-safe resume
+# are one mechanism) but are planned and executed by the
+# MassRepairOrchestrator, never by this controller's executor
 TRANSITIONS = ("seal", "ttl_expire", "ec_encode", "tier", "vacuum",
-               "rebalance")
+               "rebalance", "mass_repair")
 
 MAX_ATTEMPTS = 3
 # how long a finished vacuum/rebalance suppresses re-planning the same
@@ -381,7 +384,12 @@ class LifecycleController:
         `volume.lifecycle -apply -volumeId=…` must not drain unrelated
         resumed/queued jobs as a side effect); None runs everything."""
         pending = [j for j in self.journal.jobs(("pending",))
-                   if keys is None or j["key"] in keys]
+                   if (keys is None or j["key"] in keys)
+                   # mass-repair jobs ride this journal for dedup +
+                   # crash-safe resume, but the orchestrator drives them
+                   # (one batched rpc per target node, not one worker
+                   # per volume)
+                   and j.get("transition") != "mass_repair"]
         futures = [(j, self._pool.submit(self._run_job, j))
                    for j in pending]
         results = []

@@ -102,15 +102,26 @@ class MasterGrpcService:
                     self.master.broadcast_location(
                         node, new_vids, deleted_vids
                     )
+                # the shared background-I/O budget: volume servers point
+                # their scrub bucket at this rate so scrub + lifecycle
+                # tier traffic can never saturate a node together (0 =
+                # keep the node's local default).  During a deadline-
+                # bounded mass repair the pushed rate is raised to the
+                # floor the bound requires — never below the operator's
+                # budget, and only while a budget exists to raise.
+                rate = self.master.lifecycle.rate_mbps
+                if rate > 0:
+                    rate = max(rate, self.master.mass_repair
+                               .rate_floor_mbps())
                 yield master_pb2.HeartbeatResponse(
                     volume_size_limit=self.topo.volume_size_limit,
                     leader=self.master.leader(),
                     leader_grpc=self.master.leader_grpc(),
-                    # the shared background-I/O budget: volume servers
-                    # point their scrub bucket at this rate so scrub +
-                    # lifecycle tier traffic can never saturate a node
-                    # together (0 = keep the node's local default)
-                    lifecycle_rate_mbps=self.master.lifecycle.rate_mbps,
+                    lifecycle_rate_mbps=rate,
+                    # dead-node notice: a newer seq makes the volume
+                    # server drop its EC holder-location caches eagerly
+                    dead_node_seq=self.master.dead_node_seq,
+                    dead_nodes=self.master.recent_dead_nodes,
                 )
         finally:
             if node is not None and context.code() is None:
@@ -331,9 +342,24 @@ class MasterGrpcService:
                     wait=True, keys={j["key"] for j in accepted})
             return master_pb2.LifecycleResponse(
                 report=json.dumps(report))
+        if action == "mass_repair_status":
+            return master_pb2.LifecycleResponse(
+                report=json.dumps(self.master.mass_repair.status()))
+        if action in ("mass_repair_plan", "mass_repair_run"):
+            self._require_leader(context)
+            mr = self.master.mass_repair
+            plans = mr.plan(dead_node=request.node)
+            report = {"planned": plans, "results": []}
+            if action == "mass_repair_run":
+                accepted = mr.submit(plans)
+                report["accepted"] = [j["key"] for j in accepted]
+                report["results"] = mr.run_wave(mr.pending())
+            return master_pb2.LifecycleResponse(
+                report=json.dumps(report))
         context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                       f"unknown lifecycle action {action!r} "
-                      "(want status|policy|run)")
+                      "(want status|policy|run|mass_repair_status|"
+                      "mass_repair_plan|mass_repair_run)")
 
     # -- admin lock -------------------------------------------------------
 

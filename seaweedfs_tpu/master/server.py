@@ -62,6 +62,7 @@ class MasterServer:
         lifecycle_dir: str = "",          # journal dir; "" = memory only
         lifecycle_rate_mbps: float | None = None,  # None = env, 0 = off
         lifecycle_policy: dict | None = None,
+        repair_deadline_s: float | None = None,  # None = env, 0 = no bound
     ):
         self.ip = ip
         self.port = port
@@ -105,6 +106,20 @@ class MasterServer:
         # serializes repair passes (maintenance loop vs /vol/repair): a
         # concurrent pass would VolumeUnmount mid-VolumeCopy
         self._repair_mutex = threading.Lock()
+        # vids the scrub repair pass is healing RIGHT NOW — the mass
+        # repair orchestrator skips them (and the pass skips volumes
+        # with an active mass_repair journal job: one repairer at a
+        # time).  Claims on BOTH sides happen under _repair_claim_lock:
+        # the pass registers its volume set and snapshots the journal
+        # atomically, and the orchestrator journals its jobs while
+        # reading this set — without the shared lock a death arriving
+        # mid-pass could interleave check-then-act on the same volume
+        self._scrub_repairing: set[int] = set()
+        self._repair_claim_lock = threading.Lock()
+        # dead-node announcements for the heartbeat ack: volume servers
+        # seeing a newer seq drop their EC holder-location caches NOW
+        self.dead_node_seq = 0
+        self.recent_dead_nodes: list[str] = []
         from ..util.executors import MeteredThreadPoolExecutor
 
         self.federation_pool = MeteredThreadPoolExecutor(
@@ -129,6 +144,13 @@ class MasterServer:
             rate_mbps=lifecycle_rate_mbps,
             journal_dir=lifecycle_dir,
         )
+        # dead-node mass repair (ISSUE 11): rides the lifecycle journal
+        # for crash-safe, duplicate-suppressed jobs; triggered from the
+        # liveness sweep, executed as one batched rebuild rpc per target
+        from ..maintenance import MassRepairOrchestrator
+
+        self.mass_repair = MassRepairOrchestrator(
+            self, self.lifecycle, deadline_s=repair_deadline_s)
         self._rng = random.Random()
         # raft quorum (raft_server.go:21-46): multi-master when peers given
         self.raft = None
@@ -167,6 +189,10 @@ class MasterServer:
         if self.maintenance_interval > 0:
             threading.Thread(target=self._maintenance_loop, daemon=True).start()
         self.lifecycle.start()
+        if self.is_leader():
+            # journaled mass-repair jobs interrupted by a crash replay
+            # as pending — resume them exactly-once from the journal
+            self.mass_repair.resume()
         if self.raft is not None:
             self.raft.start()
         glog.info("master started http=%d grpc=%d peers=%d",
@@ -175,6 +201,7 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self.mass_repair.stop()
         self.lifecycle.stop()
         if self.raft is not None:
             self.raft.stop()
@@ -477,6 +504,23 @@ class MasterServer:
             for node_id in self.topo.collect_dead_nodes():
                 vids = self.topo.unregister_node(node_id)
                 self.unregister_from_layouts(vids, node_id)
+                self.note_dead_node(node_id)
+                if self.is_leader():
+                    # plan AFTER the node left the topology, so the
+                    # orchestrator ranks exactly the post-death shard map
+                    self.mass_repair.on_node_dead(node_id)
+            if self.is_leader():
+                self.mass_repair.tick()
+
+    def note_dead_node(self, node_id: str) -> None:
+        """Bump the dead-node sequence the heartbeat ack carries; volume
+        servers seeing a newer seq invalidate their EC holder-location
+        caches eagerly (the first post-death rebuild must not plan
+        against the dead holder)."""
+        self.dead_node_seq += 1
+        self.recent_dead_nodes = (self.recent_dead_nodes + [node_id])[-8:]
+        glog.warning("node %s presumed dead (seq %d)", node_id,
+                     self.dead_node_seq)
 
     # -- vacuum -----------------------------------------------------------
 
@@ -606,7 +650,21 @@ class MasterServer:
         try:
             return self._repair_pass_locked(summary)
         finally:
+            # conservative: vids stay claimed for the whole pass, so the
+            # mass-repair planner can never start on a volume this pass
+            # is mid-VolumeCopy on
+            with self._repair_claim_lock:
+                self._scrub_repairing.clear()
             self._repair_mutex.release()
+
+    def _mass_repair_active_vids(self) -> set[int]:
+        """Volumes with an active mass_repair journal job: the scrub
+        repair pass leaves them to the orchestrator (and vice versa —
+        one repairer per volume, never a double rebuild)."""
+        from ..maintenance.mass_repair import TRANSITION
+
+        return {j["volume_id"] for j in self.lifecycle.journal.active()
+                if j.get("transition") == TRANSITION}
 
     def _repair_pass_locked(self, summary: dict) -> dict:
         from ..stats.metrics import SCRUB_REPAIRS
@@ -615,12 +673,26 @@ class MasterServer:
             work = [(k, dict(v)) for k, v in self.scrub_findings.items()
                     if v["status"] in ("pending", "failed")
                     and v["attempts"] < self.MAX_REPAIR_ATTEMPTS]
+        # claim EVERY volume this pass intends to touch UP FRONT and
+        # snapshot the orchestrator's active jobs in the same locked
+        # section: the mass-repair planner journals its jobs under this
+        # lock while reading our claims, so a node death arriving
+        # mid-pass can never interleave check-then-act on one volume
+        with self._repair_claim_lock:
+            self._scrub_repairing.update(f["volume_id"] for _k, f in work)
+            mass_busy = self._mass_repair_active_vids()
         for key, f in work:
             with self._scrub_lock:
                 if key not in self.scrub_findings:
                     # an earlier repair in THIS pass already healed the
                     # whole volume and dropped its sibling findings
                     continue
+            if f["volume_id"] in mass_busy:
+                # the mass-repair orchestrator is rebuilding this volume
+                # right now; the finding stays queued and a later pass
+                # re-checks it against the freshly rebuilt shards
+                summary["skipped"].append(key)
+                continue
             kind = f["kind"]
             repair_kind = "ec_shard" if kind == "ec_shard" else "replica"
             try:
@@ -1148,6 +1220,7 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
                 "failed": [list(k) for k in s["failed"]],
                 "skipped": [list(k) for k in s["skipped"]],
                 "outstanding": len(self.master.scrub_findings_snapshot()),
+                "massRepair": self.master.mass_repair.status(),
             })
         if u.path == "/vol/grow":
             # master_server_handlers_admin.go volumeGrowHandler

@@ -84,6 +84,7 @@ VOLUME_SERVER = Service("volume_server_pb.VolumeServer", {
     "VolumeTailReceiver": _m(UU, _V.VolumeTailReceiverRequest, _V.VolumeTailReceiverResponse),
     "VolumeEcShardsGenerate": _m(UU, _V.VolumeEcShardsGenerateRequest, _V.VolumeEcShardsGenerateResponse),
     "VolumeEcShardsRebuild": _m(UU, _V.VolumeEcShardsRebuildRequest, _V.VolumeEcShardsRebuildResponse),
+    "VolumeEcShardsBatchRebuild": _m(UU, _V.VolumeEcShardsBatchRebuildRequest, _V.VolumeEcShardsBatchRebuildResponse),
     "VolumeEcShardsCopy": _m(UU, _V.VolumeEcShardsCopyRequest, _V.VolumeEcShardsCopyResponse),
     "VolumeEcShardsDelete": _m(UU, _V.VolumeEcShardsDeleteRequest, _V.VolumeEcShardsDeleteResponse),
     "VolumeEcShardsMount": _m(UU, _V.VolumeEcShardsMountRequest, _V.VolumeEcShardsMountResponse),
@@ -214,14 +215,37 @@ def _counted_stream(server_type: str, method: str, fn: Callable) -> Callable:
 def generic_handler(service: Service, impl: object) -> grpc.GenericRpcHandler:
     """Build a GenericRpcHandler from an object with methods named like the
     service's rpcs.  Unimplemented rpcs answer UNIMPLEMENTED."""
+    from ..stats.metrics import GRPC_BYTES
+
     handlers = {}
     server_type = _GRPC_TYPE.get(service.name, service.name)
     for name, m in service.methods.items():
         fn: Callable | None = getattr(impl, name, None)
         if fn is None:
             fn = _unimplemented(name)
-        deser = m.request.FromString
-        ser = m.response.SerializeToString
+        # serialized-byte accounting at the codec boundary: the exact
+        # wire payload of every rpc, per method and direction.  Children
+        # are created LAZILY on first traffic — eagerly materializing
+        # rx/tx for every method of every service (~90 on a volume
+        # server) would crowd the heartbeat's 512-sample stats snapshot
+        # with zeros for rpcs never called
+        rx_cell: list = []
+        tx_cell: list = []
+
+        def deser(data, _from=m.request.FromString, _cell=rx_cell,
+                  _st=server_type, _n=name):
+            if not _cell:
+                _cell.append(GRPC_BYTES.labels(_st, _n, "rx"))
+            _cell[0].inc(len(data))
+            return _from(data)
+
+        def ser(msg, _to=m.response.SerializeToString, _cell=tx_cell,
+                _st=server_type, _n=name):
+            blob = _to(msg)
+            if not _cell:
+                _cell.append(GRPC_BYTES.labels(_st, _n, "tx"))
+            _cell[0].inc(len(blob))
+            return blob
         if m.kind == UU:
             handlers[name] = grpc.unary_unary_rpc_method_handler(
                 _traced_unary(server_type, name, fn), deser, ser)

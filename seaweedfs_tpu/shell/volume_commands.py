@@ -817,6 +817,54 @@ def volume_lifecycle(env: CommandEnv, args: list[str]) -> str:
     return "\n".join(lines)
 
 
+@register("volume.repair")
+def volume_repair(env: CommandEnv, args: list[str]) -> str:
+    """Operate the master's dead-node mass-repair orchestrator.
+
+    volume.repair                — orchestrator status + recent jobs
+    volume.repair -plan          — rank affected volumes by exposure,
+                                   print targets; touches nothing
+    volume.repair -apply         — plan, journal and execute the batch
+    -node=ip:port tags the plan with the dead node it answers for."""
+    import json as _json
+
+    flags = _parse_flags(args)
+    node = flags.get("node", "")
+    if "plan" in flags or "apply" in flags:
+        resp = env.master().Lifecycle(master_pb2.LifecycleRequest(
+            action=("mass_repair_run" if "apply" in flags
+                    else "mass_repair_plan"),
+            node=node))
+        doc = _json.loads(resp.report)
+        planned = doc.get("planned", [])
+        lines = [f"mass repair: {len(planned)} volume(s) planned"
+                 + ("" if "apply" in flags
+                    else " (dry run, -apply to execute)")]
+        for p in planned:
+            lines.append(
+                f"  v{p['volume_id']} surviving={p['surviving']}"
+                f" -> {p['node']} ({p.get('bytes', 0)} bytes)")
+        for r in doc.get("results", []):
+            lines.append(f"  {r.get('key')}: {r.get('state')}"
+                         + (f" — {r['error']}" if r.get("error") else ""))
+        return "\n".join(lines)
+    resp = env.master().Lifecycle(
+        master_pb2.LifecycleRequest(action="mass_repair_status"))
+    doc = _json.loads(resp.report)
+    lines = [
+        f"mass repair: enabled={doc['enabled']} pending={doc['pending']}"
+        f" deadline={doc['deadlineSeconds']}s"
+        f" rateFloor={doc['rateFloorMBps']}MB/s",
+        f"counts: {doc['counts']}",
+    ]
+    for j in doc.get("jobs", [])[-16:]:
+        lines.append(
+            f"  {j['key']}: {j['state']} attempts={j.get('attempts', 0)}"
+            + (f" — {j['detail']}" if j.get("detail") else "")
+            + (f" — {j['error']}" if j.get("error") else ""))
+    return "\n".join(lines)
+
+
 @register("lock")
 def lock_cmd(env: CommandEnv, args: list[str]) -> str:
     return "locked" if env.acquire_lock() else "lock busy"

@@ -652,6 +652,51 @@ LIFECYCLE_QUEUE_DEPTH = REGISTRY.gauge(
 )
 
 
+# -- dead-node mass repair (maintenance/mass_repair.py, ISSUE 11) -----------
+# the master-side orchestrator turns a dead node into one planned batch:
+# volumes ranked by exposure (fewest surviving shards first), rebuild
+# targets spread across the survivors, execution driven through
+# cross-volume aggregated partial rpcs.  bytes + seconds give the
+# aggregate repair GB/s; deadline slack tracks the configured
+# total-repair-time bound.
+
+REPAIR_BATCH_QUEUE_DEPTH = REGISTRY.gauge(
+    "seaweedfs_repair_batch_queue_depth",
+    "mass-repair volume jobs journaled but not yet finished",
+)
+REPAIR_BATCH_VOLUMES = REGISTRY.counter(
+    "seaweedfs_repair_batch_volumes_total",
+    "volumes planned into mass-repair batches by exposure class "
+    "(surviving shards above the 10-shard decode floor; lost = below it)",
+    labels=("exposure",),  # "0" | "1" | "2" | "3" | "lost"
+)
+REPAIR_BATCH_JOBS = REGISTRY.counter(
+    "seaweedfs_repair_batch_jobs_total",
+    "mass-repair volume rebuild executions by outcome",
+    labels=("result",),  # ok | error | parked | resumed
+)
+REPAIR_BATCH_BYTES = REGISTRY.counter(
+    "seaweedfs_repair_batch_bytes_total",
+    "shard bytes reconstructed by completed mass-repair jobs",
+)
+REPAIR_BATCH_SECONDS = REGISTRY.histogram(
+    "seaweedfs_repair_batch_seconds",
+    "wall time per mass-repair wave (one pass over the pending batch)",
+    buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0),
+)
+REPAIR_BATCH_DEADLINE_SLACK = REGISTRY.gauge(
+    "seaweedfs_repair_batch_deadline_slack_seconds",
+    "configured mass-repair deadline minus projected completion time",
+)
+GRPC_BYTES = REGISTRY.counter(
+    "seaweedfs_grpc_bytes_total",
+    "serialized gRPC message bytes through this server, by rpc and "
+    "direction — the exact wire payload (sans HTTP/2 framing), which is "
+    "what bench A/Bs like --mass-repair measure repair traffic with",
+    labels=("type", "op", "direction"),  # rx | tx
+)
+
+
 def serve_metrics(port: int, registry: Registry = REGISTRY,
                   host: str = "0.0.0.0") -> ThreadingHTTPServer:
     """Expose GET /metrics (Prometheus text) and GET /debug/traces (JSON)."""

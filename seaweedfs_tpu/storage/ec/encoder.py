@@ -576,15 +576,20 @@ def _pick_rebuild_sources(
         holders = {sid: h for sid, h in partial.remote_shards().items()
                    if sid not in local}
         remote_available = set(holders)
+        # the holder map can list a dead node (heartbeat not yet timed
+        # out); a 1-byte probe of each CHOSEN source keeps that from
+        # sinking the whole rebuild when a live alternate shard exists —
+        # the map still decides what is globally missing, exactly like
+        # the shell's planning.  Mass-repair batch clients skip the
+        # probes (trust_holders): their maps were refreshed by the
+        # master's dead-node notice moments ago, and a stale holder
+        # costs one per-volume fallback, not a stalled batch.
+        probe = (remote_fetch is not None
+                 and not getattr(partial, "trust_holders", False))
         for sid in partial.order(holders):
             if len(sources) >= DATA_SHARDS:
                 break
-            if remote_fetch is not None:
-                # the holder map can list a dead node (heartbeat not yet
-                # timed out); a 1-byte probe of each CHOSEN source keeps
-                # that from sinking the whole rebuild when a live
-                # alternate shard exists — the map still decides what is
-                # globally missing, exactly like the shell's planning
+            if probe:
                 try:
                     if not remote_fetch(sid, 0, 1):
                         continue

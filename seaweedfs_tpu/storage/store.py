@@ -393,29 +393,33 @@ class Store:
                          dat_file_size=os.path.getsize(base + ".dat"))
 
     def rebuild_ec_shards(self, vid: int, collection: str,
-                          codec_name: str | None = None) -> list[int]:
+                          codec_name: str | None = None,
+                          partial=None,
+                          shard_size: int | None = None) -> list[int]:
         """Rebuild locally-missing shard files.  A node holding fewer
         than DATA_SHARDS local shards streams the missing SOURCE
         intervals from peers through the same gRPC shard-read fetcher
         the degraded-read path uses, instead of failing (the shell's
         gather-copies-first flow still works and simply never needs the
-        hook)."""
+        hook).  `partial`/`shard_size` override the per-volume defaults —
+        a mass rebuild hands every volume a BatchedPartialClient on one
+        shared session plus the size hint from the master's plan."""
         base = self._ec_base(vid, collection)
         remote_fetch = None
-        partial = None
-        shard_size = None
         ev = self.find_ec_volume(vid)
         if ev is not None:
             remote_fetch = ev.remote_fetch
-            partial = ev.partial_client
-            try:
-                shard_size = ev.shard_size or None
-            except (OSError, IOError):
-                shard_size = None
+            if partial is None:
+                partial = ev.partial_client
+            if shard_size is None:
+                try:
+                    shard_size = ev.shard_size or None
+                except (OSError, IOError):
+                    shard_size = None
         else:
             if self.ec_fetcher_factory is not None:
                 remote_fetch = self.ec_fetcher_factory(vid)
-            if self.partial_client_factory is not None:
+            if partial is None and self.partial_client_factory is not None:
                 partial = self.partial_client_factory(vid)
         if partial is not None:
             # a rebuild decides which shards are GLOBALLY missing from
@@ -446,6 +450,18 @@ class Store:
                 return base
         raise KeyError(f"ec volume {vid} not found")
 
+    def ec_base_for_rebuild(self, vid: int, collection: str = "") -> str:
+        """Base path for a mass-rebuild target: the existing EC base when
+        this node already holds any piece of the volume, else a fresh
+        base on the freest location (a spread rebuild target may hold
+        NOTHING of the volume yet — the caller pulls .ecx/.ecj/.vif from
+        a surviving holder before decoding into it)."""
+        try:
+            return self._ec_base(vid, collection)
+        except KeyError:
+            loc = self.has_free_location() or self.locations[0]
+            return loc.base_name(vid, collection)
+
     def mount_ec_shards(self, vid: int, collection: str,
                         shard_ids: list[int]) -> None:
         with self._lock:
@@ -472,11 +488,16 @@ class Store:
                 # a (re)mounted shard's bytes are fresh (repair rebuilds
                 # land here): stale findings must not re-deliver
                 self.scrubber.forget_shards(vid, shard_ids)
+            try:
+                shard_size = ev.shard_size
+            except (OSError, IOError):
+                shard_size = 0
             self.new_ec_shards.append(
                 master_pb2.VolumeEcShardInformationMessage(
                     id=vid,
                     collection=collection,
                     ec_index_bits=int(_bits(shard_ids)),
+                    shard_size=shard_size,
                 )
             )
 
@@ -584,10 +605,18 @@ class Store:
                     disk_type=loc.disk_type,
                 )
             for vid, ev in loc.ec_volumes.items():
+                try:
+                    shard_size = ev.shard_size
+                except (OSError, IOError):
+                    shard_size = 0
                 hb.ec_shards.add(
                     id=vid,
                     collection=getattr(ev, "collection", ""),
                     ec_index_bits=int(_bits(ev.shard_ids())),
+                    # bytes-at-risk hint: the master's mass-repair
+                    # orchestrator ranks exposure ties by size and sizes
+                    # rebuild streams without per-volume probe rpcs
+                    shard_size=shard_size,
                 )
         hb.max_file_key = max_key
         for k, c in self.max_volume_counts.items():

@@ -172,6 +172,46 @@ def group_partial_sources(
     return groups
 
 
+def spread_rebuild_targets(
+    volumes: "list[dict]",
+    candidates: "dict[str, int]",
+) -> "dict[int, str]":
+    """Assign one rebuild-target node per volume of a mass-repair batch
+    so no single node becomes the write bottleneck: a hard cap of
+    ceil(N / alive_nodes) + 1 assignments per node.
+
+    ``volumes`` come pre-ranked (exposure order — the assignment keeps
+    that order so the most exposed volumes get first pick of targets);
+    each entry carries ``volume_id`` and ``holders`` (node -> count of
+    surviving shards it holds).  ``candidates`` maps alive node ids to
+    free EC slots.  Within the cap the node already holding the most
+    surviving shards of the volume wins (its plan columns apply locally,
+    off the wire), then most free slots, id as tiebreak."""
+    import math
+
+    if not candidates:
+        return {}
+    cap = math.ceil(len(volumes) / len(candidates)) + 1
+    load = {n: 0 for n in candidates}
+    out: dict[int, str] = {}
+    for v in volumes:
+        under_cap = [n for n in candidates if load[n] < cap]
+        # a full node (no free EC slots left after its assignments so
+        # far) cannot STORE the rebuilt shards — preferring it for its
+        # local sources would park the job on no-space retries while
+        # capacity sits idle elsewhere; only when EVERY node is full is
+        # it allowed back in (the rebuild itself surfaces the no-space)
+        eligible = [n for n in under_cap if candidates[n] - load[n] > 0]
+        if not eligible:
+            eligible = under_cap
+        holders = v.get("holders", {})
+        best = max(eligible, key=lambda n: (
+            holders.get(n, 0), candidates[n] - load[n], n))
+        out[v["volume_id"]] = best
+        load[best] += 1
+    return out
+
+
 def balanced_ec_distribution(
     free_slots_by_node: dict[str, int], total_shards: int = 14
 ) -> dict[str, list[int]]:

@@ -62,6 +62,7 @@ class DataNode:
     volumes: dict = field(default_factory=dict)  # vid -> VolumeInfo
     ec_shards: dict = field(default_factory=dict)  # vid -> ShardBits
     ec_collections: dict = field(default_factory=dict)  # vid -> collection
+    ec_shard_sizes: dict = field(default_factory=dict)  # vid -> bytes/shard
     last_seen: float = field(default_factory=time.monotonic)
     # per-disk-type capacity from the heartbeat's max_volume_counts map
     # (reference: Disk nodes under DataNode); empty -> one default tier
@@ -151,6 +152,8 @@ class Topology:
         with self.lock:
             node.ec_shards = {m.id: ShardBits(m.ec_index_bits) for m in shards}
             node.ec_collections = {m.id: m.collection for m in shards}
+            node.ec_shard_sizes = {m.id: m.shard_size for m in shards
+                                   if m.shard_size}
             node.last_seen = time.monotonic()
 
     def apply_incremental(self, node: DataNode, hb: master_pb2.Heartbeat) -> None:
@@ -168,6 +171,8 @@ class Topology:
                 bits = node.ec_shards.get(m.id, ShardBits(0))
                 node.ec_shards[m.id] = bits.plus(m.ec_index_bits)
                 node.ec_collections[m.id] = m.collection
+                if m.shard_size:
+                    node.ec_shard_sizes[m.id] = m.shard_size
             for m in hb.deleted_ec_shards:
                 bits = node.ec_shards.get(m.id, ShardBits(0))
                 left = bits.minus(m.ec_index_bits)

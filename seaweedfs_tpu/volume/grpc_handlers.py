@@ -311,6 +311,30 @@ class VolumeGrpcService:
             context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         return vs.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
 
+    def VolumeEcShardsBatchRebuild(self, request, context):
+        """Rebuild MANY volumes' globally-missing shards on this node in
+        one rpc — the master's mass-repair orchestrator sends each
+        rebuild-target node its whole slice of a dead-node batch.  Every
+        volume sources remote columns through ONE shared
+        MassPartialSession (cross-volume aggregated rpcs per source
+        server) and mounts its rebuilt shards locally; per-volume errors
+        come back in the response instead of failing the batch."""
+        self._log_ec_dispatch(
+            "VolumeEcShardsBatchRebuild",
+            request.jobs[0].volume_id if request.jobs else 0, request.codec)
+        results = self.server.mass_rebuild(
+            [(j.volume_id, j.collection, j.shard_size)
+             for j in request.jobs],
+            codec=request.codec)
+        resp = vs.VolumeEcShardsBatchRebuildResponse()
+        for r in results:
+            resp.results.add(
+                volume_id=r["volume_id"],
+                rebuilt_shard_ids=r.get("rebuilt", []),
+                error=r.get("error", ""),
+                used_partial=r.get("used_partial", False))
+        return resp
+
     def VolumeEcShardsCopy(self, request, context):
         """Pull shard files from the source node (server-side pull protocol)."""
         loc = self.store.has_free_location() or self.store.locations[0]
@@ -393,19 +417,9 @@ class VolumeGrpcService:
         Served bytes are charged to the node's shared background-I/O
         bucket and back off while the PR 5 saturation gauges fire, so a
         rebuild storm never starves foreground reads."""
-        from ..storage.ec.partial import serve_partial
+        from ..storage.ec.partial import batch_response_frames, serve_partial
         from ..storage.scrub import _saturation
 
-        ev = self.store.find_ec_volume(request.volume_id)
-        if ev is None:
-            context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not found")
-        if request.size == 0:  # probe: shard size only
-            try:
-                size = ev.shard_size
-            except (OSError, IOError):
-                size = 0
-            yield vs.VolumeEcShardPartialApplyResponse(shard_size=size)
-            return
         import time as _time
 
         server = self.server
@@ -424,6 +438,45 @@ class VolumeGrpcService:
             if scrubber is not None:
                 scrubber.throttle_background(n)
 
+        me = f"{server.ip}:{server.port}" if server else ""
+
+        if len(request.batch):
+            # cross-volume aggregation (mass repair): one rpc carries
+            # coefficient columns for MANY volumes; per-volume eof/error
+            # frames let the rebuilder degrade exactly the volumes a
+            # dead shard breaks, never the whole batch
+            def read_interval_for(vid: int, _collection: str):
+                bev = self.store.find_ec_volume(vid)
+                if bev is None:
+                    return None
+
+                def read_interval(sid: int, offset: int, length: int):
+                    sh = bev.shards.get(sid)
+                    if sh is None:
+                        return None
+                    buf = sh.read_at(offset, length)
+                    return buf if len(buf) == length else None
+
+                return read_interval
+
+            yield from batch_response_frames(
+                request, read_interval_for,
+                stub_for=lambda addr: rpclib.volume_server_stub(
+                    addr, timeout=30),
+                ctx=me, throttle=throttle)
+            return
+
+        ev = self.store.find_ec_volume(request.volume_id)
+        if ev is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not found")
+        if request.size == 0:  # probe: shard size only
+            try:
+                size = ev.shard_size
+            except (OSError, IOError):
+                size = 0
+            yield vs.VolumeEcShardPartialApplyResponse(shard_size=size)
+            return
+
         def read_interval(sid: int, offset: int, length: int):
             sh = ev.shards.get(sid)
             if sh is None:
@@ -431,7 +484,6 @@ class VolumeGrpcService:
             buf = sh.read_at(offset, length)
             return buf if len(buf) == length else None
 
-        me = f"{server.ip}:{server.port}" if server else ""
         try:
             acc = serve_partial(
                 request, read_interval,

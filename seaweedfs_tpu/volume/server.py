@@ -6,6 +6,7 @@ The gRPC port is http_port + 10000 by convention, like the reference.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import urllib.error
@@ -30,6 +31,19 @@ from .grpc_handlers import VolumeGrpcService
 from .http_handlers import serve_http
 
 GRPC_PORT_OFFSET = 10000
+
+
+def grpc_addr(url: str) -> str:
+    """http `host:port` -> its grpc address (the one port convention)."""
+    host, port = url.rsplit(":", 1)
+    return f"{host}:{int(port) + GRPC_PORT_OFFSET}"
+
+
+def partial_enabled() -> bool:
+    """SEAWEEDFS_TPU_EC_PARTIAL gate (default on) — one parse shared by
+    every client-construction site."""
+    return os.environ.get("SEAWEEDFS_TPU_EC_PARTIAL", "1").lower() not in (
+        "0", "false", "off", "no")
 
 
 class VolumeServer:
@@ -109,6 +123,17 @@ class VolumeServer:
         # disables the daemon; on-demand volume.scrub still works)
         self.scrubber = Scrubber(self.store)
         self.store.scrubber = self.scrubber
+        # every EC location cache handed to fetchers/partial clients, so
+        # a master dead-node notice (heartbeat ack dead_node_seq) can
+        # drop them ALL eagerly — the first post-death rebuild must not
+        # plan against a dead holder and burn its liveness probe.
+        # Lock-guarded: request threads register caches concurrently
+        # with the heartbeat thread snapshotting the set
+        import weakref
+
+        self._loc_caches: "weakref.WeakSet" = weakref.WeakSet()
+        self._loc_caches_lock = threading.Lock()
+        self._dead_node_seq = 0
 
     # -- lifecycle --------------------------------------------------------
 
@@ -266,6 +291,20 @@ class VolumeServer:
             # of 0 WITHDRAWS a previously adopted budget (restores the
             # node's local default), so it must reach the scrubber too
             self.scrubber.set_shared_rate(resp.lifecycle_rate_mbps)
+            if resp.dead_node_seq and resp.dead_node_seq != self._dead_node_seq:
+                # a node died since our last beat: drop every cached EC
+                # holder map NOW instead of serving the dead holder out
+                # of a still-fresh TTL until the first rebuild trips on
+                # it.  The seq is recorded only AFTER the invalidation
+                # succeeds — recording first would let a failure here be
+                # swallowed by the reconnect loop and skip this death's
+                # notice forever
+                dropped = self.invalidate_location_caches()
+                self._dead_node_seq = resp.dead_node_seq
+                glog.info(
+                    "dead-node notice seq=%d (%s): invalidated %d "
+                    "location cache(s)", resp.dead_node_seq,
+                    ",".join(resp.dead_nodes) or "?", dropped)
             if resp.leader_grpc and resp.leader_grpc != master:
                 self.current_leader = resp.leader_grpc
                 raise grpc.RpcError()  # reconnect to leader
@@ -303,6 +342,7 @@ class VolumeServer:
         from ..wdclient.location_cache import TieredLocationCache
 
         cache = TieredLocationCache(lambda: self._ec_shard_lookup(vid))
+        self._register_cache(cache)
         # locality of the holder each shard was LAST actually read from
         # (a same-rack peer can be down, silently shifting the read
         # cross-rack — the ingress counters must not lie about that)
@@ -317,10 +357,9 @@ class VolumeServer:
                     h[1], h[2], self.store.rack,
                     self.store.data_center) == "rack" else 1)
             for url, rack, dc in holders:
-                host, port = url.rsplit(":", 1)
-                grpc_addr = f"{host}:{int(port) + GRPC_PORT_OFFSET}"
                 try:
-                    stream = rpclib.volume_server_stub(grpc_addr, timeout=30).VolumeEcShardRead(
+                    stream = rpclib.volume_server_stub(
+                        grpc_addr(url), timeout=30).VolumeEcShardRead(
                         vs.VolumeEcShardReadRequest(
                             volume_id=vid, shard_id=shard_id,
                             offset=offset, size=length,
@@ -354,33 +393,161 @@ class VolumeServer:
         fetch.locality_of = locality_of
         return fetch
 
+    def _grpc_locate(self, vid: int):
+        """locate() for partial clients: the master's shard->holders map
+        with every holder rewritten to its grpc address."""
+
+        def locate():
+            return {
+                sid: [(grpc_addr(url), rack, dc)
+                      for url, rack, dc in holders]
+                for sid, holders in self._ec_shard_lookup(vid).items()
+            }
+
+        return locate
+
     def _make_partial_client(self, vid: int):
         """PartialRepairClient for rebuilds/degraded reads on this node,
         or None when the protocol is disabled
         (SEAWEEDFS_TPU_EC_PARTIAL=0)."""
-        import os
-
         from ..storage.ec.partial import PartialRepairClient
 
-        if os.environ.get("SEAWEEDFS_TPU_EC_PARTIAL", "1").lower() in (
-                "0", "false", "off", "no"):
+        if not partial_enabled():
             return None
+        locate = self._grpc_locate(vid)
 
-        def locate():
-            out = {}
-            for sid, holders in self._ec_shard_lookup(vid).items():
-                out[sid] = [
-                    (f"{url.rsplit(':', 1)[0]}:"
-                     f"{int(url.rsplit(':', 1)[1]) + GRPC_PORT_OFFSET}",
-                     rack, dc)
-                    for url, rack, dc in holders
-                ]
-            return out
-
-        return PartialRepairClient(
+        client = PartialRepairClient(
             vid, "", locate,
             lambda addr: rpclib.volume_server_stub(addr, timeout=30),
             my_rack=self.store.rack, my_dc=self.store.data_center)
+        self._register_cache(client._cache)
+        return client
+
+    def _register_cache(self, cache) -> None:
+        with self._loc_caches_lock:
+            self._loc_caches.add(cache)
+
+    def invalidate_location_caches(self) -> int:
+        """Drop every live EC holder-location cache (fetchers + partial
+        clients); -> how many were invalidated."""
+        with self._loc_caches_lock:
+            caches = list(self._loc_caches)
+        for c in caches:
+            c.invalidate()
+        return len(caches)
+
+    # -- mass repair (batch rebuild target) -------------------------------
+
+    def _ensure_ec_index(self, vid: int, collection: str) -> str:
+        """Base path ready for a rebuild on this node: when we hold no
+        piece of the volume yet (a spread mass-repair target), pull
+        .ecx/.ecj/.vif from a surviving holder first — rebuilt shards
+        without the index could never serve a read."""
+        from ..pb import volume_server_pb2 as vs
+        from .grpc_handlers import _write_stream
+
+        base = self.store.ec_base_for_rebuild(vid, collection)
+        if os.path.exists(base + ".ecx"):
+            return base
+        peers: list[str] = []
+        for _sid, holders in sorted(self._ec_shard_lookup(vid).items()):
+            for url, _rack, _dc in holders:
+                addr = grpc_addr(url)
+                if addr not in peers:
+                    peers.append(addr)
+        last_err: Exception | None = None
+        for addr in peers:
+            try:
+                src = rpclib.volume_server_stub(addr, timeout=60)
+                for ext, optional in ((".ecx", False), (".ecj", True),
+                                      (".vif", True)):
+                    # pull to a temp name, publish atomically: a crash
+                    # (or non-grpc error) mid-stream must never leave a
+                    # TORN .ecx that the exists() check above would
+                    # trust as a valid index on the retry
+                    tmp = base + ext + ".masstmp"
+                    try:
+                        _write_stream(tmp, src.CopyFile(
+                            vs.CopyFileRequest(
+                                volume_id=vid, collection=collection,
+                                ext=ext, is_ec_volume=True,
+                                ignore_source_file_not_found=optional)),
+                            drop_empty=optional)
+                    except Exception:
+                        try:
+                            os.remove(tmp)
+                        except FileNotFoundError:
+                            pass
+                        raise
+                    if os.path.exists(tmp):
+                        os.replace(tmp, base + ext)
+                return base
+            except (grpc.RpcError, OSError) as e:
+                last_err = e
+                continue
+        raise IOError(
+            f"volume {vid}: no reachable holder to pull .ecx from "
+            f"({last_err})")
+
+    def mass_rebuild(self, jobs: "list[tuple[int, str, int]]",
+                     codec: str = "") -> list[dict]:
+        """Rebuild many volumes' globally-missing shards here, remote
+        columns aggregated CROSS-VOLUME through one MassPartialSession —
+        one streaming rpc per source server carries every queued
+        volume's coefficient columns, feeding the codec service the
+        multi-volume job mix its scheduler batches.  Per-volume failures
+        (or per-volume fallback to full fetches) never stall the batch.
+
+        ``jobs`` is [(volume_id, collection, shard_size_hint)], the hint
+        coming from the master's heartbeat-learned sizes (0 = probe)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..storage.ec.partial import (
+            BatchedPartialClient,
+            MassPartialSession,
+        )
+
+        partial_on = partial_enabled()
+        session = MassPartialSession(
+            lambda addr: rpclib.volume_server_stub(addr, timeout=60))
+        workers = max(1, int(os.environ.get(
+            "SEAWEEDFS_TPU_MASS_REBUILD_WORKERS", "4")))
+
+
+        def one(job: "tuple[int, str, int]") -> dict:
+            vid, collection, size_hint = job
+            try:
+                self._ensure_ec_index(vid, collection)
+                client = None
+                if partial_on:
+                    client = BatchedPartialClient(
+                        session, vid, collection, self._grpc_locate(vid),
+                        lambda addr: rpclib.volume_server_stub(
+                            addr, timeout=60),
+                        my_rack=self.store.rack,
+                        my_dc=self.store.data_center,
+                        shard_size_hint=size_hint)
+                    self._register_cache(client._cache)
+                rebuilt = self.store.rebuild_ec_shards(
+                    vid, collection, codec_name=codec or None,
+                    partial=client, shard_size=size_hint or None)
+                if rebuilt:
+                    self.store.mount_ec_shards(vid, collection, rebuilt)
+                return {"volume_id": vid, "rebuilt": rebuilt,
+                        "used_partial": client is not None}
+            except Exception as e:  # noqa: BLE001 — per-volume isolation
+                glog.warning("mass rebuild vol=%d failed: %s", vid, e)
+                return {"volume_id": vid, "error": str(e)[:300] or "failed"}
+
+        try:
+            if len(jobs) == 1:
+                return [one(jobs[0])]
+            with ThreadPoolExecutor(
+                    max_workers=workers,
+                    thread_name_prefix="mass-rebuild") as pool:
+                return list(pool.map(one, jobs))
+        finally:
+            session.close()
 
     def delete_ec_needle_distributed(self, vid: int, needle_id: int) -> int:
         """Tombstone an EC needle locally, then fan VolumeEcBlobDelete out to
@@ -405,10 +572,9 @@ class VolumeServer:
             if loc.url != me
         }
         for url in peers:
-            host, port = url.rsplit(":", 1)
-            grpc_addr = f"{host}:{int(port) + GRPC_PORT_OFFSET}"
             try:
-                rpclib.volume_server_stub(grpc_addr, timeout=10).VolumeEcBlobDelete(
+                rpclib.volume_server_stub(
+                    grpc_addr(url), timeout=10).VolumeEcBlobDelete(
                     vs.VolumeEcBlobDeleteRequest(
                         volume_id=vid, file_key=needle_id
                     )

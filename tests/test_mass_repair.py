@@ -1,0 +1,503 @@
+"""Dead-node mass repair (ISSUE 11): exposure ranking, target
+spreading bounds, the cross-volume batched partial transport (byte
+identity, coalescing, per-volume fallback on source death), orchestrator
+planning over a live topology snapshot, crash-safe journal resume, and
+the scrub-pass / mass-repair mutual exclusion."""
+
+import hashlib
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from seaweedfs_tpu.maintenance.mass_repair import (
+    exposure_class,
+    rank_by_exposure,
+)
+from seaweedfs_tpu.stats.metrics import (
+    EC_PARTIAL_FALLBACK,
+    EC_PARTIAL_JOBS,
+    REPAIR_BATCH_JOBS,
+)
+from seaweedfs_tpu.storage.ec import constants as ecc
+from seaweedfs_tpu.storage.ec import partial as P
+from seaweedfs_tpu.storage.ec.encoder import (
+    generate_ec_files,
+    rebuild_ec_files,
+    write_sorted_file_from_idx,
+)
+from seaweedfs_tpu.storage.ec.shard_bits import ShardBits
+from seaweedfs_tpu.topology.placement import spread_rebuild_targets
+from seaweedfs_tpu.topology.topology import DataNode
+from seaweedfs_tpu.util import faultpoint
+
+from helpers import free_port, make_volume
+
+LARGE = 10000
+SMALL = 100
+
+
+# -- pure planning --------------------------------------------------------
+
+
+def test_rank_by_exposure_floor_first():
+    """Volumes one shard from data loss (10 surviving) schedule strictly
+    before every healthier volume, regardless of size."""
+    vols = [
+        {"volume_id": 1, "surviving": 13, "shard_size": 999999},
+        {"volume_id": 2, "surviving": 10, "shard_size": 1},
+        {"volume_id": 3, "surviving": 12, "shard_size": 5},
+        {"volume_id": 4, "surviving": 10, "shard_size": 777},
+        {"volume_id": 5, "surviving": 11, "shard_size": 123456},
+    ]
+    ranked = rank_by_exposure(vols)
+    assert [v["volume_id"] for v in ranked][:2] == [4, 2]  # floor first,
+    # bigger shard (more bytes at risk) breaks the tie
+    assert [v["surviving"] for v in ranked] == [10, 10, 11, 12, 13]
+
+
+def test_exposure_class_labels():
+    assert exposure_class(9) == "lost"
+    assert exposure_class(10) == "0"
+    assert exposure_class(11) == "1"
+    assert exposure_class(13) == "3"
+    assert exposure_class(14) == "3"  # clamped: healthy never planned
+
+
+def test_spread_targets_respects_cap():
+    """N volumes over alive nodes: no node gets more than
+    ceil(N/alive)+1 assignments, even when every volume prefers the
+    same holder."""
+    import math
+
+    n_vols, nodes = 20, {f"n{i}:80": 100 for i in range(4)}
+    vols = [{"volume_id": v, "surviving": 10,
+             # every volume's shards live mostly on n0 — without the cap
+             # n0 would take the whole batch
+             "holders": {"n0:80": 9, "n1:80": 1}}
+            for v in range(n_vols)]
+    targets = spread_rebuild_targets(vols, nodes)
+    assert len(targets) == n_vols
+    cap = math.ceil(n_vols / len(nodes)) + 1
+    per_node: dict = {}
+    for t in targets.values():
+        per_node[t] = per_node.get(t, 0) + 1
+    assert max(per_node.values()) <= cap, per_node
+
+
+def test_spread_targets_prefers_surviving_holders():
+    """Within the cap, the node already holding the most surviving
+    shards wins (its plan columns are local, off the wire)."""
+    nodes = {"a:80": 10, "b:80": 10}
+    vols = [{"volume_id": 1, "holders": {"b:80": 7, "a:80": 3}}]
+    assert spread_rebuild_targets(vols, nodes) == {1: "b:80"}
+
+
+def test_spread_targets_skips_full_nodes():
+    """A node with zero free EC slots never gets a rebuild it cannot
+    store, even when it holds the most surviving shards — unless every
+    node is full (then the rebuild itself surfaces the no-space)."""
+    vols = [{"volume_id": 1, "holders": {"full:80": 9, "ok:80": 1}}]
+    assert spread_rebuild_targets(
+        vols, {"full:80": 0, "ok:80": 5}) == {1: "ok:80"}
+    assert spread_rebuild_targets(
+        vols, {"full:80": 0, "alsofull:80": 0}) in (
+        {1: "full:80"}, {1: "alsofull:80"})
+
+
+# -- cross-volume batched transport ---------------------------------------
+
+
+@pytest.fixture()
+def multi_volume_fleet(tmp_path):
+    """4 encoded volumes spread over 5 fake source nodes on 2 racks;
+    each volume is missing shard (vid % 14) cluster-wide."""
+    n_src = 5
+    nodes: dict = {}
+    holders_of: dict = {}
+    bases: dict = {}
+    digests: dict = {}
+    for v in range(1, 5):
+        d = tmp_path / f"v{v}"
+        d.mkdir()
+        vol = make_volume(str(d), volume_id=v, n_needles=30, seed=v,
+                          max_size=2500)
+        base = vol.file_name()
+        vol.close()
+        generate_ec_files(base, large_block_size=LARGE,
+                          small_block_size=SMALL, codec_name="cpu",
+                          slice_size=1 << 20)
+        write_sorted_file_from_idx(base)
+        lost = v % ecc.TOTAL_SHARDS
+        digests[v] = hashlib.sha256(
+            open(base + ecc.to_ext(lost), "rb").read()).hexdigest()
+        bases[v] = base
+        holders: dict = {}
+        for sid in range(ecc.TOTAL_SHARDS):
+            if sid == lost:
+                continue
+            addr = f"mass-src-{sid % n_src}:0"
+            nodes.setdefault(addr, {}).setdefault(v, (base, []))[1].append(
+                sid)
+            holders.setdefault(sid, []).append(
+                (addr, f"rack{(sid % n_src) % 2}", "dc1"))
+        holders_of[v] = holders
+    stub_for = P.local_source_network(nodes)
+    return stub_for, holders_of, bases, digests
+
+
+def _batched_rebuild(tmp_path, stub_for, holders_of, bases, digests,
+                     session, vids, slice_size=1000, with_fallback=False):
+    results = {}
+
+    def one(v):
+        rdir = tmp_path / f"r{v}"
+        rdir.mkdir(exist_ok=True)
+        rbase = str(rdir / str(v))
+        holders = holders_of[v]
+        client = P.BatchedPartialClient(
+            session, v, "", lambda h=holders: h, stub_for,
+            my_rack="rack0", my_dc="dc1",
+            shard_size_hint=os.path.getsize(
+                bases[v] + ecc.to_ext((v + 1) % ecc.TOTAL_SHARDS)))
+        kw = {}
+        if with_fallback:
+            lost = v % ecc.TOTAL_SHARDS
+
+            def fetch(sid, off, length, v=v, lost=lost):
+                if sid == lost:
+                    return None
+                with open(bases[v] + ecc.to_ext(sid), "rb") as f:
+                    f.seek(off)
+                    return f.read(length)
+
+            kw["remote_fetch"] = fetch
+        rebuilt = rebuild_ec_files(rbase, codec_name="cpu",
+                                   slice_size=slice_size, partial=client,
+                                   **kw)
+        got = hashlib.sha256(
+            open(rbase + ecc.to_ext(v % ecc.TOTAL_SHARDS),
+                 "rb").read()).hexdigest()
+        results[v] = (rebuilt, got)
+
+    with ThreadPoolExecutor(max_workers=len(vids)) as pool:
+        list(pool.map(one, vids))
+    for v in vids:
+        rebuilt, got = results[v]
+        assert rebuilt == [v % ecc.TOTAL_SHARDS], (v, rebuilt)
+        assert got == digests[v], f"volume {v} not byte-identical"
+
+
+def test_batched_rebuild_byte_identity(tmp_path, multi_volume_fleet):
+    """4 volumes rebuilt concurrently through one MassPartialSession:
+    byte-identical outputs, and the rack-group jobs coalesce into fewer
+    rpcs than the per-volume path would issue."""
+    stub_for, holders_of, bases, digests = multi_volume_fleet
+    session = P.MassPartialSession(stub_for)
+    try:
+        before = EC_PARTIAL_JOBS.labels("fetch", "ok").value
+        _batched_rebuild(tmp_path, stub_for, holders_of, bases, digests,
+                         session, [1, 2, 3, 4])
+        assert EC_PARTIAL_JOBS.labels("fetch", "ok").value >= before + 4
+        # every per-volume fetch succeeded through the session
+        assert session.batched_jobs >= session.rpcs
+        assert session.rpcs >= 1
+    finally:
+        session.close()
+
+
+def test_batched_rebuild_multi_slice(tmp_path, multi_volume_fleet):
+    """Shards larger than the slice: successive slices of one volume
+    must not merge into one rpc (frames are keyed by volume id), and
+    output stays byte-identical."""
+    stub_for, holders_of, bases, digests = multi_volume_fleet
+    session = P.MassPartialSession(stub_for)
+    try:
+        _batched_rebuild(tmp_path, stub_for, holders_of, bases, digests,
+                         session, [1, 2], slice_size=257)
+    finally:
+        session.close()
+
+
+def test_batch_source_death_falls_back_per_volume(tmp_path,
+                                                  multi_volume_fleet):
+    """faultpoint repair.batch.source scoped to ONE volume's batch job:
+    exactly that volume degrades to the full-fetch path (fallback
+    counter +1), the rest of the batch rides the aggregated protocol,
+    and every output is byte-identical."""
+    stub_for, holders_of, bases, digests = multi_volume_fleet
+    session = P.MassPartialSession(stub_for)
+    faultpoint.set_fault("repair.batch.source", "error", match="vol=3")
+    try:
+        before_fb = EC_PARTIAL_FALLBACK.labels("rebuild").value
+        _batched_rebuild(tmp_path, stub_for, holders_of, bases, digests,
+                         session, [1, 2, 3, 4], with_fallback=True)
+        assert EC_PARTIAL_FALLBACK.labels("rebuild").value == before_fb + 1
+    finally:
+        faultpoint.clear_fault("repair.batch.source")
+        session.close()
+
+
+def test_session_coalesces_waves():
+    """While one rpc is in flight, queued jobs pile into the NEXT wave:
+    a blocking first rpc forces jobs 2-4 into one batch rpc."""
+    import numpy as np
+
+    gate = threading.Event()
+    first_started = threading.Event()
+    batch_sizes = []
+
+    class _Stub:
+        def VolumeEcShardPartialApply(self, request):
+            batch_sizes.append(len(request.batch))
+            if len(batch_sizes) == 1:
+                first_started.set()
+                gate.wait(timeout=10)
+            for job in request.batch:
+                blob = bytes(job.row_count * job.size)
+                yield type("R", (), {
+                    "volume_id": job.volume_id, "data": blob,
+                    "eof": False, "error": ""})()
+                yield type("R", (), {
+                    "volume_id": job.volume_id, "data": b"",
+                    "eof": True, "error": ""})()
+
+    session = P.MassPartialSession(lambda addr: _Stub())
+
+    def job(vid):
+        return {"volume_id": vid, "collection": "", "offset": 0,
+                "size": 8, "row_count": 1, "shard_ids": [1],
+                "coefficients": b"\x01", "delegates": []}
+
+    try:
+        f1 = session.submit("a:0", job(1))
+        assert first_started.wait(timeout=10)
+        fs = [session.submit("a:0", job(v)) for v in (2, 3, 4)]
+        gate.set()
+        assert isinstance(f1.result(timeout=10), np.ndarray)
+        for f in fs:
+            f.result(timeout=10)
+        assert batch_sizes[0] == 1
+        assert 3 in batch_sizes, batch_sizes  # jobs 2-4 rode one rpc
+    finally:
+        session.close()
+
+
+# -- orchestrator over a topology snapshot --------------------------------
+
+
+def _fake_master(tmp_path, journal=True):
+    from seaweedfs_tpu.master.server import MasterServer
+
+    jd = ""
+    if journal:
+        jd = str(tmp_path / "journal")
+        os.makedirs(jd, exist_ok=True)
+    return MasterServer(ip="127.0.0.1", port=free_port(),
+                        volume_size_limit_mb=64, lifecycle_dir=jd)
+
+
+def _bits(*sids):
+    b = ShardBits(0)
+    for s in sids:
+        b = b.add(s)
+    return b
+
+
+def _register(master, node_id, rack, ec):
+    """ec: {vid: (shard_ids, shard_size)}"""
+    n = DataNode(id=node_id, public_url=node_id,
+                 grpc_address=node_id, rack=rack, data_center="dc1",
+                 max_volumes=100)
+    n.ec_shards = {vid: _bits(*sids) for vid, (sids, _sz) in ec.items()}
+    n.ec_collections = {vid: "" for vid in ec}
+    n.ec_shard_sizes = {vid: sz for vid, (_sids, sz) in ec.items()}
+    master.topo.register_node(n)
+    return n
+
+
+def test_orchestrator_plan_ranks_and_spreads(tmp_path):
+    """Live-topology planning: the volume at the decode floor plans
+    first, targets never exceed the cap, unrepairable volumes are
+    reported not planned."""
+    master = _fake_master(tmp_path, journal=False)
+    # volume 1: 13 surviving (lost 1 shard), volume 2: 10 surviving,
+    # volume 3: 9 surviving (below floor -> unrepairable)
+    _register(master, "a:80", "r0", {
+        1: (list(range(0, 7)), 100),
+        2: (list(range(0, 5)), 999),
+        3: (list(range(0, 5)), 5),
+    })
+    _register(master, "b:80", "r1", {
+        1: (list(range(7, 13)), 100),
+        2: (list(range(5, 10)), 999),
+        3: (list(range(5, 9)), 5),
+    })
+    plans = master.mass_repair.plan(dead_node="dead:80")
+    assert [p["volume_id"] for p in plans] == [2, 1]  # floor first
+    assert plans[0]["surviving"] == 10
+    assert plans[0]["shard_size"] == 999
+    assert plans[0]["bytes"] == 4 * 999
+    assert all(p["node"] in ("a:80", "b:80") for p in plans)
+    assert master.mass_repair._counts["unrepairable"] == 1
+
+
+def test_orchestrator_journal_resume_exactly_once(tmp_path):
+    """Jobs journaled by a first master run (killed before execution)
+    replay as pending in a second run and execute exactly once."""
+    master1 = _fake_master(tmp_path)
+    _register(master1, "a:80", "r0", {1: (list(range(0, 7)), 64)})
+    _register(master1, "b:80", "r1", {1: (list(range(7, 13)), 64)})
+    accepted = master1.mass_repair.submit(master1.mass_repair.plan())
+    assert len(accepted) == 1
+    assert master1.mass_repair.pending()
+
+    # "crash": a fresh master over the same journal dir
+    master2 = _fake_master(tmp_path)
+    _register(master2, "a:80", "r0", {1: (list(range(0, 7)), 64)})
+    _register(master2, "b:80", "r1", {1: (list(range(7, 13)), 64)})
+    pending = master2.mass_repair.pending()
+    assert [j["volume_id"] for j in pending] == [1]
+
+    executed = []
+
+    class _Stub:
+        def VolumeEcShardsBatchRebuild(self, req):
+            executed.extend(j.volume_id for j in req.jobs)
+            resp = type("R", (), {})()
+            resp.results = [type("J", (), {
+                "volume_id": j.volume_id, "rebuilt_shard_ids": [13],
+                "error": "", "used_partial": True})() for j in req.jobs]
+            return resp
+
+    master2.mass_repair._target_stub = lambda node: _Stub()
+    before_ok = REPAIR_BATCH_JOBS.labels("ok").value
+    master2.mass_repair.run_wave(master2.mass_repair.pending())
+    assert executed == [1]
+    assert not master2.mass_repair.pending()
+    job = master2.mass_repair.journal.get("1:mass_repair")
+    assert job["state"] == "done"
+    assert REPAIR_BATCH_JOBS.labels("ok").value == before_ok + 1
+    # a second wave over the drained queue re-runs nothing
+    master2.mass_repair.run_wave(master2.mass_repair.pending())
+    assert executed == [1]
+
+
+def test_orchestrator_failed_target_parks_after_attempts(tmp_path):
+    """An unreachable target fails the job (attempts preserved across
+    resubmits) until MAX_ATTEMPTS parks it for an operator."""
+    import grpc
+
+    master = _fake_master(tmp_path, journal=False)
+    _register(master, "a:80", "r0", {1: (list(range(0, 7)), 64)})
+    _register(master, "b:80", "r1", {1: (list(range(7, 13)), 64)})
+
+    class _DeadStub:
+        def VolumeEcShardsBatchRebuild(self, req):
+            raise grpc.RpcError("unreachable")
+
+    master.mass_repair._target_stub = lambda node: _DeadStub()
+    for attempt in range(1, 4):
+        accepted = master.mass_repair.submit(master.mass_repair.plan())
+        assert accepted, f"attempt {attempt} not resubmitted"
+        master.mass_repair.run_wave(master.mass_repair.pending())
+        job = master.mass_repair.journal.get("1:mass_repair")
+        assert job["attempts"] == attempt
+    assert job["state"] == "parked"
+    # parked: no more resubmission until an operator clears it
+    assert master.mass_repair.submit(master.mass_repair.plan()) == []
+
+
+def test_scrub_pass_skips_volume_under_mass_repair(tmp_path):
+    """Mutual exclusion, both directions, on the (volume, transition)
+    journal key: a scrub finding on a volume with an active mass_repair
+    job is skipped (stays queued), and the orchestrator skips a volume
+    the scrub pass is currently healing."""
+    master = _fake_master(tmp_path, journal=False)
+    _register(master, "a:80", "r0", {7: (list(range(0, 7)), 64)})
+    _register(master, "b:80", "r1", {7: (list(range(7, 13)), 64)})
+
+    # active mass_repair job on volume 7
+    accepted = master.mass_repair.submit(master.mass_repair.plan())
+    assert [j["volume_id"] for j in accepted] == [7]
+
+    finding = type("F", (), {
+        "volume_id": 7, "kind": "needle", "shard_id": 0,
+        "needle_id": 1, "detail": "crc", "detected_at_ms": 1})()
+    master.record_scrub_findings("a:80", [finding])
+    summary = master.repair_pass()
+    key = ("a:80", 7, "needle", 0, 1)
+    assert key in summary["skipped"]
+    assert master.scrub_findings[key]["status"] == "pending"  # requeued
+
+    # reverse: scrub pass mid-heal on volume 7 -> orchestrator defers
+    master.lifecycle.journal.update("7:mass_repair", state="done")
+    master._scrub_repairing.add(7)
+    assert master.mass_repair.submit(master.mass_repair.plan()) == []
+    master._scrub_repairing.clear()
+
+
+def test_lifecycle_skips_volume_under_mass_repair(tmp_path):
+    """The shared journal's one-transition-per-volume rule keeps every
+    lifecycle planner off a volume that mass repair holds, and the
+    controller's executor never claims mass_repair jobs."""
+    master = _fake_master(tmp_path, journal=False)
+    _register(master, "a:80", "r0", {9: (list(range(0, 7)), 64)})
+    _register(master, "b:80", "r1", {9: (list(range(7, 13)), 64)})
+    accepted = master.mass_repair.submit(master.mass_repair.plan())
+    assert [j["volume_id"] for j in accepted] == [9]
+    # a lifecycle plan for the same volume is suppressed
+    assert master.lifecycle.submit([{
+        "key": "9:vacuum", "volume_id": 9, "transition": "vacuum",
+        "collection": "", "node": "a:80", "holders": ["a:80"],
+        "bytes": 0}]) == []
+    # and the controller's executor leaves the mass_repair job alone
+    assert master.lifecycle.run_pending(wait=True) == []
+    assert master.mass_repair.pending()
+
+
+def test_lifecycle_rpc_mass_repair_actions(tmp_path):
+    """The shell's surface: mass_repair_status reports orchestrator
+    state, mass_repair_plan dry-runs the exposure-ranked plan."""
+    import json
+
+    from seaweedfs_tpu.master.grpc_handlers import MasterGrpcService
+    from seaweedfs_tpu.pb import master_pb2
+
+    master = _fake_master(tmp_path, journal=False)
+    _register(master, "a:80", "r0", {4: (list(range(0, 7)), 64)})
+    _register(master, "b:80", "r1", {4: (list(range(7, 13)), 64)})
+    svc = MasterGrpcService(master)
+    st = json.loads(svc.Lifecycle(master_pb2.LifecycleRequest(
+        action="mass_repair_status"), None).report)
+    assert st["enabled"] and st["pending"] == 0
+    plan = json.loads(svc.Lifecycle(master_pb2.LifecycleRequest(
+        action="mass_repair_plan", node="dead:80"), None).report)
+    assert [p["volume_id"] for p in plan["planned"]] == [4]
+    assert plan["planned"][0]["dead_node"] == "dead:80"
+    # a dry run journals nothing
+    assert master.mass_repair.pending() == []
+
+
+def test_eager_cache_invalidation_registry(tmp_path):
+    """Dead-node notice plumbing: every partial client / fetcher cache a
+    volume server hands out is registered, and one call drops them all
+    to force a fresh master lookup."""
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    d = tmp_path / "v"
+    d.mkdir()
+    s = VolumeServer(directories=[str(d)], master_addresses=["127.0.0.1:1"],
+                     ip="127.0.0.1", port=free_port())
+    client = s._make_partial_client(1)
+    fetch = s._make_ec_fetcher(2)
+    assert fetch is not None and client is not None
+    now = time.monotonic()
+    for c in s._loc_caches:
+        c._fetched_at = now  # simulate a fresh, trusted holder map
+    assert len(list(s._loc_caches)) == 2
+    assert s.invalidate_location_caches() == 2
+    for c in s._loc_caches:
+        assert c._fetched_at == float("-inf")

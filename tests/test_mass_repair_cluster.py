@@ -1,0 +1,391 @@
+"""Chaos: dead-node mass repair at cluster scale (ISSUE 11).
+
+Test 1 — a node holding shards of 33 EC volumes is killed while clients
+hammer reads: the master detects the death, the orchestrator ranks the
+batch by exposure (a 4-shard-loss volume is in the same batch), spreads
+rebuild targets, and every volume is rebuilt byte-identically within the
+configured repair budget with ZERO client 5xx.
+
+Test 2 — the master is SIGKILLed while mass-repair jobs are journaled
+running (held open by a delay fault on the batch serve path): the
+restarted master replays the journal and completes the batch
+exactly-once — every shard held by exactly one node, no duplicates.
+
+Setup note: EC files are generated with small test block sizes (the
+mounted EcVolume's block-size attributes are overridden to match) so 33
+volumes stay a few-KB each instead of the 1MB-padded default shards;
+the batch protocol and orchestrator under test never consult block
+sizes.  The default-size path is covered by test_ec_partial's chaos.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from seaweedfs_tpu.stats.metrics import (
+    EC_PARTIAL_BYTES,
+    REPAIR_BATCH_BYTES,
+    REPAIR_BATCH_VOLUMES,
+)
+from seaweedfs_tpu.storage.ec import constants as ecc
+from seaweedfs_tpu.storage.ec.encoder import (
+    generate_ec_files,
+    write_sorted_file_from_idx,
+)
+from seaweedfs_tpu.util import faultpoint
+
+from helpers import free_port, make_volume
+
+LARGE = 10000
+SMALL = 100
+N_SRV = 5
+
+
+def _stage_volumes(tmp_path, servers, n_volumes, victim_sids):
+    """Encode n_volumes tiny EC volumes and mount their shards across
+    `servers`; the victim (servers[0]) holds `victim_sids(v)` of each.
+    Returns {vid: {fid: payload}}."""
+    stage = tmp_path / "stage"
+    stage.mkdir()
+    needles: dict = {}
+    for v in range(1, n_volumes + 1):
+        d = stage / str(v)
+        d.mkdir()
+        vol = make_volume(str(d), volume_id=v, n_needles=10, seed=v,
+                          max_size=2000)
+        needles[v] = {}
+        for i in range(1, 11):
+            n = vol.read_needle(i)
+            needles[v][f"{v},{i:x}{n.cookie:08x}"] = bytes(n.data)
+        base = vol.file_name()
+        vol.close()
+        generate_ec_files(base, large_block_size=LARGE,
+                          small_block_size=SMALL, codec_name="cpu",
+                          slice_size=1 << 20)
+        write_sorted_file_from_idx(base)
+        vic = set(victim_sids(v))
+        assign = {j: [] for j in range(len(servers))}
+        assign[0] = sorted(vic)
+        rest = [sid for sid in range(ecc.TOTAL_SHARDS) if sid not in vic]
+        for k, sid in enumerate(rest):
+            assign[1 + k % (len(servers) - 1)].append(sid)
+        for j, sids in assign.items():
+            if not sids:
+                continue
+            tbase = servers[j].store.locations[0].base_name(v, "")
+            shutil.copy(base + ".ecx", tbase + ".ecx")
+            for sid in sids:
+                shutil.copy(base + ecc.to_ext(sid), tbase + ecc.to_ext(sid))
+            servers[j].store.mount_ec_shards(v, "", sids)
+            ev = servers[j].store.find_ec_volume(v)
+            ev.large_block_size = LARGE
+            ev.small_block_size = SMALL
+    return needles
+
+
+def _start_servers(tmp_path, master_grpc, n=N_SRV):
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    servers = []
+    for i in range(n):
+        d = tmp_path / f"vol{i}"
+        d.mkdir()
+        s = VolumeServer(
+            directories=[str(d)], master_addresses=[master_grpc],
+            ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+            rack=f"rack{i % 2}", data_center="dc1", max_volume_count=600)
+        s.start()
+        servers.append(s)
+    return servers
+
+
+@pytest.mark.chaos
+def test_chaos_dead_node_mass_repair_under_reads(tmp_path):
+    """Kill a node holding shards of 33 EC volumes under concurrent
+    client reads: detection -> exposure-ranked plan -> spread batched
+    rebuild, zero 5xx, byte identity, inside the configured bound."""
+    from seaweedfs_tpu.master.server import MasterServer
+
+    deadline_s = 90.0
+    jd = tmp_path / "journal"
+    jd.mkdir()
+    master = MasterServer(ip="127.0.0.1", port=free_port(),
+                          volume_size_limit_mb=64, pulse_seconds=0.5,
+                          lifecycle_dir=str(jd),
+                          repair_deadline_s=deadline_s)
+    master.start()
+    servers = []
+    try:
+        servers = _start_servers(tmp_path, f"127.0.0.1:{master.grpc_port}")
+        deadline = time.time() + 20
+        while time.time() < deadline and len(master.topo.nodes) < N_SRV:
+            time.sleep(0.1)
+        assert len(master.topo.nodes) == N_SRV
+
+        # victim holds 2 shards of most volumes, 4 of volume 1 — volume
+        # 1 lands at the decode floor and must plan in exposure class 0
+        V = 33
+        needles = _stage_volumes(
+            tmp_path, servers, V,
+            victim_sids=lambda v: (
+                [0, 1, 2, 3] if v == 1
+                else [v % 14, (v + 1) % 14]))
+        deadline = time.time() + 30
+        while time.time() < deadline and any(
+                len(master.topo.lookup_ec_shards(v)) < 14
+                for v in range(1, V + 1)):
+            time.sleep(0.2)
+        assert all(len(master.topo.lookup_ec_shards(v)) == 14
+                   for v in range(1, V + 1))
+
+        reader = servers[1]
+
+        def check_reads() -> int:
+            bad = 0
+            for v in (1, 5, 17, 30):
+                for fid, want in list(needles[v].items())[:3]:
+                    try:
+                        with urllib.request.urlopen(
+                                f"http://127.0.0.1:{reader.port}/{fid}",
+                                timeout=15) as r:
+                            assert r.read() == want, f"corrupt {fid}"
+                    except urllib.error.HTTPError as e:
+                        if e.code >= 500:
+                            bad += 1
+                    except OSError:
+                        bad += 1
+            return bad
+
+        assert check_reads() == 0
+
+        before_bytes = REPAIR_BATCH_BYTES.labels().value
+        before_floor = REPAIR_BATCH_VOLUMES.labels("0").value
+        before_recv = EC_PARTIAL_BYTES.labels("recv").value
+        victim = servers[0]
+        victim.stop()
+        t_kill = time.time()
+
+        errs: list = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                errs.append(check_reads())
+                time.sleep(0.1)
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+
+        def all_healed():
+            return all(len(master.topo.lookup_ec_shards(v)) == 14
+                       for v in range(1, V + 1))
+
+        try:
+            deadline = time.time() + 30
+            while (time.time() < deadline
+                   and f"127.0.0.1:{victim.port}" in master.topo.nodes):
+                time.sleep(0.2)
+            assert f"127.0.0.1:{victim.port}" not in master.topo.nodes, \
+                "death never detected"
+            deadline = time.time() + deadline_s
+            while time.time() < deadline and not all_healed():
+                time.sleep(0.3)
+        finally:
+            stop.set()
+            t.join(timeout=15)
+        elapsed = time.time() - t_kill
+        assert all_healed(), {
+            v: len(master.topo.lookup_ec_shards(v))
+            for v in range(1, V + 1)
+            if len(master.topo.lookup_ec_shards(v)) < 14}
+        assert elapsed < deadline_s, f"repair blew the bound: {elapsed}"
+        assert sum(errs) == 0, f"client 5xx during mass repair: {sum(errs)}"
+        assert check_reads() == 0
+
+        st = master.mass_repair.status()
+        assert st["counts"]["deaths"] >= 1
+        assert st["counts"]["repaired"] >= V
+        # the floor volume was classed exposure-0 and repaired
+        assert REPAIR_BATCH_VOLUMES.labels("0").value > before_floor
+        assert master.lifecycle.journal.get("1:mass_repair")["state"] == \
+            "done"
+        assert REPAIR_BATCH_BYTES.labels().value > before_bytes
+        # the batch rode the aggregated partial transport
+        assert EC_PARTIAL_BYTES.labels("recv").value > before_recv
+        # no shard duplicated by the repair
+        for v in range(1, V + 1):
+            for sid, nodes in master.topo.lookup_ec_shards(v).items():
+                assert len(nodes) == 1, (v, sid, [n.id for n in nodes])
+    finally:
+        for s in servers[1:]:
+            s.stop()
+        master.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGKILL the master mid-batch, journal resumes exactly-once
+# ---------------------------------------------------------------------------
+
+
+def _spawn_master(mport, jd, extra_env=None):
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": os.path.dirname(os.path.dirname(
+               os.path.abspath(__file__)))}
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", "master",
+         "-port", str(mport),
+         "-volumeSizeLimitMB", "64",
+         "-lifecycleDir", jd],
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, env=env)
+
+
+def _journal_jobs(jd) -> dict:
+    jobs: dict = {}
+    try:
+        with open(os.path.join(jd, "lifecycle.journal.jsonl")) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if "key" in rec:
+                    jobs[rec["key"]] = rec
+    except FileNotFoundError:
+        pass
+    return jobs
+
+
+@pytest.mark.chaos
+def test_chaos_master_sigkill_mid_batch_resumes(tmp_path):
+    """SIGKILL the master while mass-repair jobs are journaled RUNNING
+    (a delay fault on repair.batch.source holds the batch open): the
+    restarted master replays them as pending, the batch completes, and
+    every shard ends on exactly one node."""
+    jd = str(tmp_path / "journal")
+    os.makedirs(jd)
+    mport = free_port()
+    master_proc = _spawn_master(mport, jd)
+    servers = []
+    second = None
+    V = 6
+    try:
+        servers = _start_servers(tmp_path, f"127.0.0.1:{mport + 10000}")
+        # wait for the subprocess master to register everyone
+        deadline = time.time() + 90
+        up = False
+        while time.time() < deadline and not up:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mport}/cluster/status",
+                        timeout=5) as r:
+                    doc = json.loads(r.read())
+                    up = len(doc.get("Topology", {}).get(
+                        "DataNodes", doc.get("DataNodes", []))) >= N_SRV
+            except OSError:
+                time.sleep(0.5)
+                continue
+            if not up:
+                time.sleep(0.5)
+        assert up, "master subprocess never registered the volume servers"
+
+        needles = _stage_volumes(
+            tmp_path, servers, V,
+            victim_sids=lambda v: [v % 14, (v + 1) % 14])
+
+        def lookup_shards(v):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mport}/dir/lookup?volumeId={v}",
+                        timeout=5) as r:
+                    return len(json.loads(r.read()).get("locations", []))
+            except (OSError, ValueError):
+                return 0
+
+        deadline = time.time() + 30
+        while time.time() < deadline and any(
+                lookup_shards(v) == 0 for v in range(1, V + 1)):
+            time.sleep(0.3)
+
+        # every batch-served partial job stalls 1.5s: the SIGKILL window
+        # (the fault lives in THIS process — the volume servers)
+        faultpoint.set_fault("repair.batch.source", "delay", delay=1.5)
+        servers[0].stop()
+
+        deadline = time.time() + 60
+        killed = False
+        while time.time() < deadline:
+            jobs = _journal_jobs(jd)
+            running = [k for k, j in jobs.items()
+                       if j.get("transition") == "mass_repair"
+                       and j.get("state") == "running"]
+            if running:
+                master_proc.kill()
+                master_proc.wait(timeout=10)
+                killed = True
+                break
+            time.sleep(0.05)
+        assert killed, f"no mass_repair job reached running: " \
+                       f"{_journal_jobs(jd)}"
+        faultpoint.clear_fault("repair.batch.source")
+
+        second = _spawn_master(mport, jd)
+
+        def all_mounted():
+            """Exactly one holder per shard across the survivors."""
+            for v in range(1, V + 1):
+                held: dict = {}
+                for s in servers[1:]:
+                    for sid in s.store.status()["ec_volumes"].get(v, []):
+                        held[sid] = held.get(sid, 0) + 1
+                if sorted(held) != list(range(14)):
+                    return False
+                if any(c != 1 for c in held.values()):
+                    pytest.fail(f"duplicate shard holders: vol {v} {held}")
+            return True
+
+        deadline = time.time() + 120
+        while time.time() < deadline and not all_mounted():
+            time.sleep(0.5)
+        assert all_mounted(), {
+            v: sorted({sid for s in servers[1:]
+                       for sid in s.store.status()["ec_volumes"]
+                       .get(v, [])})
+            for v in range(1, V + 1)}
+
+        jobs = _journal_jobs(jd)
+        mass = {k: j for k, j in jobs.items()
+                if j.get("transition") == "mass_repair"}
+        assert len(mass) == V, sorted(mass)
+        assert all(j["state"] == "done" for j in mass.values()), mass
+        assert any(j.get("resumed") for j in mass.values()), \
+            "no job carries the journal-resume marker"
+
+        # byte identity through the healed cluster
+        reader = servers[1]
+        for v in (1, V):
+            for fid, want in list(needles[v].items())[:4]:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{reader.port}/{fid}",
+                        timeout=15) as r:
+                    assert r.read() == want, f"corrupt read {fid}"
+    finally:
+        faultpoint.clear_fault("repair.batch.source")
+        for s in servers[1:]:
+            s.stop()
+        for p in (master_proc, second):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
