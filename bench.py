@@ -1597,6 +1597,209 @@ def _cpu_rate(shard_bytes: int = 16 << 20, iters: int = 5) -> float:
     return (10 * shard_bytes) / best / 1e9
 
 
+def _geo_rates() -> dict:
+    """ISSUE 12: steady-state geo replication lag + throttled link
+    throughput, two LIVE in-process clusters (master + volume + filer
+    each) cross-linked active-active.
+
+    Two phases:
+      * steady state — paced small writes on A, per-object replication
+        lag measured as time-to-visible on B (p50/p99 seconds behind);
+      * burst — a batch of larger objects written at once, the link's
+        measured MB/s compared against its token-bucket budget
+        (SEAWEEDFS_TPU_BENCH_GEO_RATE_MBPS) while concurrent foreground
+        reads on A must hold the soak read-p99 SLO.
+    Byte-identity over the full key set gates the whole leg.
+    """
+    import os
+    import shutil
+    import socket
+    import tempfile
+    import threading
+    import urllib.request
+
+    from seaweedfs_tpu.filer.server import FilerServer
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    rate_mbps = float(os.environ.get(
+        "SEAWEEDFS_TPU_BENCH_GEO_RATE_MBPS", "2"))
+    n_steady = int(os.environ.get("SEAWEEDFS_TPU_BENCH_GEO_OBJECTS", "80"))
+    # burst sized to several times the bucket's 1s burst capacity, so
+    # the measured link rate reflects the THROTTLE, not the free burst
+    n_burst = int(os.environ.get("SEAWEEDFS_TPU_BENCH_GEO_BURST", "40"))
+    burst_kb = int(os.environ.get("SEAWEEDFS_TPU_BENCH_GEO_BURST_KB",
+                                  "128"))
+    slo_p99_s = float(os.environ.get("SEAWEEDFS_TPU_SOAK_P99_S", "2.0"))
+
+    reserved: set[int] = set()
+
+    def _port() -> int:
+        while True:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                p = s.getsockname()[1]
+            if (p <= 55000 and p not in reserved
+                    and p + 10000 not in reserved):
+                reserved.update((p, p + 10000))
+                return p
+
+    tmp = tempfile.mkdtemp(prefix="swfs-geo-")
+
+    def _cluster(tag: str, cid: int):
+        root = os.path.join(tmp, tag)
+        os.makedirs(os.path.join(root, "vol"), exist_ok=True)
+        m = MasterServer(ip="127.0.0.1", port=_port(),
+                         volume_size_limit_mb=256)
+        m.start()
+        v = VolumeServer(directories=[os.path.join(root, "vol")],
+                         ip="127.0.0.1", port=_port(),
+                         master_addresses=[f"127.0.0.1:{m.grpc_port}"],
+                         pulse_seconds=0.5, max_volume_count=16)
+        v.start()
+        f = FilerServer(masters=[f"127.0.0.1:{m.grpc_port}"],
+                        ip="127.0.0.1", port=_port(), store="sqlite",
+                        store_path=os.path.join(root, "filer.db"),
+                        cluster_id=cid, geo_rate_mbps=rate_mbps)
+        f.start()
+        deadline = time.time() + 15
+        while time.time() < deadline and len(m.topo.nodes) < 1:
+            time.sleep(0.1)
+        return m, v, f
+
+    ma, va, fa = _cluster("a", 1)
+    mb, vb, fb = _cluster("b", 2)
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    # cross-link AFTER both are up (same wiring -geoPeers does)
+    ra = GeoReplicator(fa, f"127.0.0.1:{fb.port}",
+                       journal_dir=os.path.join(tmp, "a", "geo"),
+                       rate_mbps=rate_mbps)
+    rb = GeoReplicator(fb, f"127.0.0.1:{fa.port}",
+                       journal_dir=os.path.join(tmp, "b", "geo"),
+                       rate_mbps=rate_mbps)
+    fa.geo_replicators.append(ra)
+    fb.geo_replicators.append(rb)
+    ra.start()
+    rb.start()
+
+    def _put(f, path, data):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{f.port}{path}", data=data, method="PUT")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            r.read()
+
+    def _get(f, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{f.port}{path}", timeout=30) as r:
+            return r.read()
+
+    def _visible(f, path, want, timeout_s=60.0) -> float:
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < timeout_s:
+            try:
+                if _get(f, path) == want:
+                    return time.perf_counter() - t0
+            except Exception:
+                pass
+            time.sleep(0.004)
+        raise TimeoutError(path)
+
+    from seaweedfs_tpu.stats.metrics import REGISTRY
+
+    def _geo_bytes() -> float:
+        fam = REGISTRY.family("seaweedfs_geo_bytes_total")
+        if fam is None:
+            return 0.0
+        return sum(float(line.rsplit(" ", 1)[1])
+                   for line in fam.render() if not line.startswith("#"))
+
+    objects: dict[str, bytes] = {}
+    try:
+        # -- steady state: per-object replication lag ----------------------
+        lags = []
+        for i in range(n_steady):
+            key = f"/buckets/geo/s-{i}.bin"
+            blob = os.urandom(2048)
+            _put(fa, key, blob)
+            lags.append(_visible(fb, key, blob))
+            objects[key] = blob
+        lags.sort()
+        lag_p50 = lags[len(lags) // 2]
+        lag_p99 = lags[min(len(lags) - 1, int(len(lags) * 0.99))]
+
+        # -- burst under the token bucket + foreground reads ---------------
+        bytes_before = _geo_bytes()
+        read_lat: list[float] = []
+        stop_reads = threading.Event()
+
+        def _reader():
+            keys = list(objects)
+            i = 0
+            while not stop_reads.is_set():
+                t0 = time.perf_counter()
+                try:
+                    _get(fa, keys[i % len(keys)])
+                    read_lat.append(time.perf_counter() - t0)
+                except Exception:
+                    read_lat.append(float("inf"))
+                i += 1
+                time.sleep(0.01)
+
+        rt = threading.Thread(target=_reader, daemon=True)
+        rt.start()
+        t0 = time.perf_counter()
+        burst: list[tuple[str, bytes]] = []
+        for i in range(n_burst):
+            key = f"/buckets/geo/burst-{i}.bin"
+            blob = os.urandom(burst_kb << 10)
+            _put(fa, key, blob)
+            objects[key] = blob
+            burst.append((key, blob))
+        for key, blob in burst:
+            _visible(fb, key, blob, timeout_s=300.0)
+        burst_s = time.perf_counter() - t0
+        stop_reads.set()
+        rt.join(timeout=5)
+        link_bytes = _geo_bytes() - bytes_before
+        link_mbps = link_bytes / burst_s / (1 << 20)
+        read_lat.sort()
+        read_p99 = (read_lat[int(len(read_lat) * 0.99)]
+                    if read_lat else 0.0)
+
+        # -- full-scan byte identity ---------------------------------------
+        identical = all(_get(fb, k) == v for k, v in objects.items())
+        # the A->B link must not beat ~2x its budget (the 1s bucket
+        # burst capacity makes 2x the honest bound, same as scrub); the
+        # B->A link ships nothing here (it only sees origin-1-signed
+        # applies, which it skips), so the shared-registry sum is A->B
+        bounded = link_mbps <= 2.0 * rate_mbps
+        return {
+            "geo_objects": len(objects),
+            "geo_lag_p50_s": round(lag_p50, 4),
+            "geo_lag_p99_s": round(lag_p99, 4),
+            "geo_burst_MB": round(link_bytes / (1 << 20), 3),
+            "geo_burst_seconds": round(burst_s, 2),
+            "geo_link_MBps": round(link_mbps, 3),
+            "geo_rate_MBps": rate_mbps,
+            "geo_bounded": bool(bounded),
+            "geo_read_p99_s": round(read_p99, 4),
+            "geo_read_p99_ok": bool(read_p99 <= slo_p99_s),
+            "geo_byte_identical": bool(identical),
+            "geo_ok": bool(identical and bounded
+                           and read_p99 <= slo_p99_s),
+        }
+    finally:
+        for srv in (ra, rb):
+            srv.stop()
+        for srv in (fa, fb, va, vb, ma, mb):
+            try:
+                srv.stop()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _stage_in_subprocess(
     flag: str, timeout_s: float, attempts: int = 3, backoff_s: float = 15.0,
     env_per_attempt: list[dict] | None = None,
@@ -1752,6 +1955,14 @@ def main() -> None:
             print(json.dumps(_rebuild_only_rates()))
         except Exception as exc:  # noqa: BLE001
             print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
+        return
+    if "--geo-only" in sys.argv:
+        try:
+            print(json.dumps(_geo_rates()))
+        except Exception as exc:  # noqa: BLE001
+            print(json.dumps(
+                {"geo_ok": False,
+                 "error": f"{type(exc).__name__}: {exc}"[:500]}))
         return
     if "--mass-repair-only" in sys.argv or "--mass-repair" in sys.argv:
         try:
@@ -1910,6 +2121,13 @@ def main() -> None:
         out.update(svc_res)
     except Exception as exc:  # noqa: BLE001
         out["service_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    # ISSUE 12: cross-cluster replication lag + throttled link throughput
+    # (opt-in with --geo: spins two full clusters in-process)
+    if "--geo" in _sys.argv:
+        try:
+            out.update(_geo_rates())
+        except Exception as exc:  # noqa: BLE001
+            out["geo_error"] = f"{type(exc).__name__}: {exc}"[:300]
     print(json.dumps(out))
 
 

@@ -55,6 +55,8 @@ def cmd_master(args) -> None:
         jwt_signing_key=args.jwtKey or _security_jwt_key(),
         peers=args.peers.split(",") if args.peers else None,
         raft_state_dir=args.raftDir,
+        peer_clusters=(args.peerClusters.split(",")
+                       if args.peerClusters else None),
     )
     m.start()
     print(f"master listening http={args.port} grpc={m.grpc_port}")
@@ -240,6 +242,10 @@ def cmd_filer(args) -> None:
         cipher=args.cipher,
         store_options=store_options,
         notification=notification,
+        cluster_id=args.clusterId,
+        geo_peers=args.geoPeers.split(",") if args.geoPeers else None,
+        geo_rate_mbps=args.geoRateMBps,
+        meta_log_dir=args.metaLogDir,
     )
     f.start()
     print(f"filer http={args.port} grpc={f.grpc_port}")
@@ -408,6 +414,7 @@ def cmd_s3(args) -> None:
         domain=args.domainName,
         iam_config_filer_path=args.iam_config or "",
         masters=args.master or "",
+        geo_masters=args.geoMaster or "",
     )
     s.start()
     print(f"s3 gateway http={args.port} "
@@ -707,6 +714,9 @@ def main(argv=None) -> None:
                    help="comma-separated master quorum ip:port list (raft)")
     m.add_argument("-raftDir", default=".",
                    help="directory for persisted raft state")
+    m.add_argument("-peerClusters", default="",
+                   help="comma-separated REMOTE-cluster master http "
+                        "addresses for the /cluster/geo registry")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume")
@@ -766,6 +776,20 @@ def main(argv=None) -> None:
                    action="store_true",
                    help="AES-GCM encrypt chunk data before it reaches "
                         "volume servers")
+    f.add_argument("-clusterId", type=int, default=0,
+                   help="geo replication: this cluster's nonzero id "
+                        "(the LWW tiebreak; enables HLC stamping and "
+                        "the /.geo/* surface)")
+    f.add_argument("-geoPeers", default="",
+                   help="comma-separated REMOTE-cluster filer http "
+                        "addresses to replicate to (active-active; one "
+                        "journaled link per address)")
+    f.add_argument("-geoRateMBps", type=float, default=None,
+                   help="per-link replication budget (None = env "
+                        "SEAWEEDFS_TPU_GEO_RATE_MBPS, 0 = unthrottled)")
+    f.add_argument("-metaLogDir", default="",
+                   help="durable metadata event log dir (default: "
+                        "<store path>.metalog for disk stores)")
     f.set_defaults(fn=cmd_filer)
 
     mnt = sub.add_parser("mount")
@@ -847,6 +871,11 @@ def main(argv=None) -> None:
                      default="/etc/iam/identity.json",
                      help="filer path of the IAM-managed identity json "
                           "('' disables the live-reload loop)")
+    s3p.add_argument("-geoMaster", default="",
+                     help="comma-separated REMOTE-cluster master http "
+                          "addresses: when the local filer fleet is "
+                          "entirely unreachable, reads/writes fail over "
+                          "to the remote cluster (geo failover)")
     s3p.set_defaults(fn=cmd_s3)
 
     iamp = sub.add_parser("iam")

@@ -19,7 +19,13 @@ from ..util import faultpoint, glog
 from . import filechunks
 from .filerstore import FilerStore
 from .fleet.tenant import tenant_for_path
-from .meta_log import MetaLogBuffer
+from .meta_log import (
+    GEO_HLC_KEY,
+    MetaLogBuffer,
+    decode_hlc,
+    encode_hlc,
+    tombstone_key,
+)
 
 ROOT = "/"
 DIR_BUCKETS = "/buckets"
@@ -49,7 +55,8 @@ def join_path(directory: str, name: str) -> str:
 
 class Filer:
     def __init__(self, store: FilerStore, delete_chunks_fn=None,
-                 resolve_chunks_fn=None):
+                 resolve_chunks_fn=None, meta_log_dir: str | None = None,
+                 meta_log_fsync: bool | None = None):
         """``delete_chunks_fn(file_ids: list[str])`` deletes blobs; when
         None, chunk deletion is a no-op (offline/metadata-only use).
 
@@ -57,9 +64,26 @@ class Filer:
         garbage-collection diffs run over EXPANDED lists on both sides so
         a chunk folded into a manifest is never mistaken for garbage
         (reference: MinusChunks with a lookup fn, filechunk_manifest.go).
+
+        ``meta_log_dir`` makes the metadata event log durable (fsynced
+        segment files, monotonic gap-detectable sequence numbers) — the
+        substrate the geo replication plane tails (ISSUE 12).
         """
         self.store = store
-        self.meta_log = MetaLogBuffer()
+        self.meta_log = MetaLogBuffer(dir=meta_log_dir,
+                                      fsync=meta_log_fsync)
+        # striped per-path locks serializing every stamped mutation of
+        # one path against the geo applier's LWW check-then-write
+        # (replication/geo.py): without them a concurrent newer local
+        # write landing between the applier's stamp read and its store
+        # write would be silently overwritten by an older remote event
+        self._path_locks = [threading.RLock()
+                            for _ in range(256)]  # power of two: masked
+        # geo plane: when enabled, every mutation stamps the entry with a
+        # hybrid-logical-clock (ts_ns, cluster_id) pair and deletes leave
+        # tombstones, so active-active peers can resolve last-writer-wins
+        self.cluster_id = 0
+        self.geo_stamp = False
         # fleet.TenantManager when the sharded metadata plane is on:
         # quota checks + usage accounting run HERE, in the local
         # mutation path only — meta_aggregator replays write straight to
@@ -81,6 +105,7 @@ class Filer:
     def close(self) -> None:
         self._stop.set()
         self._deletion_q.put(None)
+        self.meta_log.close()
         self.store.close()
 
     # -- hardlinks (filerstore_hardlink.go:12-40) --------------------------
@@ -145,10 +170,56 @@ class Filer:
                 return
             self.store.kv_put(key, meta.SerializeToString())
 
+    # -- geo stamping ------------------------------------------------------
+
+    def _stripe_index(self, path: str) -> int:
+        return hash(path) & (len(self._path_locks) - 1)
+
+    def path_mutation_lock(self, path: str) -> threading.RLock:
+        """The stripe lock covering ``path``: reentrant, so the geo
+        applier can hold it across its LWW check + write-through while
+        create/delete below re-acquire it."""
+        return self._path_locks[self._stripe_index(path)]
+
+    def _geo_ts(self, entry: filer_pb2.Entry | None = None,
+                relay: bool = False) -> int | None:
+        """HLC-stamp a mutation (geo mode only): stamps ``entry``'s
+        extended map and returns the clock value so the metadata event
+        carries the SAME ts as the stored stamp.  A RELAY (``relay=``:
+        the mutation carries replication signatures — geo applies,
+        within-cluster sink/aggregator writes) keeps an existing stamp:
+        LWW must compare origin write time, not relay time — it returns
+        None so the EVENT still stamps fresh and monotonic.  A direct
+        client mutation that happens to echo a stored stamp back (a
+        read-modify-write UpdateEntry: chmod, touch) is a NEW write and
+        is re-stamped — honoring the echoed stamp would make the update
+        compare equal to the overwritten version everywhere and never
+        replicate."""
+        if not self.geo_stamp:
+            return None
+        if entry is not None and GEO_HLC_KEY in entry.extended:
+            stamp = decode_hlc(bytes(entry.extended[GEO_HLC_KEY]))
+            if relay and stamp is not None:
+                self.meta_log.observe(stamp[0])
+                return None
+            del entry.extended[GEO_HLC_KEY]
+        ts = self.meta_log.next_ts()
+        if entry is not None:
+            entry.extended[GEO_HLC_KEY] = encode_hlc(ts, self.cluster_id)
+        return ts
+
     # -- create/update -----------------------------------------------------
 
     def create_entry(self, directory: str, entry: filer_pb2.Entry,
                      o_excl: bool = False, signatures=None) -> None:
+        with self.path_mutation_lock(join_path(directory, entry.name)):
+            self._create_entry_locked(directory, entry, o_excl,
+                                      signatures)
+
+    def _create_entry_locked(self, directory: str,
+                             entry: filer_pb2.Entry,
+                             o_excl: bool = False,
+                             signatures=None) -> None:
         # read the old entry MERGED so a hardlinked file's true (shared)
         # chunk list is what the rewrite diff below runs against —
         # diffing the stub would leak every shadowed chunk forever
@@ -165,6 +236,7 @@ class Filer:
         # store (including hardlink KV counters) untouched
         tenant, d_objects, d_bytes = self._tenant_delta(
             directory, entry, old)
+        geo_ts = self._geo_ts(entry, relay=bool(signatures))
         self._set_hardlink(entry)
         broke_link = (old is not None and old.hard_link_id
                       and old.hard_link_id != entry.hard_link_id)
@@ -185,16 +257,24 @@ class Filer:
             self.queue_chunk_deletion(
                 self._garbage_fids(old.chunks, entry.chunks)
             )
-        self.meta_log.append(directory, old, entry, signatures=signatures)
+        self.meta_log.append(directory, old, entry, signatures=signatures,
+                             ts=geo_ts)
 
     def update_entry(self, directory: str, entry: filer_pb2.Entry,
                      signatures=None) -> None:
+        with self.path_mutation_lock(join_path(directory, entry.name)):
+            self._update_entry_locked(directory, entry, signatures)
+
+    def _update_entry_locked(self, directory: str,
+                             entry: filer_pb2.Entry,
+                             signatures=None) -> None:
         old = self._maybe_read_hardlink(
             self.store.find_entry(directory, entry.name))
         if old is None:
             raise FileNotFoundError(join_path(directory, entry.name))
         tenant, d_objects, d_bytes = self._tenant_delta(
             directory, entry, old)
+        geo_ts = self._geo_ts(entry, relay=bool(signatures))
         self._set_hardlink(entry)
         if (old.hard_link_id
                 and old.hard_link_id != entry.hard_link_id):
@@ -208,7 +288,8 @@ class Filer:
                 )
         if tenant:
             self.tenants.record(tenant, d_objects, d_bytes)
-        self.meta_log.append(directory, old, entry, signatures=signatures)
+        self.meta_log.append(directory, old, entry, signatures=signatures,
+                             ts=geo_ts)
 
     def _tenant_delta(self, directory: str, entry: filer_pb2.Entry,
                       old: filer_pb2.Entry | None) -> tuple[str, int, int]:
@@ -265,8 +346,12 @@ class Filer:
 
     def append_chunks(self, directory: str, name: str, chunks) -> None:
         # serialize the read-modify-write: two concurrent appenders would
-        # otherwise both read the same chunk list and one would lose chunks
-        with self._append_lock:
+        # otherwise both read the same chunk list and one would lose
+        # chunks (the path stripe additionally fences the geo applier;
+        # lock order append->stripe is safe: no holder of a stripe ever
+        # takes the append lock)
+        with self._append_lock, \
+                self.path_mutation_lock(join_path(directory, name)):
             # merged read: appending to a hardlinked file must extend the
             # SHARED chunk list, not the stub's stale copy
             entry = self._maybe_read_hardlink(
@@ -293,27 +378,40 @@ class Filer:
                 if tenant:
                     self.tenants.check_quota(
                         tenant, 0 if existed else 1, added)
+            # a geo append is a fresh local write (appends never relay an
+            # origin stamp), so drop any stale stamp before re-stamping
+            entry.extended.pop(GEO_HLC_KEY, None)
+            geo_ts = self._geo_ts(entry)
             self._set_hardlink(entry)
             self.store.insert_entry(directory, entry)
             if tenant:
                 self.tenants.record(tenant, 0 if existed else 1, added)
-            self.meta_log.append(directory, None, entry)
+            self.meta_log.append(directory, None, entry, ts=geo_ts)
 
-    def _ensure_parents(self, directory: str, signatures=None) -> None:
+    def _ensure_parents(self, directory: str, signatures=None,
+                        stamp: bytes | None = None) -> None:
         """mkdir -p the ancestor chain (filer.go ensures parent dirs).
         The dir-creation events inherit the mutation's signatures so
-        bidirectional sync filters them like the triggering write."""
+        bidirectional sync filters them like the triggering write.
+
+        ``stamp`` (a geo apply relaying a remote mkdir) pins the created
+        dirs to the ORIGIN's HLC: without it they would stamp as local
+        apply-time, and a backlog-drained delete/rename of the dir —
+        carrying the origin's older hlc — would lose LWW to the dir's
+        own arrival time and never apply."""
         if directory in ("/", ""):
             return
         parent, name = split_path(directory)
         existing = self.store.find_entry(parent, name)
         if existing is not None:
             return
-        self._ensure_parents(parent, signatures=signatures)
+        self._ensure_parents(parent, signatures=signatures, stamp=stamp)
         d = filer_pb2.Entry(name=name, is_directory=True)
         d.attributes.crtime = int(time.time())
         d.attributes.mtime = d.attributes.crtime
         d.attributes.file_mode = 0o40755  # dir bit
+        if stamp:
+            d.extended[GEO_HLC_KEY] = stamp
         self.store.insert_entry(parent, d)
         self.meta_log.append(parent, None, d, signatures=signatures)
 
@@ -341,7 +439,19 @@ class Filer:
                      is_recursive: bool = False,
                      ignore_recursive_error: bool = False,
                      is_delete_data: bool = True,
-                     signatures=None) -> None:
+                     signatures=None,
+                     tombstone: bytes | None = None) -> None:
+        with self.path_mutation_lock(join_path(directory, name)):
+            self._delete_entry_locked(
+                directory, name, is_recursive, ignore_recursive_error,
+                is_delete_data, signatures, tombstone)
+
+    def _delete_entry_locked(self, directory: str, name: str,
+                             is_recursive: bool = False,
+                             ignore_recursive_error: bool = False,
+                             is_delete_data: bool = True,
+                             signatures=None,
+                             tombstone: bytes | None = None) -> None:
         entry = self.store.find_entry(directory, name)
         if entry is None:
             raise FileNotFoundError(join_path(directory, name))
@@ -360,6 +470,20 @@ class Filer:
             self._delete_hardlink(entry.hard_link_id, is_delete_data)
         elif is_delete_data and entry.chunks:
             self.queue_chunk_deletion(self._all_fids(entry.chunks))
+        geo_ts = None
+        if self.geo_stamp:
+            # tombstone: a late-arriving older geo create must not
+            # resurrect this path (replication/geo.py LWW compare).  A
+            # relay (geo apply) passes ``tombstone=`` carrying the
+            # ORIGIN's stamp: it must be in the KV BEFORE the event is
+            # appended below, or a tailing replicator relaying the
+            # delete onward could read a fresh local stamp and inflate
+            # the fence around a 3+-cluster mesh
+            if tombstone is None:
+                geo_ts = self.meta_log.next_ts()
+                tombstone = encode_hlc(geo_ts, self.cluster_id)
+            self.store.kv_put(tombstone_key(join_path(directory, name)),
+                              tombstone)
         self.store.delete_entry(directory, name)
         if self.tenants is not None and not entry.is_directory:
             tenant = tenant_for_path(join_path(directory, name))
@@ -367,7 +491,7 @@ class Filer:
                 self.tenants.record(tenant, -1, -_entry_bytes(entry))
         self.meta_log.append(
             directory, entry, None, delete_chunks=is_delete_data,
-            signatures=signatures,
+            signatures=signatures, ts=geo_ts,
         )
 
     def _delete_tree(self, path: str, is_delete_data: bool) -> None:
@@ -400,6 +524,22 @@ class Filer:
                      new_dir: str, new_name: str) -> None:
         """AtomicRenameEntry (filer_grpc_server_rename.go): move the entry
         and, for directories, re-root all children."""
+        # both endpoint stripes, in index order (deadlock-free vs a
+        # concurrent rename crossing the same pair the other way)
+        stripes = sorted({
+            self._stripe_index(join_path(old_dir, old_name)),
+            self._stripe_index(join_path(new_dir, new_name))})
+        for i in stripes:
+            self._path_locks[i].acquire()
+        try:
+            self._rename_entry_locked(old_dir, old_name, new_dir,
+                                      new_name)
+        finally:
+            for i in reversed(stripes):
+                self._path_locks[i].release()
+
+    def _rename_entry_locked(self, old_dir: str, old_name: str,
+                             new_dir: str, new_name: str) -> None:
         entry = self.store.find_entry(old_dir, old_name)
         if entry is None:
             raise FileNotFoundError(join_path(old_dir, old_name))
@@ -409,6 +549,13 @@ class Filer:
         moved = filer_pb2.Entry()
         moved.CopyFrom(entry)
         moved.name = new_name
+        # a rename is a fresh write at the new path: re-stamp (and
+        # tombstone the old path so geo peers don't resurrect it)
+        moved.extended.pop(GEO_HLC_KEY, None)
+        geo_ts = self._geo_ts(moved)
+        if geo_ts is not None:
+            self.store.kv_put(tombstone_key(join_path(old_dir, old_name)),
+                              encode_hlc(geo_ts, self.cluster_id))
         self.store.insert_entry(new_dir, moved)
         if entry.is_directory:
             old_path = join_path(old_dir, old_name)
@@ -428,7 +575,7 @@ class Filer:
                 if t_new:
                     self.tenants.record(t_new, 1, size)
         self.meta_log.append(
-            old_dir, entry, moved, new_parent_path=new_dir,
+            old_dir, entry, moved, new_parent_path=new_dir, ts=geo_ts,
         )
 
     def _move_children(self, old_path: str, new_path: str) -> None:

@@ -78,20 +78,39 @@ class FleetFilerClient:
     def _run(self, path: str, fn):
         """fn(FilerClient) on the owner of ``path``, failing over in
         ring order; a transport failure forces a membership refresh so
-        the second round routes on a post-mortem ring."""
+        the second round routes on a post-mortem ring.  When the WHOLE
+        local cluster is gone (empty ring or every shard dark) and a
+        geo fallback is configured, the operation fails over to the
+        remote cluster — a gateway survives its local cluster dying."""
         tried: set[str] = set()
         last: BaseException | None = None
+        outage = False  # no usable local membership at all
+        candidates: list[str] = []
+        geo = self.router.remote is not None
+        # with a geo fallback configured, total local loss must be
+        # PROVEN before dodging to the remote cluster: sweep EVERY
+        # local shard instead of stopping at the bounded-latency try
+        # cap (a capped sweep over a >MAX_TRIES fleet would classify
+        # an all-dark cluster as a partial outage and 503 forever)
+        local_cap = None if geo else MAX_TRIES
         for _round in range(2):
             try:
                 candidates = self.router.candidates(path)
-            except LookupError as e:
-                # empty ring (master up, zero live filer registrations):
-                # an outage, so surface the retryable 503, never a 500
-                raise FilerUnavailable(f"filer ring is empty: {e}")
+            except (LookupError, OSError) as e:
+                # empty ring (LookupError: master up, zero live
+                # registrations) or discovery failure (IOError: no
+                # master answered, no cached ring): no local membership
+                # either way — an outage; try the geo fallback before
+                # surfacing.  Anything else (a routing BUG) propagates:
+                # masking it as an outage would silently shift all
+                # traffic to the remote cluster
+                last = last or e
+                outage = True
+                break
             for addr in candidates:
                 if addr in tried:
                     continue
-                if len(tried) >= MAX_TRIES:
+                if local_cap is not None and len(tried) >= local_cap:
                     break
                 tried.add(addr)
                 try:
@@ -105,7 +124,34 @@ class FleetFilerClient:
                 self.router.note_route(
                     "ok" if len(tried) == 1 else "failover")
                 return result
+        if not outage and any(a not in tried for a in candidates):
+            # only reachable WITHOUT a geo fallback: the try cap
+            # stopped the sweep with live-listed shards still untried —
+            # a partial outage; surface the retryable 503
+            self.router.note_route("error")
+            raise FilerUnavailable(
+                f"no filer shard reachable for {path!r} within "
+                f"{MAX_TRIES} tries ({sorted(tried)}): {last}")
+        remote_tried = 0
+        for addr in self.router.remote_candidates(path):
+            if addr in tried:
+                continue
+            if remote_tried >= MAX_TRIES:
+                break
+            tried.add(addr)
+            remote_tried += 1
+            try:
+                result = fn(self._client(addr))
+            except BaseException as e:  # noqa: BLE001 — classified
+                if not _is_transport_failure(e):
+                    raise
+                last = e
+                continue
+            self.router.note_route("remote")
+            return result
         self.router.note_route("error")
+        if outage and not tried:
+            raise FilerUnavailable(f"no local filer membership: {last}")
         raise FilerUnavailable(
             f"no filer shard reachable for {path!r} "
             f"(tried {sorted(tried)}): {last}")

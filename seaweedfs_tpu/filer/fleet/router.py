@@ -48,7 +48,9 @@ class FleetRouter:
     def __init__(self, masters: list[str] | None = None,
                  filers: list[str] | None = None,
                  vnodes: int = DEFAULT_VNODES,
-                 membership_ttl_s: float = MEMBERSHIP_TTL_S):
+                 membership_ttl_s: float = MEMBERSHIP_TTL_S,
+                 remote_masters: list[str] | None = None,
+                 remote_filers: list[str] | None = None):
         self.masters = [m.strip() for m in (masters or []) if m.strip()]
         self.static_filers = [f.strip() for f in (filers or []) if f.strip()]
         if not self.masters and not self.static_filers:
@@ -60,6 +62,17 @@ class FleetRouter:
         self._fetched_at = time.monotonic() if self.static_filers else 0.0
         if self.static_filers:
             RING_NODES.labels().set(len(self.static_filers))
+        # geo failover (ISSUE 12): a second, REMOTE-cluster ring the
+        # fleet client falls back to when every local shard is gone —
+        # read-from-nearest (local cluster first, always), fail over to
+        # the remote cluster only on total local loss.  Active-active
+        # replication makes remote writes safe: they ship back once the
+        # local cluster rejoins.
+        self.remote: FleetRouter | None = None
+        if remote_masters or remote_filers:
+            self.remote = FleetRouter(
+                masters=remote_masters, filers=remote_filers,
+                vnodes=vnodes, membership_ttl_s=membership_ttl_s)
 
     # -- membership --------------------------------------------------------
 
@@ -132,6 +145,18 @@ class FleetRouter:
     def owner(self, path: str) -> str:
         faultpoint.inject(FP_RING_ROUTE, ctx=path)
         return self.refresh().lookup(shard_key(path))
+
+    def remote_candidates(self, path: str) -> list[str]:
+        """Failover-ordered REMOTE-cluster filers for ``path``; empty
+        when no geo fallback is configured or the remote cluster is
+        undiscoverable (the caller surfaces the local failure then)."""
+        if self.remote is None:
+            return []
+        try:
+            return self.remote.candidates(path)
+        except Exception as e:  # noqa: BLE001 — both clusters dark
+            glog.warning("geo-failover discovery failed: %s", e)
+            return []
 
     def note_route(self, result: str) -> None:
         """result ∈ ok | failover | error (one per routed operation)."""

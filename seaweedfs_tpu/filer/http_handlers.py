@@ -86,6 +86,8 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
         q = urllib.parse.parse_qs(u.query)
         if path == "/debug/tenants":
             return self._serve_tenants(q)
+        if path == "/.geo/status":
+            return self._serve_geo_status()
         # debug/observability surface (exact paths, ahead of the namespace)
         if serve_debug_http(self, path):
             return
@@ -196,9 +198,87 @@ class FilerHttpHandler(BaseHTTPRequestHandler):
             })
         self._send(status, data, mime, extra)
 
+    # -- geo replication (replication/geo.py) ------------------------------
+
+    def _serve_geo_status(self):
+        fs = self.filer_server
+        if fs.geo_applier is None:
+            return self._json(404, {"error": "geo replication not enabled"})
+        return self._json(200, {
+            "clusterId": fs.filer.cluster_id,
+            "signature": fs.signature,
+            "links": [r.status() for r in fs.geo_replicators],
+            "applier": fs.geo_applier.status(),
+        })
+
+    def _geo_post(self):
+        """POST /.geo/apply — one remote-cluster event, LWW-resolved.
+
+        Replication traffic bypasses tenant admission (it is background
+        budgeted by the sender's token bucket); quota enforcement still
+        runs inside the write path and surfaces as a permanent 403."""
+        fs = self.filer_server
+        u = urllib.parse.urlparse(self.path)
+        if u.path != "/.geo/apply" or fs.geo_applier is None:
+            # the posted body goes unread: the connection must not be
+            # reused or the next request would parse out of object bytes
+            self.close_connection = True
+            return self._send(404, json.dumps(
+                {"error": "geo replication not enabled"}).encode(),
+                extra={"Connection": "close"})
+        q = urllib.parse.parse_qs(u.query)
+
+        def qi(name):
+            try:
+                return int(q.get(name, ["0"])[0] or 0)
+            except ValueError:
+                raise ValueError(f"{name} must be an integer") from None
+
+        length = int(self.headers.get("Content-Length", 0))
+        from ..replication.geo import MAX_BODY_BYTES
+        if length > MAX_BODY_BYTES:
+            # the body is buffered whole before apply — an unbounded
+            # Content-Length must not be an OOM lever.  The body goes
+            # unread, so the connection cannot be reused afterwards.
+            self.close_connection = True
+            return self._send(413, json.dumps({
+                "error": f"geo body {length} exceeds {MAX_BODY_BYTES}",
+            }).encode(), extra={"Connection": "close"})
+        body = self.rfile.read(length)
+        try:
+            out = fs.geo_applier.apply(
+                origin=qi("origin"), source=qi("src"), seq=qi("seq"),
+                hlc=qi("hlc"), op=q.get("op", [""])[0],
+                path=q.get("path", [""])[0], data=body,
+                mime=q.get("mime", [""])[0],
+                log=q.get("log", [""])[0],
+            )
+        except QuotaExceededError as e:
+            return self._send(403, json.dumps({"error": str(e)}).encode(),
+                              extra={"X-Seaweed-Reject": "quota"})
+        except ValueError as e:
+            from ..replication.geo import GeoSkewError
+            if isinstance(e, GeoSkewError):
+                # remote-STATE rejection (sender's clock broken, clears
+                # over operator time): marked so the sender HOLDS the
+                # link instead of skipping events past its checkpoint
+                return self._send(
+                    400, json.dumps({"error": str(e)}).encode(),
+                    extra={"X-Seaweed-Reject": "skew"})
+            return self._json(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — sender retries on 500
+            return self._json(500, {
+                "error": str(e),
+                "trace": trace.current_trace_id() or "",
+            })
+        return self._json(200, out)
+
     # -- write -------------------------------------------------------------
 
     def do_POST(self):
+        if self.path.startswith("/.geo/"):
+            with http_request(self, "filer", "geo"):
+                return self._geo_post()
         with http_request(self, "filer", "post"):
             self._admitted(self._upload)
 

@@ -61,6 +61,10 @@ class FilerServer:
         peers: list[str] | None = None,  # peer filer HTTP addresses
         cipher: bool = False,  # AES-GCM encrypt chunk blobs (cipher.go)
         store_options: dict | None = None,  # extra store kwargs (redis etc.)
+        cluster_id: int = 0,  # geo: this cluster's identity (nonzero = geo on)
+        geo_peers: list[str] | None = None,  # remote cluster filer http addrs
+        geo_rate_mbps: float | None = None,  # per-link budget; None = env
+        meta_log_dir: str = "",  # durable event log dir; "" = derived
     ):
         self.masters = list(masters)
         self.ip = ip
@@ -80,14 +84,34 @@ class FilerServer:
             f"filer@{ip}:{port}", self.masters,
             client_type="filer", http_address=f"{ip}:{port}")
         opts = dict(store_options or {})
+        # durable metadata event log (ISSUE 12): sequence-numbered
+        # segments beside the store, so the geo replicator (and
+        # within-cluster followers) resume across restarts with gap
+        # detection instead of today's lossy in-memory ring.  Memory
+        # stores stay memory-logged unless a dir is forced.  Per-append
+        # fsync is paid only by geo-enabled filers (a non-geo filer's
+        # log survives process SIGKILL via the page cache; host power
+        # loss degrades to torn-tail truncation + gap-driven resync) —
+        # SEAWEEDFS_TPU_META_LOG_FSYNC overrides either default.
+        geo_on = cluster_id != 0 or bool(geo_peers)
+        log_fsync = (None if "SEAWEEDFS_TPU_META_LOG_FSYNC" in os.environ
+                     else geo_on)
+        log_dir = meta_log_dir or None
+        if log_dir is None and store != "memory" and not os.environ.get(
+                "SEAWEEDFS_TPU_META_LOG_DISABLE"):
+            log_dir = f"{store_path}.metalog"
         if store == "memory":
             self.filer = Filer(make_store("memory"), self._delete_chunks,
-                               resolve_chunks_fn=self.resolve_chunks)
+                               resolve_chunks_fn=self.resolve_chunks,
+                               meta_log_dir=meta_log_dir or None,
+                               meta_log_fsync=log_fsync)
         else:
             self.filer = Filer(
                 make_store(store, path=store_path, **opts),
                 self._delete_chunks,
                 resolve_chunks_fn=self.resolve_chunks,
+                meta_log_dir=log_dir,
+                meta_log_fsync=log_fsync,
             )
         # tenant plane (fleet): quotas checked in the Filer mutation
         # path, WFQ admission consulted by the HTTP serving layer.
@@ -116,6 +140,26 @@ class FilerServer:
                 f"{ip}:{self.grpc_port}",
                 [_peer_grpc_addr(p) for p in self.peers],
             )
+        # geo plane (ISSUE 12): active-active cross-cluster replication.
+        # A nonzero cluster id turns on HLC stamping + delete tombstones
+        # (the LWW substrate) and the /.geo/* surface; each geo peer gets
+        # its own replicator link with a journaled checkpoint.
+        self.geo_peers = [p.strip() for p in (geo_peers or [])
+                          if p.strip()]
+        self.filer.cluster_id = cluster_id
+        self.filer.geo_stamp = bool(self.geo_peers) or cluster_id != 0
+        self.geo_applier = None
+        self.geo_replicators = []
+        if self.filer.geo_stamp:
+            from ..replication.geo import GeoApplier, GeoReplicator
+
+            self.geo_applier = GeoApplier(self)
+            geo_dir = (f"{store_path}.geo" if store != "memory" else None)
+            self.geo_replicators = [
+                GeoReplicator(self, peer, journal_dir=geo_dir,
+                              rate_mbps=geo_rate_mbps)
+                for peer in self.geo_peers
+            ]
         self._brokers: dict[str, list[str]] = {}
         self._grpc_server = None
         self._httpd = None
@@ -196,10 +240,17 @@ class FilerServer:
             self._metricsd = serve_metrics(self.metrics_port)
         if self.meta_aggregator is not None:
             self.meta_aggregator.start()
-        glog.info("filer started http=%d grpc=%d peers=%d",
-                  self.port, self.grpc_port, len(self.peers))
+        for rep in self.geo_replicators:
+            rep.start()
+        glog.info("filer started http=%d grpc=%d peers=%d geo_links=%d",
+                  self.port, self.grpc_port, len(self.peers),
+                  len(self.geo_replicators))
 
     def stop(self) -> None:
+        for rep in self.geo_replicators:
+            rep.stop()
+        if self.geo_applier is not None:
+            self.geo_applier.flush()  # persist watermarks before close
         if self.meta_aggregator is not None:
             self.meta_aggregator.stop()
         self.master_client.stop()
@@ -245,7 +296,8 @@ class FilerServer:
     def write_file(self, path: str, data: bytes, mime: str = "",
                    collection: str = "", replication: str = "",
                    ttl: str = "",
-                   signatures: list[int] | None = None) -> filer_pb2.Entry:
+                   signatures: list[int] | None = None,
+                   extended: dict | None = None) -> filer_pb2.Entry:
         """Auto-chunking upload: split, assign+upload each chunk, CreateEntry."""
         directory, name = split_path(path)
         # quota pre-check BEFORE the chunk uploads: create_entry re-runs
@@ -270,6 +322,11 @@ class FilerServer:
         elif data:
             chunks = [upload_one(0)]
         entry = filer_pb2.Entry(name=name)
+        for k, v in (extended or {}).items():
+            # caller-supplied extended attrs (the geo applier passes the
+            # ORIGIN's HLC stamp through here so LWW compares origin
+            # write time, not relay time)
+            entry.extended[k] = v
         entry.chunks.extend(self.manifestize_chunks(chunks, path=path))
         entry.attributes.file_size = len(data)
         entry.attributes.mime = mime

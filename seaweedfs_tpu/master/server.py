@@ -63,6 +63,7 @@ class MasterServer:
         lifecycle_rate_mbps: float | None = None,  # None = env, 0 = off
         lifecycle_policy: dict | None = None,
         repair_deadline_s: float | None = None,  # None = env, 0 = no bound
+        peer_clusters: list[str] | None = None,  # remote master http addrs
     ):
         self.ip = ip
         self.port = port
@@ -151,6 +152,12 @@ class MasterServer:
 
         self.mass_repair = MassRepairOrchestrator(
             self, self.lifecycle, deadline_s=repair_deadline_s)
+        # geo scenario (ISSUE 12): the peer-cluster registry behind
+        # GET /cluster/geo — remote master addresses this cluster
+        # replicates with; link health/lag comes from the filer
+        # heartbeat stats snapshots (the seaweedfs_geo_* families)
+        self.peer_clusters = [p.strip() for p in (peer_clusters or [])
+                              if p.strip()]
         self._rng = random.Random()
         # raft quorum (raft_server.go:21-46): multi-master when peers given
         self.raft = None
@@ -914,6 +921,46 @@ class MasterServer:
         with self._clients_lock:
             return {k: dict(v) for k, v in self.clients.items()}
 
+    # -- geo registry (ISSUE 12) ------------------------------------------
+
+    def geo_status(self) -> dict:
+        """The /cluster/geo document: peer-cluster reachability (probed
+        live, concurrently, 1s each) plus every geo link sample the
+        filers' heartbeat snapshots carried (lag, shipped/applied/
+        conflict counters) — the operator's one-stop geo view."""
+        from ..util import connpool
+
+        def probe(addr: str) -> dict:
+            try:
+                with connpool.request(
+                        "GET", f"http://{addr}/cluster/status",
+                        timeout=2) as r:
+                    doc = json.loads(r.read())
+                return {
+                    "reachable": True,
+                    "leader": doc.get("Leader", ""),
+                    "dataNodes": len(doc.get("DataNodes") or {}),
+                    "filers": len(doc.get("Filers") or {}),
+                }
+            except Exception as e:  # noqa: BLE001 — a dead peer is data
+                return {"reachable": False, "error": str(e)[:200]}
+
+        peers = {}
+        if self.peer_clusters:
+            futures = {
+                addr: self.federation_pool.submit(probe, addr)
+                for addr in self.peer_clusters
+            }
+            peers = {addr: fut.result() for addr, fut in futures.items()}
+        links: dict[str, dict] = {}
+        for instance, snap in self.stats_snapshots_snapshot().items():
+            geo = {name: value for name, value in snap.get("samples", [])
+                   if name.startswith("seaweedfs_geo_")
+                   or name.startswith("seaweedfs_meta_listener_")}
+            if geo:
+                links[instance] = geo
+        return {"peerClusters": peers, "links": links}
+
 
 # ---------------------------------------------------------------------------
 # HTTP API (/dir/assign, /dir/lookup, /cluster/status, /vol/vacuum)
@@ -934,6 +981,7 @@ _MASTER_OPS = {
     "/cluster/metrics": "cluster.metrics",
     "/cluster/traces": "cluster.traces",
     "/cluster/lifecycle": "cluster.lifecycle",
+    "/cluster/geo": "cluster.geo",
     "/vol/vacuum": "vol.vacuum", "/vol/grow": "vol.grow",
     "/vol/repair": "vol.repair",
     "/vol/status": "vol.status", "/col/delete": "col.delete",
@@ -1114,6 +1162,9 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         if u.path == "/cluster/lifecycle":
             # lifecycle controller status: policies, journal, job states
             return self._json(200, self.master.lifecycle.status())
+        if u.path == "/cluster/geo":
+            # peer-cluster registry + per-link replication health
+            return self._json(200, self.master.geo_status())
         if u.path == "/cluster/traces":
             from ..telemetry import parse_trace_query
             from . import observability

@@ -1,0 +1,887 @@
+"""Cross-cluster active-active replication (ISSUE 12).
+
+Two pieces, both hosted inside the filer process:
+
+* ``GeoReplicator`` — one per remote cluster link.  Tails the local
+  filer's DURABLE metadata event log (filer/meta_log.py) from a
+  journaled checkpoint (the PR 9 crash-safe JSONL journal), ships every
+  event PLUS the referenced object bytes to the remote cluster's filer
+  over the connpool, and paces itself with a per-link token bucket — the
+  same background-budget discipline as scrub/lifecycle traffic
+  (arXiv:1309.0186): async batched shipping, never synchronous dual
+  writes (arXiv:1709.05365's cold-path economics).
+
+  Crash safety: the checkpoint advances only after the remote
+  acknowledged the event, and re-shipping after a crash is deduplicated
+  remotely by the per-link watermark — together, exactly-once apply.
+  Sequence numbers are contiguous by construction; a checkpoint that
+  fell behind the log's retention raises ``MetaLogGap`` and the link
+  RESYNCS from a full namespace walk (LWW makes the overlap safe).
+
+* ``GeoApplier`` — the receiving side, behind the filer's
+  ``POST /.geo/apply`` endpoint.  Resolves ACTIVE-ACTIVE conflicts by
+  last-writer-wins on the hybrid logical clock every event carries
+  (ts_ns stamped by the origin's meta log, origin cluster id as the
+  tiebreak), consults delete tombstones so an older create cannot
+  resurrect a deleted object, folds the remote clock into the local one
+  (``meta_log.observe``), and counts every LWW rejection in
+  ``seaweedfs_geo_conflicts_total`` — conflicts are surfaced, never
+  silent.  Applied mutations re-enter the local write path carrying the
+  ORIGIN's signature, which is what keeps a bidirectional link loop-free
+  (the replicator skips events signed by its own remote).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+import urllib.error
+import urllib.parse
+from collections import deque
+
+from ..filer.filer import join_path, split_path
+from ..filer.meta_log import (
+    GEO_HLC_KEY,
+    MetaLogGap,
+    decode_hlc,
+    encode_hlc,
+    entry_hlc,
+    tombstone_key,
+)
+from ..maintenance.journal import JobJournal
+from ..stats.metrics import (
+    GEO_APPLIED,
+    GEO_BYTES,
+    GEO_CONFLICTS,
+    GEO_EVENTS,
+    GEO_LAG,
+)
+from ..util import connpool, failsafe, faultpoint, glog
+from .sink import FP_REPLICATION_APPLY
+
+RATE_ENV = "SEAWEEDFS_TPU_GEO_RATE_MBPS"
+DEFAULT_RATE_MBPS = 8.0
+
+# per-event wire overhead charged to the link budget on top of the body
+EVENT_OVERHEAD_BYTES = 256
+
+# checkpoint cadence: re-shipping the window after a crash is dedup'd by
+# the remote watermark, so a per-event fsync'd journal write would buy
+# nothing but write amplification
+CHECKPOINT_EVERY = 20
+CHECKPOINT_INTERVAL_S = 1.0
+
+# tombstones older than this are garbage-collected when next read — any
+# create they could have fenced off is long since shipped both ways
+TOMBSTONE_RETAIN_S = float(os.environ.get(
+    "SEAWEEDFS_TPU_GEO_TOMBSTONE_RETAIN_S", str(7 * 86400)))
+
+# one geo event materializes its whole object body in RAM on BOTH
+# filers (sender _read_data, applier body buffer); beyond this size the
+# sender skips the event (counted as an error) and the applier refuses
+# with 413 — an unbounded Content-Length on /.geo/apply must not be an
+# OOM lever
+MAX_BODY_BYTES = int(os.environ.get(
+    "SEAWEEDFS_TPU_GEO_MAX_BODY_MB", "256")) << 20
+
+# events stamped further ahead of the local clock than this are REFUSED
+# (400, permanent): folding a corrupt/forged far-future hlc into the
+# local clock would poison BOTH clusters' HLCs persistently and fence
+# the path with an unbeatable tombstone
+MAX_SKEW_S = float(os.environ.get("SEAWEEDFS_TPU_GEO_MAX_SKEW_S", "3600"))
+
+# namespaces that never cross clusters: each cluster owns its own config
+# (filer.conf, IAM identities) and broker internals
+SKIP_PREFIXES = ("/etc/", "/topics/")
+
+_WM_PREFIX = b"GeoSeq"
+
+
+def _wm_key(source_signature: int) -> bytes:
+    return _WM_PREFIX + struct.pack(">i", source_signature)
+
+
+class GeoSkewError(ValueError):
+    """Event hlc too far ahead of the local clock: REMOTE-state
+    rejection (the sender's clock is broken), not a poison event — the
+    HTTP layer marks it so the sender holds the link instead of
+    skipping the event past its checkpoint forever."""
+
+
+def _iter_dir(store, directory: str):
+    """Paginated listing of one directory — shared by the applier's
+    subtree walks and the resync shipper so the resume/termination
+    logic cannot drift between copies."""
+    start = ""
+    while True:
+        batch = list(store.list_entries(directory, start_from=start,
+                                        limit=1024))
+        if not batch:
+            return
+        yield from batch
+        start = batch[-1].name
+
+
+class GeoApplier:
+    """LWW apply of remote cluster events into the local filer.
+
+    Idempotency key = (source store signature, source LOG identity,
+    source log seq): the per-source watermark persisted in the store KV
+    drops re-shipped events, so a replicator crash-resuming behind its
+    checkpoint applies each event exactly once.  The log identity scopes
+    the seq comparison to ONE meta-log incarnation — a source whose log
+    dir was wiped restarts at seq 1 with a new log id, and its events
+    must not be swallowed as "duplicates" of the OLD log's higher
+    watermark.  seq==0 events (namespace resync walks) skip the
+    watermark and rely on LWW alone."""
+
+    PERSIST_EVERY = 64
+    PERSIST_INTERVAL_S = 2.0
+
+    def __init__(self, fs):
+        self.fs = fs  # FilerServer
+        self._lock = threading.Lock()
+        self._watermarks: dict[int, tuple[int, str]] = {}  # src->(seq,log)
+        self._dirty = 0
+        self._last_persist = time.monotonic()
+
+    # -- watermarks --------------------------------------------------------
+
+    def watermark(self, source: int) -> tuple[int, str]:
+        """-> (seq, log_id) high-water mark for one source; log_id ""
+        for pre-log-identity senders/records (seq compared unscoped)."""
+        with self._lock:
+            wm = self._watermarks.get(source)
+            if wm is not None:
+                return wm
+            raw = self.fs.filer.store.kv_get(_wm_key(source))
+            if raw and len(raw) >= 8:
+                wm = (struct.unpack(">q", raw[:8])[0],
+                      raw[8:].decode("ascii", "replace"))
+            else:
+                wm = (0, "")
+            self._watermarks[source] = wm
+            return wm
+
+    def _advance(self, source: int, seq: int, log: str) -> None:
+        with self._lock:
+            cur_seq, cur_log = self._watermarks.get(source, (0, ""))
+            if seq <= cur_seq and log == cur_log:
+                return
+            # a CHANGED log id rebinds the watermark to the new
+            # incarnation (seq restarts); same-log marks only advance
+            self._watermarks[source] = (max(seq, cur_seq)
+                                        if log == cur_log else seq, log)
+            self._dirty += 1
+            now = time.monotonic()
+            if (self._dirty >= self.PERSIST_EVERY
+                    or now - self._last_persist > self.PERSIST_INTERVAL_S):
+                self._persist_locked()
+
+    def _persist_locked(self) -> None:
+        for source, (seq, log) in self._watermarks.items():
+            self.fs.filer.store.kv_put(
+                _wm_key(source),
+                struct.pack(">q", seq) + log.encode("ascii", "replace"))
+        self._dirty = 0
+        self._last_persist = time.monotonic()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._persist_locked()
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"watermarks": {src: seq for src, (seq, _log)
+                                   in self._watermarks.items()}}
+
+    # -- LWW core ----------------------------------------------------------
+
+    def _local_stamp(self, path: str):
+        """-> (best local (hlc, cluster) stamp or None, current entry).
+        The stamp is the max of the live entry's stamp, any delete
+        tombstone at the path, and any ANCESTOR tombstone — a recursive
+        directory delete fences the whole subtree with ONE tombstone at
+        the directory (children get none), so a backlogged older write
+        inside the subtree must compare against the ancestors too or it
+        resurrects the deleted tree on this cluster only.  Tombstones
+        past TOMBSTONE_RETAIN_S are GC'd here lazily (there is no store
+        KV scan to sweep them eagerly; unrevisited paths keep their row
+        until the next remote touch)."""
+        filer = self.fs.filer
+        entry = filer.find_entry(path)
+        stamps = []
+        s = entry_hlc(entry) if entry is not None and entry.name else None
+        if s is not None:
+            stamps.append(s)
+        probe = path
+        while probe and probe != "/":
+            tomb = decode_hlc(filer.store.kv_get(tombstone_key(probe)))
+            if tomb is not None:
+                if (time.time_ns() - tomb[0]) / 1e9 > TOMBSTONE_RETAIN_S:
+                    filer.store.kv_delete(tombstone_key(probe))
+                else:
+                    stamps.append(tomb)
+            probe = probe.rsplit("/", 1)[0]
+        return (max(stamps) if stamps else None), entry
+
+    def apply(self, origin: int, source: int, seq: int, hlc: int, op: str,
+              path: str, data: bytes = b"", mime: str = "",
+              log: str = "") -> dict:
+        """Apply one remote event; returns {"result": ...}.
+
+        result ∈ ok | dup | conflict — all three mean "processed, sender
+        may advance".  Errors raise (the sender retries transients)."""
+        faultpoint.inject(FP_REPLICATION_APPLY, ctx=f"geo {path}")
+        origin_l = str(origin)
+        if hlc and hlc > time.time_ns() + MAX_SKEW_S * 1e9:
+            # a sane peer's clock is within MAX_SKEW_S of ours; beyond
+            # that the stamp is corrupt or forged and must not enter
+            # the clock, the store, or a tombstone
+            raise GeoSkewError(
+                f"event hlc is {(hlc - time.time_ns()) / 1e9:.0f}s ahead "
+                f"of this cluster's clock (max skew {MAX_SKEW_S:.0f}s)")
+        if seq and source:
+            wm_seq, wm_log = self.watermark(source)
+            # the seq comparison only means "already applied" within ONE
+            # log incarnation; a changed id means the source's log was
+            # wiped/repointed and ITS seqs restarted — not duplicates.
+            # A log-less sender (pre-identity) can only compare unscoped;
+            # against a mismatched/legacy record we RE-APPLY instead —
+            # safe, every apply is LWW-guarded — and rebind the mark
+            if seq <= wm_seq and (not log or log == wm_log):
+                GEO_APPLIED.labels(origin_l, "dup").inc()
+                return {"result": "dup"}
+        if hlc:
+            # HLC merge rule: later local writes must stamp past every
+            # remote write already applied here
+            self.fs.filer.meta_log.observe(hlc)
+        if op == "mkdir":
+            result = self._apply_mkdir(origin, hlc, path)
+        elif op == "put":
+            result = self._apply_put(origin, hlc, path, data, mime)
+        elif op == "delete":
+            result = self._apply_delete(origin, hlc, path)
+        else:
+            raise ValueError(f"unknown geo op {op!r}")
+        if seq and source:
+            self._advance(source, seq, log)
+        GEO_APPLIED.labels(origin_l, result).inc()
+        return {"result": result}
+
+    def _apply_mkdir(self, origin: int, hlc: int, path: str) -> str:
+        # directories carry no payload and merge trivially when they
+        # exist — but a missing dir must still pass the tombstone fence:
+        # an older remote mkdir must not resurrect a newer local delete
+        # (divergence: the delete wins on the origin, the resurrect here)
+        incoming = (hlc, origin) if hlc else None
+        with self.fs.filer.path_mutation_lock(path):
+            local, entry = self._local_stamp(path)
+            if entry is not None and entry.name:
+                return "dup"  # already present: idempotent merge
+            if incoming is not None and local is not None \
+                    and incoming < local:
+                GEO_CONFLICTS.labels(str(origin), "local").inc()
+                return "conflict"
+            # the origin stamp rides along so a later backlog delete of
+            # the dir (older hlc than our apply time) still wins LWW
+            self.fs.filer._ensure_parents(
+                path, signatures=[origin],
+                stamp=encode_hlc(hlc, origin) if hlc else None)
+        return "ok"
+
+    def _apply_put(self, origin: int, hlc: int, path: str, data: bytes,
+                   mime: str) -> str:
+        incoming = (hlc, origin)
+        # the stripe serializes the stamp check + write-through against
+        # concurrent local mutations of the same path: without it a
+        # newer local write landing in the window would be silently
+        # overwritten by this older remote event (reentrant: write_file
+        # -> create_entry re-acquires it).  The hold spans the chunk
+        # upload — acceptable because MAX_BODY_BYTES bounds it; writing
+        # outside the stripe would need a re-check + orphan-chunk
+        # cleanup on abort for a window that LWW already closes
+        with self.fs.filer.path_mutation_lock(path):
+            local, _entry = self._local_stamp(path)
+            if local is not None:
+                if incoming == local:
+                    return "dup"  # same event, re-delivered
+                if incoming < local:
+                    # a strictly-newer local mutation already landed:
+                    # the remote write was concurrent and loses (LWW)
+                    GEO_CONFLICTS.labels(str(origin), "local").inc()
+                    return "conflict"
+            # winner: write through the normal path (chunks assigned in
+            # THIS cluster, quotas accounted here, within-cluster peers
+            # replicate it) carrying the ORIGIN's stamp + signature
+            self.fs.write_file(
+                path, data, mime=mime, signatures=[origin],
+                extended={GEO_HLC_KEY: encode_hlc(hlc, origin)})
+        return "ok"
+
+    def _apply_delete(self, origin: int, hlc: int, path: str) -> str:
+        incoming = (hlc, origin)
+        with self.fs.filer.path_mutation_lock(path):
+            local, entry = self._local_stamp(path)
+            exists = entry is not None and bool(entry.name)
+            if local is not None:
+                if incoming == local and not exists:
+                    return "dup"
+                if incoming < local:
+                    GEO_CONFLICTS.labels(str(origin), "local").inc()
+                    return "conflict"
+            directory, name = split_path(path)
+            # the tombstone must carry the ORIGIN's stamp so every
+            # cluster fences with the same clock value — and it must be
+            # in the KV BEFORE delete_entry appends the meta-log event
+            # (tombstone=), or a tailing replicator relaying the delete
+            # onward could read a fresh local stamp in the window and
+            # inflate the fence around a 3+-cluster mesh
+            tomb = encode_hlc(hlc, origin)
+            if not exists:
+                self.fs.filer.store.kv_put(tombstone_key(path), tomb)
+                return "ok"
+            if not entry.is_directory:
+                try:
+                    self.fs.filer.delete_entry(
+                        directory, name, is_recursive=True,
+                        ignore_recursive_error=True, signatures=[origin],
+                        tombstone=tomb)
+                except FileNotFoundError:
+                    self.fs.filer.store.kv_put(tombstone_key(path), tomb)
+                return "ok"
+            # directory: fence the subtree FIRST (under the root
+            # stripe) so older writes can't slip in mid-walk
+            self.fs.filer.store.kv_put(tombstone_key(path), tomb)
+        # a recursive delete is LWW per CHILD, not per root: children
+        # stamped newer than the delete are concurrent writes it must
+        # lose to — on the origin they beat the ancestor tombstone and
+        # get re-created, so destroying them here would diverge the
+        # clusters forever.  Walk OUTSIDE the root stripe, taking each
+        # child's OWN stripe one at a time: the per-child stamp check
+        # then serializes against concurrent local writes (a newer
+        # write landing mid-walk survives), and holding at most one
+        # stripe can never deadlock ABBA against a concurrent
+        # recursive apply rooted on one of our child stripes
+        kept = self._delete_older_subtree(path, incoming, tomb, origin)
+        if kept:
+            return "conflict"
+        with self.fs.filer.path_mutation_lock(path):
+            try:
+                # non-recursive: a child created since the walk makes
+                # this fail loudly instead of being silently destroyed
+                self.fs.filer._delete_entry_locked(
+                    directory, name, is_recursive=False,
+                    signatures=[origin], tombstone=tomb)
+            except FileNotFoundError:
+                pass
+            except IsADirectoryError:
+                GEO_CONFLICTS.labels(str(origin), "local").inc()
+                return "conflict"
+        return "ok"
+
+    def _delete_older_subtree(self, path: str, incoming: tuple,
+                              tomb: bytes, origin: int) -> int:
+        """Depth-first delete of every entry under ``path`` stamped at
+        or before the incoming delete; returns how many newer entries
+        survived (each counted as a conflict).  A directory survives
+        when it keeps survivors below it, or its own stamp is newer.
+        Caller must NOT hold any path stripe (each child is re-checked
+        and deleted under its own)."""
+        filer = self.fs.filer
+        kept = 0
+        for e in list(_iter_dir(filer.store, path)):
+            p = join_path(path, e.name)
+            sub_kept = 0
+            if e.is_directory:
+                sub_kept = self._delete_older_subtree(p, incoming, tomb,
+                                                      origin)
+            with filer.path_mutation_lock(p):
+                cur = filer.store.find_entry(path, e.name)
+                if cur is None or not cur.name:
+                    kept += sub_kept
+                    continue  # already gone (racing delete)
+                stamp = entry_hlc(cur)
+                newer = stamp is not None and stamp > incoming
+                if sub_kept or newer:
+                    kept += sub_kept
+                    if newer:
+                        kept += 1
+                        GEO_CONFLICTS.labels(str(origin), "local").inc()
+                    continue
+                try:
+                    # child tombstones carry the origin stamp so a
+                    # relay of these per-child delete events stays
+                    # mesh-safe.  Non-recursive: a directory that
+                    # gained a child since the sub-walk fails the
+                    # delete loudly instead of destroying it
+                    filer._delete_entry_locked(
+                        path, e.name, is_recursive=False,
+                        signatures=[origin], tombstone=tomb)
+                except FileNotFoundError:
+                    pass
+                except IsADirectoryError:
+                    kept += 1  # gained a child mid-walk: a newer write
+                    GEO_CONFLICTS.labels(str(origin), "local").inc()
+        return kept
+
+
+class GeoReplicator:
+    """One replication direction: this cluster's filer -> one remote
+    cluster's filer.  Runs as a daemon thread inside the filer process."""
+
+    def __init__(self, fs, remote_http: str, journal_dir: str | None = None,
+                 rate_mbps: float | None = None, path_prefix: str = "/"):
+        self.fs = fs
+        self.remote_http = remote_http
+        self.path_prefix = path_prefix
+        self.link = f"c{fs.filer.cluster_id}->{remote_http}"
+        if rate_mbps is None:
+            rate_mbps = float(os.environ.get(RATE_ENV, DEFAULT_RATE_MBPS))
+        self.bucket = None
+        if rate_mbps > 0:
+            from ..storage.scrub import TokenBucket
+
+            self.bucket = TokenBucket(rate_mbps * (1 << 20))
+        path = None
+        if journal_dir:
+            os.makedirs(journal_dir, exist_ok=True)
+            safe = remote_http.replace(":", "_").replace("/", "_")
+            path = os.path.join(journal_dir, f"geo.{safe}.journal.jsonl")
+        self.journal = JobJournal(path)
+        self._key = f"geo:{remote_http}"
+        self._remote_cid: int | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._unsaved = 0
+        self._last_save = time.monotonic()
+        self._last_seq = 0  # newest source-log seq fully processed
+        self.shipped = 0
+        self.resyncs = 0
+        self.last_shipped_ts = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.run, name=f"geo-{self.remote_http}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self._last_seq:
+            self._save_checkpoint(self._last_seq, force=True)
+
+    def status(self) -> dict:
+        ckpt = self.checkpoint()
+        log_seq = self.fs.filer.meta_log.last_seq()
+        # _last_seq runs ahead of the batched journal save; either one
+        # reaching the log head means the link is drained — a drained
+        # idle link is 0s behind, not "age of the last event"
+        if max(ckpt, self._last_seq) >= log_seq:
+            lag = 0.0
+        elif self.last_shipped_ts:
+            lag = max(0.0, (time.time_ns() - self.last_shipped_ts) / 1e9)
+        else:
+            lag = None
+        return {
+            "link": self.link,
+            "remote": self.remote_http,
+            "checkpoint": ckpt,
+            "logSeq": log_seq,
+            "shipped": self.shipped,
+            "resyncs": self.resyncs,
+            "rateMBps": (self.bucket.rate / (1 << 20)
+                         if self.bucket else 0.0),
+            "lagSeconds": lag,
+        }
+
+    # -- checkpoint --------------------------------------------------------
+
+    def checkpoint(self) -> int:
+        rec = self.journal.get(self._key)
+        return int(rec.get("seq", 0)) if rec else 0
+
+    def _save_checkpoint(self, seq: int, force: bool = False) -> None:
+        self._unsaved += 1
+        now = time.monotonic()
+        if not force and self._unsaved < CHECKPOINT_EVERY and \
+                now - self._last_save < CHECKPOINT_INTERVAL_S:
+            return
+        if seq > self.checkpoint() or force:
+            # state "checkpoint" is outside the journal's ACTIVE_STATES,
+            # so replay treats it as a plain latest-record-wins fact (no
+            # spurious "resuming in-flight job" demotion); log_id pins
+            # the checkpoint to ONE log incarnation
+            self.journal.put({"key": self._key, "seq": seq,
+                              "state": "checkpoint",
+                              "log_id": self.fs.filer.meta_log.log_id,
+                              "remote": self.remote_http})
+        self._unsaved = 0
+        self._last_save = now
+
+    # -- main loop ---------------------------------------------------------
+
+    def run(self) -> None:
+        backoff = failsafe.Backoff(failsafe.RetryPolicy(
+            max_attempts=1 << 30, base_delay=0.5, max_delay=15.0))
+        while not self._stop.is_set():
+            try:
+                if self._remote_cid is None:
+                    self._remote_cid = self._handshake()
+                    backoff.reset()
+                self._sync()
+                return  # stop was set
+            except MetaLogGap as e:
+                glog.warning("geo link %s: %s — full namespace resync",
+                             self.link, e)
+                try:
+                    self._resync()
+                    backoff.reset()
+                except Exception as re:  # noqa: BLE001 — retry the link
+                    glog.warning("geo resync to %s failed: %s",
+                                 self.remote_http, re)
+                    GEO_EVENTS.labels(self.link, "error").inc()
+                    if self._stop.wait(backoff.next()):
+                        return
+            except Exception as e:  # noqa: BLE001 — the link must survive
+                GEO_EVENTS.labels(self.link, "error").inc()
+                delay = backoff.next()
+                glog.warning("geo link %s interrupted (%s); retrying "
+                             "in %.1fs", self.link, e, delay)
+                if self._stop.wait(delay):
+                    return
+
+    def _handshake(self) -> int:
+        """The remote's cluster id — required for loop prevention (events
+        it already signed are skipped) and sanity (replicating a cluster
+        into itself would loop on the first event)."""
+        with connpool.request(
+                "GET", f"http://{self.remote_http}/.geo/status",
+                timeout=10) as r:
+            doc = json.loads(r.read())
+        cid = int(doc.get("clusterId", 0))
+        if cid and cid == self.fs.filer.cluster_id:
+            raise ValueError(
+                f"remote {self.remote_http} reports THIS cluster id "
+                f"({cid}); geo links must cross clusters")
+        return cid
+
+    def _sync(self) -> None:
+        log = self.fs.filer.meta_log
+        rec = self.journal.get(self._key) or {}
+        after = int(rec.get("seq", 0))
+        if after and rec.get("log_id") not in (None, log.log_id):
+            # the checkpoint was taken against a DIFFERENT log
+            # incarnation (wiped/repointed store dir restarting at seq
+            # 1): its bare seqs mean nothing against this history, and
+            # resuming by them would silently skip the new log's first
+            # `after` events once last_seq catches up — resync instead
+            # (the post-resync checkpoint records the current log_id)
+            glog.warning(
+                "geo link %s: checkpoint belongs to log %s, local log "
+                "is %s — discarding it", self.link, rec.get("log_id"),
+                log.log_id)
+            raise MetaLogGap(after, log.first_retained_seq)
+        if after > log.last_seq():
+            # the log restarted below our checkpoint (memory-mode log, or
+            # a wiped store dir): unknown history was lost — resync
+            raise MetaLogGap(after, log.last_seq() + 1)
+        for seq, ev in log.tail(after, stop_event=self._stop):
+            if not self._process(seq, ev):
+                # stopped before the remote acknowledged: do NOT
+                # advance — a restart re-delivers the event (the
+                # applier's (src, log, seq) watermark dedups any half
+                # that DID land)
+                return
+            self._last_seq = seq
+            self._save_checkpoint(seq)
+
+    # -- one event ---------------------------------------------------------
+
+    def _skip(self, path: str) -> bool:
+        if any(path.startswith(p) for p in SKIP_PREFIXES):
+            return True
+        if self.path_prefix and self.path_prefix != "/":
+            return not path.startswith(self.path_prefix)
+        return False
+
+    def _process(self, seq: int, ev) -> bool:
+        """Ship one tailed event; returns False when the link stopped
+        before every ship was acknowledged (checkpoint must not move)."""
+        n = ev.event_notification
+        if self._remote_cid and self._remote_cid in n.signatures:
+            # this mutation IS a geo apply from the remote: shipping it
+            # back would loop
+            GEO_EVENTS.labels(self.link, "skipped").inc()
+            return True
+        directory = ev.directory
+        old_name, new_name = n.old_entry.name, n.new_entry.name
+        moved = bool(old_name and new_name and (
+            n.new_parent_path not in ("", directory)
+            or old_name != new_name))
+        if old_name and (not new_name or moved):
+            old_path = join_path(directory, old_name)
+            if not self._skip(old_path):
+                # ship the TOMBSTONE's stamp, not the event's: a relayed
+                # delete (mesh of 3+ clusters) logs a fresh monotonic
+                # event ts, but the tombstone keeps the ORIGIN's
+                # (hlc, cluster) — shipping relay time would inflate the
+                # fence at every hop and wrongly beat concurrent writes
+                # the origin delete properly lost to.
+                # The delete half of a move shares the event's seq with
+                # the put half — ship it watermark-free (seq=0, fenced
+                # by the tombstone's LWW stamp) so advancing the remote
+                # watermark here cannot drop the put half as a duplicate
+                tomb = decode_hlc(self.fs.filer.store.kv_get(
+                    tombstone_key(old_path)))
+                hlc, origin = (tomb if tomb is not None
+                               else (ev.ts_ns, None))
+                if not self._ship(0 if moved else seq, "delete",
+                                  old_path, hlc, origin=origin):
+                    return False
+        if new_name:
+            target_dir = (n.new_parent_path or directory) if moved \
+                else directory
+            path = join_path(target_dir, new_name)
+            # ship the ENTRY's stamp, not the event's: a relayed apply
+            # (mesh of 3+ clusters) logs a fresh monotonic event ts but
+            # the entry keeps the ORIGIN's (hlc, cluster) — re-shipping
+            # with relay time/identity would inflate stamps around the
+            # mesh and every hop would re-win LWW over the original
+            stamp = decode_hlc(
+                bytes(n.new_entry.extended.get(GEO_HLC_KEY, b"")))
+            hlc, origin = stamp if stamp is not None else (ev.ts_ns,
+                                                           None)
+            if self._skip(path):
+                GEO_EVENTS.labels(self.link, "skipped").inc()
+            elif n.new_entry.is_directory:
+                if not self._ship(seq, "mkdir", path, hlc,
+                                  origin=origin):
+                    return False
+                if moved:
+                    # a renamed directory moved its children with raw
+                    # store ops (no per-child events): the remote just
+                    # recursively deleted the old subtree, so re-ship
+                    # the children from the store under the new path
+                    if not self._walk_ship(path):
+                        return False
+            elif self._entry_size(n.new_entry) > MAX_BODY_BYTES:
+                glog.warning("geo %s: %s is %d bytes, over the %d "
+                             "replication cap; skipping event seq=%d",
+                             self.link, path,
+                             self._entry_size(n.new_entry),
+                             MAX_BODY_BYTES, seq)
+                GEO_EVENTS.labels(self.link, "error").inc()
+            else:
+                try:
+                    data = self._read_data(n.new_entry)
+                except Exception as e:  # noqa: BLE001 — chunks may be
+                    # gone already (overwritten + vacuumed); the newer
+                    # event in the stream carries the live bytes
+                    glog.warning("geo %s: source bytes for %s unreadable "
+                                 "(%s); skipping event seq=%d", self.link,
+                                 path, e, seq)
+                    GEO_EVENTS.labels(self.link, "error").inc()
+                    return True
+                if not self._ship(seq, "put", path, hlc, data=data,
+                                  mime=n.new_entry.attributes.mime,
+                                  origin=origin):
+                    return False
+        elif not old_name:
+            GEO_EVENTS.labels(self.link, "skipped").inc()
+        return True
+
+    @staticmethod
+    def _entry_size(entry) -> int:
+        if entry.content:
+            return len(entry.content)
+        if not entry.chunks:
+            return 0
+        from ..filer import filechunks
+
+        return filechunks.total_size(entry.chunks)
+
+    def _read_data(self, entry) -> bytes:
+        if entry.content:
+            return bytes(entry.content)
+        if not entry.chunks:
+            return b""
+        from ..filer import filechunks
+
+        return self.fs.read_entry_range(
+            entry, 0, filechunks.total_size(entry.chunks))
+
+    def _ship(self, seq: int, op: str, path: str, hlc: int,
+              data: bytes = b"", mime: str = "",
+              origin: int | None = None) -> bool:
+        """POST one event to the remote applier; blocks (with backoff)
+        until the remote processed it or the link is stopped.  Permanent
+        rejections (4xx: malformed, oversized) are counted and skipped —
+        one poison event must not dam the stream.
+
+        Returns True when the event was ACKNOWLEDGED by the remote (or
+        intentionally skipped as poison); False when the link stopped
+        before that — the caller must NOT advance its checkpoint past an
+        unacknowledged event, or a restart would silently lose it."""
+        if self.bucket is not None:
+            self.bucket.consume(len(data) + EVENT_OVERHEAD_BYTES,
+                                stop=self._stop)
+            if self._stop.is_set():
+                return False
+        q = urllib.parse.urlencode({
+            "origin": (origin if origin is not None
+                       else self.fs.filer.cluster_id),
+            "src": self.fs.signature,
+            # scopes the remote's (src, seq) watermark to THIS log
+            # incarnation — after a wiped log restarts seq at 1, the
+            # new events must not be swallowed by the old high-water
+            "log": self.fs.filer.meta_log.log_id,
+            "seq": seq,
+            "hlc": hlc,
+            "op": op,
+            "path": path,
+            "mime": mime or "",
+        })
+        url = f"http://{self.remote_http}/.geo/apply?{q}"
+        backoff = failsafe.Backoff(failsafe.RetryPolicy(
+            max_attempts=1 << 30, base_delay=0.3, max_delay=10.0))
+        while not self._stop.is_set():
+            try:
+                with connpool.request("POST", url, body=data,
+                                      timeout=120) as r:
+                    doc = json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                reason, retryable = failsafe.classify(e, idempotent=True)
+                skew = (e.code == 400 and e.headers is not None
+                        and e.headers.get("X-Seaweed-Reject") == "skew")
+                if e.code in (403, 404) or skew:
+                    # remote-STATE rejections, not poison events: 403 =
+                    # remote tenant quota full, 404 = remote geo
+                    # disabled (config rollback — /.geo/apply only 404s
+                    # when the applier is absent; apply errors map to
+                    # 400/403/500), 400+skew marker = OUR clock too far
+                    # ahead of the remote's.  All clear over OPERATOR
+                    # time; skipping would advance the checkpoint past
+                    # the event and silently break byte-identity with
+                    # no resync trigger (MetaLogGap never fires).  Hold
+                    # the link — the growing seaweedfs_geo_lag_seconds
+                    # is the operator signal
+                    reason = {403: "quota", 404: "geo_disabled"}.get(
+                        e.code, "skew")
+                    retryable = True
+                if not retryable:
+                    glog.warning("geo %s: %s %s rejected (%s); skipping",
+                                 self.link, op, path, reason)
+                    GEO_EVENTS.labels(self.link, "error").inc()
+                    return True
+                failsafe.RETRY_COUNTER.labels("geo", "ship", reason).inc()
+                if self._stop.wait(backoff.next()):
+                    return False
+                continue
+            except Exception as e:  # noqa: BLE001 — transport: retry
+                reason, _ = failsafe.classify(e, idempotent=True)
+                failsafe.RETRY_COUNTER.labels("geo", "ship", reason).inc()
+                glog.warning("geo %s unreachable (%s: %s); retrying",
+                             self.remote_http, reason, e)
+                if self._stop.wait(backoff.next()):
+                    return False
+                continue
+            result = doc.get("result", "ok")
+            GEO_EVENTS.labels(
+                self.link,
+                {"ok": "shipped", "dup": "dup",
+                 "conflict": "conflict"}.get(result, "error")).inc()
+            GEO_BYTES.labels(self.link).inc(
+                len(data) + EVENT_OVERHEAD_BYTES)
+            self.shipped += 1
+            if seq:
+                # resync walks (seq=0) re-ship OLD entries whose stamps
+                # (or the unstamped placeholder hlc=1) say nothing about
+                # replication lag — only live tailed events do
+                self.last_shipped_ts = hlc
+                GEO_LAG.labels(self.link).set(
+                    max(0.0, (time.time_ns() - hlc) / 1e9))
+            return True
+        return False  # stopped before the remote acknowledged
+
+    # -- divergence reconciliation ----------------------------------------
+
+    def _resync(self) -> None:
+        """Full namespace walk shipped as seq=0 LWW puts: the remote
+        applies only what it does not already have newer — the rejoin
+        reconciliation path when the event log cannot bridge the gap."""
+        self.resyncs += 1
+        log = self.fs.filer.meta_log
+        base = log.last_seq()
+        if not self._walk_ship("/"):
+            # stopped mid-walk: leave the checkpoint where it was — a
+            # restart re-enters through the same MetaLogGap and walks
+            # the namespace again (LWW/watermark-safe to repeat)
+            return
+        # writes during the walk have seq > base and re-ship from the
+        # tail; the overlap is LWW/watermark-safe
+        self._last_seq = max(self._last_seq, base)
+        self._save_checkpoint(base, force=True)
+
+    def _walk_ship(self, root: str) -> bool:
+        """Ship every entry under ``root`` as seq=0 LWW events, carrying
+        each entry's TRUE origin stamp — an entry the remote itself
+        originated must compare equal there (dup), not as a phantom
+        conflict between cluster ids at the same timestamp.  Returns
+        False when stopped before the walk completed."""
+        store = self.fs.filer.store
+        queue = deque([root])
+        while queue:
+            if self._stop.is_set():
+                return False
+            d = queue.popleft()
+            for e in _iter_dir(store, d):
+                path = join_path(d, e.name)
+                if e.is_directory:
+                    queue.append(path)
+                    if not self._skip(path):
+                        if not self._ship(0, "mkdir", path,
+                                          self._entry_ts(e),
+                                          origin=self._entry_origin(e)):
+                            return False
+                    continue
+                if self._skip(path):
+                    continue
+                if self._entry_size(e) > MAX_BODY_BYTES:
+                    glog.warning("geo resync: %s over the %d-byte "
+                                 "replication cap; skipping", path,
+                                 MAX_BODY_BYTES)
+                    GEO_EVENTS.labels(self.link, "error").inc()
+                    continue
+                try:
+                    data = self._read_data(e)
+                except Exception as ex:  # noqa: BLE001
+                    glog.warning("geo resync: %s unreadable (%s)",
+                                 path, ex)
+                    GEO_EVENTS.labels(self.link, "error").inc()
+                    continue
+                if not self._ship(0, "put", path, self._entry_ts(e),
+                                  data=data, mime=e.attributes.mime,
+                                  origin=self._entry_origin(e)):
+                    return False
+        return True
+
+    @staticmethod
+    def _entry_ts(entry) -> int:
+        stamp = entry_hlc(entry)
+        return stamp[0] if stamp else 1
+
+    def _entry_origin(self, entry) -> int:
+        """The cluster id of an entry's stored stamp (who WROTE it), for
+        re-shipping pre-existing state; entries with no stamp (or the
+        pre-geo cid 0) are claimed by this cluster."""
+        stamp = entry_hlc(entry)
+        if stamp is not None and stamp[1]:
+            return stamp[1]
+        return self.fs.filer.cluster_id

@@ -10,7 +10,7 @@ import threading
 
 from ..pb import filer_pb2
 from ..util import glog
-from .sink import Sink
+from .sink import Sink, SinkPermanentError
 from .source import FilerSource, subscribe_metadata
 
 
@@ -92,16 +92,52 @@ class Replicator:
                     self.source.filer_http, self.path_prefix, resume_ns,
                     signature=self.signature,
                 ):
-                    resume_ns = max(resume_ns, resp.ts_ns)
-                    backoff.reset()  # live traffic: next drop starts small
                     if stop_event is not None and stop_event.is_set():
                         return
-                    try:
-                        self.process_event(resp.directory,
-                                           resp.event_notification)
-                    except Exception as e:
-                        glog.warning("replicate %s failed: %s",
-                                     resp.directory, e)
+                    while True:
+                        try:
+                            self.process_event(resp.directory,
+                                               resp.event_notification)
+                        except SinkPermanentError as e:
+                            # the target rejected this event for good
+                            # (4xx): re-applying can never succeed —
+                            # count it, skip it, keep the stream moving
+                            from ..stats.metrics import REPLICATION_ERROR
+
+                            REPLICATION_ERROR.labels("apply").inc()
+                            glog.warning("replicate %s rejected "
+                                         "permanently: %s; skipping "
+                                         "event", resp.directory, e)
+                        except Exception as e:  # noqa: BLE001 — transient
+                            # transport/5xx after the sink's own retries:
+                            # retry THIS event in place.  Resubscribing
+                            # from the last applied ts would SKIP it when
+                            # it arrived late with an older ts than
+                            # resume_ns (the aggregated stream is
+                            # arrival-ordered but the subscription resume
+                            # is ts-filtered) — the event would never be
+                            # re-delivered.  Sink applies are idempotent
+                            # upserts, so in-place repeats are safe
+                            delay = backoff.next()
+                            failsafe.RETRY_COUNTER.labels(
+                                "replicator", "apply", "transient").inc()
+                            glog.warning(
+                                "replicate %s failed (%s); retrying the "
+                                "event in %.2fs", resp.directory, e,
+                                delay)
+                            if stop_event is not None:
+                                if stop_event.wait(delay):
+                                    return
+                            else:
+                                _time.sleep(delay)
+                            continue
+                        break
+                    # reset only AFTER an event actually applied: a
+                    # redelivered poison event would otherwise see the
+                    # base delay forever (reset at stream-top) instead
+                    # of escalating toward the policy cap
+                    backoff.reset()
+                    resume_ns = max(resume_ns, resp.ts_ns)
                 return  # server closed the stream cleanly
             except grpc.RpcError as e:
                 if e.code() == grpc.StatusCode.CANCELLED:

@@ -16,7 +16,57 @@ import urllib.parse
 import urllib.request
 
 from ..pb import filer_pb2
-from ..util import connpool
+from ..util import connpool, failsafe, faultpoint
+
+# fires before every replication apply (sink create/delete, geo apply):
+# chaos arms it to model a dying target mid-replication — ctx is the
+# destination path so `match` can target one object
+FP_REPLICATION_APPLY = faultpoint.register("replication.apply")
+
+
+class SinkPermanentError(Exception):
+    """The target rejected the apply for good (4xx, bad request):
+    retrying the same event can never succeed.  Callers count it and move
+    on instead of wedging the stream on one poison event."""
+
+
+# sink applies are IDEMPOTENT upserts: a PUT of the same bytes to the
+# same path, or a DELETE of the same path, lands in the same state no
+# matter how many times it runs — so transient transport failures and
+# 5xx NACKs retry safely, while 4xx answers classify as permanent
+_SINK_POLICY = failsafe.RetryPolicy(max_attempts=3, base_delay=0.2,
+                                    max_delay=2.0)
+
+
+def _apply_request(method: str, url: str, body: bytes | None = None,
+                   headers: dict | None = None, timeout: float = 60,
+                   ignore_404: bool = False) -> None:
+    """One sink apply over the connpool, failsafe-classified: transient
+    failures retry under _SINK_POLICY (via failsafe.call — same counter
+    labels, same backoff discipline as every other retried path),
+    permanent ones raise SinkPermanentError, everything else propagates
+    for the caller's stream-level reconnect."""
+
+    def attempt() -> None:
+        try:
+            with connpool.request(method, url, body=body,
+                                  headers=headers or {},
+                                  timeout=timeout) as r:
+                r.read()
+        except urllib.error.HTTPError as e:
+            if ignore_404 and e.code == 404:
+                return
+            raise
+
+    try:
+        failsafe.call(attempt, op="apply", retry_type="replication",
+                      policy=_SINK_POLICY)
+    except Exception as e:  # noqa: BLE001 — permanence decided below
+        _reason, retryable = failsafe.classify(e, idempotent=True)
+        if not retryable:
+            raise SinkPermanentError(
+                f"{method} {url}: {_reason}: {e}") from e
+        raise  # transients exhausted: stream-level reconnect retries
 
 
 class Sink:
@@ -91,25 +141,21 @@ class FilerSink(Sink):
     def create_entry(self, directory, entry, data):
         if entry.is_directory:
             return  # target filer auto-creates parents on file writes
-        with connpool.request(
-                "PUT", self._url(directory, entry.name), body=data,
-                headers={
-                    "Content-Type": entry.attributes.mime
-                    or "application/octet-stream"
-                },
-                timeout=120) as r:
-            r.read()
+        faultpoint.inject(FP_REPLICATION_APPLY,
+                          ctx=f"{directory}/{entry.name}")
+        _apply_request(
+            "PUT", self._url(directory, entry.name), body=data,
+            headers={
+                "Content-Type": entry.attributes.mime
+                or "application/octet-stream"
+            },
+            timeout=120)
 
     def delete_entry(self, directory, name, is_directory):
         extra = "recursive=true&ignoreRecursiveError=true" if is_directory else ""
-        try:
-            with connpool.request(
-                    "DELETE", self._url(directory, name, extra),
-                    timeout=60) as r:
-                r.read()
-        except urllib.error.HTTPError as e:
-            if e.code != 404:
-                raise
+        faultpoint.inject(FP_REPLICATION_APPLY, ctx=f"{directory}/{name}")
+        _apply_request("DELETE", self._url(directory, name, extra),
+                       timeout=60, ignore_404=True)
 
 
 class S3Sink(Sink):
@@ -136,25 +182,21 @@ class S3Sink(Sink):
     def create_entry(self, directory, entry, data):
         if entry.is_directory:
             return
-        with connpool.request(
-                "PUT", self._url(self._key(directory, entry.name)),
-                body=data,
-                headers={
-                    "Content-Type": entry.attributes.mime
-                    or "application/octet-stream"
-                },
-                timeout=120) as r:
-            r.read()
+        faultpoint.inject(FP_REPLICATION_APPLY,
+                          ctx=f"{directory}/{entry.name}")
+        _apply_request(
+            "PUT", self._url(self._key(directory, entry.name)),
+            body=data,
+            headers={
+                "Content-Type": entry.attributes.mime
+                or "application/octet-stream"
+            },
+            timeout=120)
 
     def delete_entry(self, directory, name, is_directory):
-        try:
-            with connpool.request(
-                    "DELETE", self._url(self._key(directory, name)),
-                    timeout=60) as r:
-                r.read()
-        except urllib.error.HTTPError as e:
-            if e.code != 404:
-                raise
+        faultpoint.inject(FP_REPLICATION_APPLY, ctx=f"{directory}/{name}")
+        _apply_request("DELETE", self._url(self._key(directory, name)),
+                       timeout=60, ignore_404=True)
 
 
 class SignedS3Sink(S3Sink):

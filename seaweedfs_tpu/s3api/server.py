@@ -79,22 +79,29 @@ class S3ApiServer:
         iam_config_filer_path: str = "",
         iam_refresh_seconds: float = 3.0,
         masters: str | list[str] = "",
+        geo_masters: str | list[str] = "",  # remote-cluster failover
     ):
         self.port = port
         master_list = (masters.split(",") if isinstance(masters, str)
                        else list(masters))
         master_list = [m.strip() for m in master_list if m.strip()]
         filer_list = [f.strip() for f in filer.split(",") if f.strip()]
-        if master_list or len(filer_list) > 1:
+        geo_list = (geo_masters.split(",")
+                    if isinstance(geo_masters, str) else list(geo_masters))
+        geo_list = [m.strip() for m in geo_list if m.strip()]
+        if master_list or len(filer_list) > 1 or geo_list:
             # fleet mode: stateless gateway over the sharded filer
             # plane — membership from the master's filer registrations
-            # (or the static list), routing by consistent hash
+            # (or the static list), routing by consistent hash; with
+            # geo masters the gateway fails over to the remote cluster
+            # when the local fleet is entirely unreachable (ISSUE 12)
             from ..filer.fleet import FleetRouter
             from ..filer.fleet.fleet_client import FleetFilerClient
 
             self.client = FleetFilerClient(FleetRouter(
                 masters=master_list,
-                filers=filer_list if not master_list else None))
+                filers=filer_list if not master_list else None,
+                remote_masters=geo_list or None))
         else:
             self.client = FilerClient(filer_list[0] if filer_list
                                       else filer)

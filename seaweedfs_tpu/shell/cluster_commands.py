@@ -143,3 +143,38 @@ def filer_ring(env: CommandEnv, args: list[str]) -> str:
                 + (f", weight={conf['weight']}" if "weight" in conf
                    else ""))
     return "\n".join(lines)
+
+
+@register("cluster.geo")
+def cluster_geo(env: CommandEnv, args: list[str]) -> str:
+    """cluster.geo [-json]  — peer-cluster reachability + per-link
+    replication health (lag, shipped/applied/conflict counters) from
+    the master's /cluster/geo registry."""
+    addr = _master_http(env)
+    with connpool.request(
+            "GET", f"http://{addr}/cluster/geo", timeout=10) as r:
+        doc = json.loads(r.read())
+    if "-json" in args:
+        return json.dumps(doc, indent=2, sort_keys=True)
+    lines = []
+    peers = doc.get("peerClusters", {})
+    lines.append(f"peer clusters ({len(peers)}):")
+    for peer in sorted(peers):
+        p = peers[peer]
+        if p.get("reachable"):
+            lines.append(
+                f"  {peer} reachable leader={p.get('leader', '?')} "
+                f"dataNodes={p.get('dataNodes')} filers={p.get('filers')}")
+        else:
+            lines.append(f"  {peer} UNREACHABLE ({p.get('error', '?')})")
+    links = doc.get("links", {})
+    if not links:
+        lines.append("geo links: none reported (filer heartbeats carry "
+                     "the seaweedfs_geo_* samples once links are up)")
+    else:
+        lines.append(f"geo link reporters ({len(links)}):")
+        for inst in sorted(links):
+            lines.append(f"  {inst}:")
+            for name in sorted(links[inst]):
+                lines.append(f"    {name} = {links[inst][name]}")
+    return "\n".join(lines)

@@ -270,6 +270,13 @@ class Registry:
         live scrape serves better."""
         with self._lock:
             metrics = list(self._metrics.values())
+        # geo-link + listener health ride ONLY this snapshot to
+        # /cluster/geo (a dead cluster cannot be scraped live); the
+        # families are tiny (per-link) but registered late, so on a
+        # high-cardinality node they would be the first past the cap —
+        # emit them first
+        metrics.sort(key=lambda m: not m.name.startswith(
+            ("seaweedfs_geo_", "seaweedfs_meta_listener_")))
         out = []
         for m in metrics:
             if m.kind not in ("counter", "gauge"):
@@ -688,6 +695,49 @@ REPAIR_BATCH_DEADLINE_SLACK = REGISTRY.gauge(
     "seaweedfs_repair_batch_deadline_slack_seconds",
     "configured mass-repair deadline minus projected completion time",
 )
+# -- cross-cluster geo replication (replication/geo.py, ISSUE 12) ----------
+# the geo plane tails the filer's durable metadata event log and ships
+# events + object bytes to a peer cluster.  `link` identifies one
+# replication direction ("<local_cluster>-><remote filer addr>"); `origin`
+# labels the apply side by the SOURCE cluster id.  Conflicts are LWW
+# losses on the hybrid logical clock — counted, never silent.
+
+META_LISTENER_ERRORS = REGISTRY.counter(
+    "seaweedfs_meta_listener_errors_total",
+    "metadata-log listener callback failures; `evicted` counts listeners "
+    "unsubscribed after too many consecutive failures",
+    labels=("result",),  # error | evicted
+)
+GEO_EVENTS = REGISTRY.counter(
+    "seaweedfs_geo_events_total",
+    "metadata events processed by a geo replication link, by outcome",
+    labels=("link", "result"),  # shipped | skipped | conflict | dup | error
+)
+GEO_BYTES = REGISTRY.counter(
+    "seaweedfs_geo_bytes_total",
+    "object + event bytes shipped over a geo replication link",
+    labels=("link",),
+)
+GEO_LAG = REGISTRY.gauge(
+    "seaweedfs_geo_lag_seconds",
+    "age of the newest event a geo link has shipped (now - event ts); "
+    "the steady-state replication lag of that link",
+    labels=("link",),
+)
+GEO_CONFLICTS = REGISTRY.counter(
+    "seaweedfs_geo_conflicts_total",
+    "active-active write conflicts resolved by last-writer-wins, by "
+    "origin cluster and which side won",
+    labels=("origin", "winner"),  # "local": the receiver kept its own
+    # newer write (a remote winner applies as a plain "ok", the loser
+    # side counts the rejection)
+)
+GEO_APPLIED = REGISTRY.counter(
+    "seaweedfs_geo_applied_total",
+    "geo events applied on the receiving cluster, by origin and outcome",
+    labels=("origin", "result"),  # ok | dup | conflict
+)
+
 GRPC_BYTES = REGISTRY.counter(
     "seaweedfs_grpc_bytes_total",
     "serialized gRPC message bytes through this server, by rpc and "

@@ -1,0 +1,1607 @@
+"""Geo replication plane units (ISSUE 12).
+
+Covers the durable metadata event log (fsynced segments, monotonic
+gap-detectable sequence numbers, torn-tail truncation, bounded
+retention), the hybrid logical clock + LWW stamps, the GeoApplier's
+conflict resolution (reject-older, tombstone fencing, watermark
+exactly-once), the GeoReplicator's ship/checkpoint/resync loop against a
+stub remote, the classified sink apply path, listener eviction, and the
+fleet client's fail-over-to-remote mode.  The live two-cluster
+SIGKILL/rejoin proof is tests/test_geo_cluster.py (chaos).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from seaweedfs_tpu.filer.filer import Filer, split_path
+from seaweedfs_tpu.filer.filerstore import make_store
+from seaweedfs_tpu.filer.meta_log import (
+    GEO_HLC_KEY,
+    MetaLogBuffer,
+    MetaLogGap,
+    decode_hlc,
+    encode_hlc,
+    entry_hlc,
+    tombstone_key,
+)
+from seaweedfs_tpu.pb import filer_pb2
+from seaweedfs_tpu.stats.metrics import REGISTRY
+
+
+def _entry(name: str, content: bytes = b"") -> filer_pb2.Entry:
+    e = filer_pb2.Entry(name=name, content=content)
+    e.attributes.mtime = int(time.time())
+    e.attributes.file_mode = 0o644
+    return e
+
+
+def _counter(family: str, *labels) -> float:
+    m = REGISTRY.family(family)
+    if m is None:
+        return 0.0
+    child = m._children.get(tuple(str(v) for v in labels))
+    return float(child.value) if child else 0.0
+
+
+# ---------------------------------------------------------------------------
+# durable meta log
+# ---------------------------------------------------------------------------
+
+
+def test_durable_log_append_recover(tmp_path):
+    d = str(tmp_path / "log")
+    log = MetaLogBuffer(capacity=4, dir=d)
+    for i in range(10):
+        log.append("/d", None, _entry(f"f{i}"))
+    assert log.last_seq() == 10
+    log.close()
+    log2 = MetaLogBuffer(capacity=4, dir=d)
+    assert log2.last_seq() == 10
+    # appends continue the sequence, never reuse it
+    log2.append("/d", None, _entry("f10"))
+    assert log2.last_seq() == 11
+
+
+def test_tail_serves_evicted_history_from_disk(tmp_path):
+    log = MetaLogBuffer(capacity=4, dir=str(tmp_path / "log"))
+    for i in range(12):
+        log.append("/d", None, _entry(f"f{i}"))
+    stop = threading.Event()
+    seqs, names = [], []
+    for seq, ev in log.tail(0, stop_event=stop, poll_interval=0.02):
+        seqs.append(seq)
+        names.append(ev.event_notification.new_entry.name)
+        if seq == 12:
+            stop.set()
+    # contiguous — the gap-free contract the geo replicator resumes on
+    assert seqs == list(range(1, 13))
+    assert names[0] == "f0" and names[-1] == "f11"
+
+
+def test_tail_resumes_mid_stream(tmp_path):
+    log = MetaLogBuffer(capacity=64, dir=str(tmp_path / "log"))
+    for i in range(8):
+        log.append("/d", None, _entry(f"f{i}"))
+    stop = threading.Event()
+    got = []
+    for seq, _ev in log.tail(5, stop_event=stop, poll_interval=0.02):
+        got.append(seq)
+        if seq == 8:
+            stop.set()
+    assert got == [6, 7, 8]
+
+
+def test_torn_tail_truncated(tmp_path):
+    d = str(tmp_path / "log")
+    log = MetaLogBuffer(dir=d)
+    for i in range(5):
+        log.append("/d", None, _entry(f"f{i}"))
+    log.close()
+    seg = sorted(p for p in os.listdir(d) if p.startswith("seg-"))[-1]
+    with open(os.path.join(d, seg), "ab") as f:
+        f.write(b"\x13\x37torn-half-record")
+    log2 = MetaLogBuffer(dir=d)
+    assert log2.last_seq() == 5  # garbage dropped, good prefix kept
+    log2.append("/d", None, _entry("f5"))
+    assert log2.last_seq() == 6
+
+
+def test_retention_drops_segments_and_gap_is_loud(tmp_path):
+    log = MetaLogBuffer(capacity=4, dir=str(tmp_path / "log"),
+                        segment_bytes=256, retain_bytes=512)
+    for i in range(60):
+        log.append("/d", None, _entry(f"g{i}"))
+    assert log.first_retained_seq > 1
+    with pytest.raises(MetaLogGap):
+        next(iter(log.tail(0, stop_event=threading.Event())))
+    # resuming at/after the retention floor works
+    stop = threading.Event()
+    first = next(iter(log.tail(log.first_retained_seq - 1,
+                               stop_event=stop)))
+    assert first[0] == log.first_retained_seq
+
+
+def test_memory_log_eviction_raises_gap():
+    log = MetaLogBuffer(capacity=4)
+    for i in range(10):
+        log.append("/d", None, _entry(f"f{i}"))
+    with pytest.raises(MetaLogGap):
+        next(iter(log.tail(0, stop_event=threading.Event())))
+
+
+def test_subscribe_serves_persisted_history(tmp_path):
+    d = str(tmp_path / "log")
+    log = MetaLogBuffer(capacity=4, dir=d)
+    for i in range(10):
+        log.append("/d", None, _entry(f"f{i}"))
+    stop = threading.Event()
+    names = []
+    for ev in log.subscribe(0, stop_event=stop, poll_interval=0.02):
+        names.append(ev.event_notification.new_entry.name)
+        if len(names) == 10:
+            stop.set()
+    assert names == [f"f{i}" for i in range(10)]
+
+
+def test_hlc_next_ts_monotonic_and_observe():
+    log = MetaLogBuffer()
+    a = log.next_ts()
+    b = log.next_ts()
+    assert b > a
+    future = time.time_ns() + 60_000_000_000
+    log.observe(future)  # remote event from a fast clock
+    assert log.next_ts() > future  # local writes stamp past it
+
+
+def test_hlc_stamp_helpers():
+    raw = encode_hlc(123456789, 7)
+    assert decode_hlc(raw) == (123456789, 7)
+    assert decode_hlc(None) is None
+    assert decode_hlc(b"short") is None
+    e = _entry("x")
+    e.extended[GEO_HLC_KEY] = raw
+    assert entry_hlc(e) == (123456789, 7)
+    e2 = _entry("y")  # falls back to mtime seconds, cluster 0
+    ts, cid = entry_hlc(e2)
+    assert cid == 0 and ts == e2.attributes.mtime * 1_000_000_000
+
+
+# ---------------------------------------------------------------------------
+# listener eviction (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_listener_evicted_after_consecutive_failures():
+    log = MetaLogBuffer()
+    calls = []
+
+    def bad(_resp):
+        calls.append(1)
+        raise RuntimeError("sink is dead")
+
+    log.add_listener(bad)
+    before_err = _counter("seaweedfs_meta_listener_errors_total", "error")
+    before_evict = _counter("seaweedfs_meta_listener_errors_total",
+                            "evicted")
+    from seaweedfs_tpu.filer.meta_log import LISTENER_MAX_FAILURES
+
+    for i in range(LISTENER_MAX_FAILURES + 5):
+        log.append("/d", None, _entry(f"f{i}"))
+    # invoked exactly MAX times, then unsubscribed — not forever
+    assert len(calls) == LISTENER_MAX_FAILURES
+    assert log.listener_count() == 0
+    assert _counter("seaweedfs_meta_listener_errors_total",
+                    "error") - before_err == LISTENER_MAX_FAILURES
+    assert _counter("seaweedfs_meta_listener_errors_total",
+                    "evicted") - before_evict == 1
+
+
+def test_listener_failure_count_resets_on_success():
+    log = MetaLogBuffer()
+    state = {"fail": True, "calls": 0}
+
+    def flaky(_resp):
+        state["calls"] += 1
+        if state["fail"]:
+            raise RuntimeError("transient")
+
+    log.add_listener(flaky)
+    from seaweedfs_tpu.filer.meta_log import LISTENER_MAX_FAILURES
+
+    for i in range(LISTENER_MAX_FAILURES - 1):
+        log.append("/d", None, _entry(f"a{i}"))
+    state["fail"] = False  # one success wipes the strike count
+    log.append("/d", None, _entry("ok"))
+    state["fail"] = True
+    for i in range(LISTENER_MAX_FAILURES - 1):
+        log.append("/d", None, _entry(f"b{i}"))
+    assert log.listener_count() == 1  # never hit MAX in a row
+
+
+# ---------------------------------------------------------------------------
+# filer HLC stamping + tombstones
+# ---------------------------------------------------------------------------
+
+
+def _geo_filer(cluster_id: int = 1) -> Filer:
+    f = Filer(make_store("memory"))
+    f.cluster_id = cluster_id
+    f.geo_stamp = True
+    return f
+
+
+def test_filer_stamps_mutations_and_tombstones_deletes():
+    f = _geo_filer(cluster_id=3)
+    e = _entry("a.txt", b"hello")
+    f.create_entry("/buckets/b", e)
+    stored = f.find_entry("/buckets/b/a.txt")
+    stamp = decode_hlc(bytes(stored.extended[GEO_HLC_KEY]))
+    assert stamp is not None and stamp[1] == 3
+    f.delete_entry("/buckets/b", "a.txt")
+    tomb = decode_hlc(f.store.kv_get(tombstone_key("/buckets/b/a.txt")))
+    assert tomb is not None and tomb[1] == 3
+    assert tomb[0] > stamp[0]  # the delete happened after the create
+
+
+def test_filer_preserves_relayed_origin_stamp():
+    f = _geo_filer(cluster_id=3)
+    e = _entry("a.txt", b"hello")
+    e.extended[GEO_HLC_KEY] = encode_hlc(424242, 9)  # origin cluster 9
+    # a RELAY carries replication signatures: the origin stamp sticks
+    f.create_entry("/buckets/b", e, signatures=[9])
+    stored = f.find_entry("/buckets/b/a.txt")
+    assert decode_hlc(bytes(stored.extended[GEO_HLC_KEY])) == (424242, 9)
+    # and the origin ts folded into the local clock
+    assert f.meta_log.next_ts() > 424242
+
+
+def test_filer_restamps_client_echoed_stamp():
+    """A direct client mutation (no signatures) that echoes a stored
+    stamp back — a read-modify-write UpdateEntry like chmod/touch — is
+    a NEW write and must be re-stamped: honoring the echo would make
+    the update compare "dup" against the version it overwrote and
+    never replicate."""
+    f = _geo_filer(cluster_id=3)
+    e = _entry("a.txt", b"v1")
+    f.create_entry("/buckets/b", e)
+    stored = f.find_entry("/buckets/b/a.txt")
+    old_stamp = decode_hlc(bytes(stored.extended[GEO_HLC_KEY]))
+    # client round-trips the entry verbatim (stale stamp included)
+    stored.attributes.file_mode = 0o600
+    f.update_entry("/buckets/b", stored)
+    restamped = decode_hlc(bytes(
+        f.find_entry("/buckets/b/a.txt").extended[GEO_HLC_KEY]))
+    assert restamped[1] == 3  # stamped by THIS cluster
+    assert restamped[0] > old_stamp[0]  # strictly newer: it replicates
+
+
+# ---------------------------------------------------------------------------
+# GeoApplier: LWW conflict resolution + exactly-once watermarks
+# ---------------------------------------------------------------------------
+
+
+class _StubFs:
+    """The slice of FilerServer the geo plane needs, volume-plane-free:
+    content-carrying entries only."""
+
+    def __init__(self, cluster_id: int = 2, signature: int = 777):
+        self.filer = Filer(make_store("memory"))
+        self.filer.cluster_id = cluster_id
+        self.filer.geo_stamp = True
+        self.signature = signature
+
+    def write_file(self, path, data, mime="", signatures=None,
+                   extended=None, **_kw):
+        d, n = split_path(path)
+        e = _entry(n, data)
+        e.attributes.mime = mime or ""
+        for k, v in (extended or {}).items():
+            e.extended[k] = v
+        self.filer.create_entry(d, e, signatures=signatures)
+        return e
+
+    def read_entry_range(self, entry, offset, size):
+        return bytes(entry.content)[offset:offset + size]
+
+
+def _applier(fs=None):
+    from seaweedfs_tpu.replication.geo import GeoApplier
+
+    fs = fs or _StubFs()
+    return GeoApplier(fs), fs
+
+
+def _read(fs, path) -> bytes | None:
+    e = fs.filer.find_entry(path)
+    return bytes(e.content) if e is not None and e.name else None
+
+
+def test_applier_lww_applies_newer_rejects_older():
+    ap, fs = _applier()
+    base = fs.filer.meta_log.next_ts()
+    out = ap.apply(origin=1, source=11, seq=1, hlc=base + 10, op="put",
+                   path="/buckets/b/k", data=b"newer", mime="")
+    assert out["result"] == "ok"
+    assert _read(fs, "/buckets/b/k") == b"newer"
+    before = _counter("seaweedfs_geo_conflicts_total", "1", "local")
+    out = ap.apply(origin=1, source=11, seq=2, hlc=base + 5, op="put",
+                   path="/buckets/b/k", data=b"older-concurrent")
+    assert out["result"] == "conflict"
+    assert _read(fs, "/buckets/b/k") == b"newer"  # LWW held
+    assert _counter("seaweedfs_geo_conflicts_total",
+                    "1", "local") == before + 1
+
+
+def test_applier_local_write_beats_older_remote():
+    ap, fs = _applier()
+    fs.write_file("/buckets/b/k", b"local-now")  # stamped with local HLC
+    local_stamp = entry_hlc(fs.filer.find_entry("/buckets/b/k"))
+    out = ap.apply(origin=1, source=11, seq=1, hlc=local_stamp[0] - 10,
+                   op="put", path="/buckets/b/k", data=b"remote-older")
+    assert out["result"] == "conflict"
+    assert _read(fs, "/buckets/b/k") == b"local-now"
+
+
+def test_applier_tombstone_blocks_resurrection():
+    ap, fs = _applier()
+    fs.write_file("/buckets/b/dead", b"v1")
+    d, n = split_path("/buckets/b/dead")
+    fs.filer.delete_entry(d, n)  # local delete -> tombstone
+    tomb = decode_hlc(
+        fs.filer.store.kv_get(tombstone_key("/buckets/b/dead")))
+    out = ap.apply(origin=1, source=11, seq=1, hlc=tomb[0] - 100,
+                   op="put", path="/buckets/b/dead", data=b"zombie")
+    assert out["result"] == "conflict"
+    assert _read(fs, "/buckets/b/dead") is None  # stayed dead
+    # but a STRICTLY NEWER remote write resurrects legitimately
+    out = ap.apply(origin=1, source=11, seq=2, hlc=tomb[0] + 100,
+                   op="put", path="/buckets/b/dead", data=b"reborn")
+    assert out["result"] == "ok"
+    assert _read(fs, "/buckets/b/dead") == b"reborn"
+
+
+def test_applier_delete_lww_and_tombstone_stamp():
+    ap, fs = _applier()
+    ts = fs.filer.meta_log.next_ts()
+    ap.apply(origin=1, source=11, seq=1, hlc=ts + 10, op="put",
+             path="/buckets/b/x", data=b"v1")
+    # older remote delete loses to the newer create
+    out = ap.apply(origin=1, source=11, seq=2, hlc=ts + 5, op="delete",
+                   path="/buckets/b/x")
+    assert out["result"] == "conflict"
+    assert _read(fs, "/buckets/b/x") == b"v1"
+    # newer delete wins and fences with the ORIGIN stamp
+    out = ap.apply(origin=1, source=11, seq=3, hlc=ts + 20, op="delete",
+                   path="/buckets/b/x")
+    assert out["result"] == "ok"
+    assert _read(fs, "/buckets/b/x") is None
+    assert decode_hlc(fs.filer.store.kv_get(
+        tombstone_key("/buckets/b/x"))) == (ts + 20, 1)
+
+
+def test_applier_recursive_delete_keeps_newer_children():
+    """A recursive directory delete is LWW per CHILD, not per root: a
+    child stamped newer than the delete is a concurrent write the
+    delete must lose to — on the origin it beats the ancestor tombstone
+    and gets re-created, so destroying it here would diverge the
+    clusters forever."""
+    ap, fs = _applier()
+    ts = fs.filer.meta_log.next_ts()
+    ap.apply(origin=1, source=11, seq=1, hlc=ts + 10, op="put",
+             path="/buckets/b/d/old", data=b"old")
+    ap.apply(origin=1, source=11, seq=2, hlc=ts + 30, op="put",
+             path="/buckets/b/d/new", data=b"new")
+    before = _counter("seaweedfs_geo_conflicts_total", "1", "local")
+    out = ap.apply(origin=1, source=11, seq=3, hlc=ts + 20, op="delete",
+                   path="/buckets/b/d")
+    assert out["result"] == "conflict"
+    assert _read(fs, "/buckets/b/d/old") is None    # older: deleted
+    assert _read(fs, "/buckets/b/d/new") == b"new"  # newer: survives
+    assert _counter("seaweedfs_geo_conflicts_total",
+                    "1", "local") == before + 1
+    # the fence still blocks older resurrections under /d
+    out = ap.apply(origin=1, source=11, seq=4, hlc=ts + 5, op="put",
+                   path="/buckets/b/d/zombie", data=b"z")
+    assert out["result"] == "conflict"
+    assert decode_hlc(fs.filer.store.kv_get(
+        tombstone_key("/buckets/b/d"))) == (ts + 20, 1)
+    # an all-older subtree still deletes wholesale
+    out = ap.apply(origin=1, source=11, seq=5, hlc=ts + 40, op="delete",
+                   path="/buckets/b/d")
+    assert out["result"] == "ok"
+    assert _read(fs, "/buckets/b/d/new") is None
+
+
+def test_applied_delete_tombstone_lands_before_event_notify():
+    """A tailing replicator (woken by the meta-log notify inside the
+    applied delete's append) must already see the ORIGIN's tombstone
+    stamp: writing it after delete_entry logged the event leaves a
+    window where the relay ships a fresh inflated local stamp around a
+    3+-cluster mesh."""
+    ap, fs = _applier()
+    ts = fs.filer.meta_log.next_ts()
+    ap.apply(origin=1, source=11, seq=1, hlc=ts + 10, op="put",
+             path="/buckets/b/r", data=b"v1")
+    seen = []
+
+    def on_event(resp):
+        n = resp.event_notification
+        if n.old_entry.name and not n.new_entry.name:
+            seen.append(decode_hlc(fs.filer.store.kv_get(
+                tombstone_key("/buckets/b/r"))))
+
+    fs.filer.meta_log.add_listener(on_event)
+    out = ap.apply(origin=1, source=11, seq=2, hlc=ts + 20, op="delete",
+                   path="/buckets/b/r")
+    assert out["result"] == "ok"
+    assert seen == [(ts + 20, 1)]  # origin stamp visible AT notify time
+
+
+def test_applier_watermark_exactly_once_and_persisted():
+    ap, fs = _applier()
+    ts = fs.filer.meta_log.next_ts()
+    assert ap.apply(origin=1, source=11, seq=5, hlc=ts + 1, op="put",
+                    path="/buckets/b/w", data=b"v1")["result"] == "ok"
+    # re-shipped after a sender crash: dropped by the watermark
+    assert ap.apply(origin=1, source=11, seq=5, hlc=ts + 1, op="put",
+                    path="/buckets/b/w",
+                    data=b"v1")["result"] == "dup"
+    # a DIFFERENT source link is tracked independently
+    assert ap.apply(origin=1, source=22, seq=5, hlc=ts + 1, op="put",
+                    path="/buckets/b/w2", data=b"v2")["result"] == "ok"
+    ap.flush()
+    ap2, _ = _applier(fs)  # restart: watermark read back from store KV
+    assert ap2.watermark(11) == (5, "")
+    assert ap2.apply(origin=1, source=11, seq=4, hlc=ts + 9, op="put",
+                     path="/buckets/b/w3",
+                     data=b"late")["result"] == "dup"
+
+
+def test_applier_seq0_resync_events_rely_on_lww_only():
+    ap, fs = _applier()
+    ts = fs.filer.meta_log.next_ts()
+    for _ in range(2):  # idempotent, no watermark involvement
+        out = ap.apply(origin=1, source=11, seq=0, hlc=ts + 1, op="put",
+                       path="/buckets/b/r", data=b"resync")
+    assert out["result"] in ("ok", "dup")
+    assert _read(fs, "/buckets/b/r") == b"resync"
+    assert ap.watermark(11) == (0, "")
+
+
+# ---------------------------------------------------------------------------
+# GeoReplicator against a stub remote
+# ---------------------------------------------------------------------------
+
+
+class _GeoStub(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    applies: list = []
+    cluster_id = 9
+    fail_next = 0
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _json(self, code, obj):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path.startswith("/.geo/status"):
+            return self._json(200, {"clusterId": self.cluster_id,
+                                    "signature": 999})
+        self._json(404, {})
+
+    def do_POST(self):
+        q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
+        body = self.rfile.read(
+            int(self.headers.get("Content-Length") or 0))
+        if type(self).fail_next > 0:
+            type(self).fail_next -= 1
+            return self._json(503, {"error": "injected"})
+        if type(self).quota_next > 0:
+            type(self).quota_next -= 1
+            return self._json(403, {"error": "quota exceeded"})
+        if type(self).disabled_next > 0:
+            type(self).disabled_next -= 1
+            return self._json(404, {"error": "geo replication not "
+                                             "enabled"})
+        if type(self).skew_next > 0:
+            type(self).skew_next -= 1
+            body = json.dumps({"error": "hlc ahead of clock"}).encode()
+            self.send_response(400)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("X-Seaweed-Reject", "skew")
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self.applies.append({
+            "op": q.get("op", [""])[0],
+            "path": q.get("path", [""])[0],
+            "seq": int(q.get("seq", ["0"])[0]),
+            "hlc": int(q.get("hlc", ["0"])[0]),
+            "origin": int(q.get("origin", ["0"])[0]),
+            "data": body,
+        })
+        self._json(200, {"result": "ok"})
+
+
+def _start_stub():
+    handler = type("BoundGeoStub", (_GeoStub,),
+                   {"applies": [], "cluster_id": 9, "fail_next": 0,
+                    "quota_next": 0, "disabled_next": 0,
+                    "skew_next": 0})
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, handler, f"127.0.0.1:{httpd.server_address[1]}"
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_replicator_ships_checkpoints_and_resumes_exactly_once(tmp_path):
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=1)
+        for i in range(5):
+            fs.write_file(f"/buckets/b/f{i}", f"payload-{i}".encode())
+        rep = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                            rate_mbps=0)
+        rep.start()
+        assert _wait(lambda: len(
+            [a for a in handler.applies if a["op"] == "put"]) >= 5)
+        rep.stop()
+        puts = [a for a in handler.applies if a["op"] == "put"]
+        assert [a["path"] for a in puts] == [
+            f"/buckets/b/f{i}" for i in range(5)]
+        assert puts[0]["data"] == b"payload-0"
+        assert rep.checkpoint() == fs.filer.meta_log.last_seq()
+        # restart on the same journal: nothing re-ships
+        n = len(handler.applies)
+        rep2 = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                             rate_mbps=0)
+        rep2.start()
+        fs.write_file("/buckets/b/after", b"only-this")
+        assert _wait(lambda: any(a["path"] == "/buckets/b/after"
+                                 for a in handler.applies))
+        rep2.stop()
+        new = handler.applies[n:]
+        assert [a["path"] for a in new if a["op"] == "put"] == [
+            "/buckets/b/after"]
+    finally:
+        httpd.shutdown()
+
+
+def test_replicator_retries_transient_503(tmp_path):
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=1)
+        fs.write_file("/buckets/b/x", b"v")
+        handler.fail_next = 2  # two 503s, then accept
+        rep = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                            rate_mbps=0)
+        rep.start()
+        assert _wait(lambda: any(a["path"] == "/buckets/b/x"
+                                 for a in handler.applies), timeout=15)
+        rep.stop()
+    finally:
+        httpd.shutdown()
+
+
+def test_replicator_stop_mid_ship_does_not_advance_checkpoint(tmp_path):
+    """stop() while an event is un-acknowledged (remote rejecting with
+    retryable 503s) must NOT advance the checkpoint: a restart
+    re-delivers the event instead of silently losing it forever."""
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=1)
+        fs.write_file("/buckets/b/lost", b"v")
+        handler.fail_next = 1 << 30  # remote never accepts
+        rep = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                            rate_mbps=0)
+        rep.start()
+        # wait until the ship loop has burned at least two attempts
+        assert _wait(lambda: handler.fail_next < (1 << 30) - 1,
+                     timeout=15)
+        rep.stop()
+        assert rep.checkpoint() == 0  # event stays owed
+        assert not handler.applies
+    finally:
+        httpd.shutdown()
+
+
+def test_replicator_holds_link_on_remote_quota_403(tmp_path):
+    """A remote 403 (tenant quota full) is transient over OPERATOR
+    time, not poison: skipping it would advance the checkpoint past
+    the event and silently break byte-identity with no resync trigger.
+    The link must hold and deliver once the quota clears."""
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=1)
+        fs.write_file("/buckets/b/q", b"v")
+        handler.quota_next = 2  # two 403s, then the quota is raised
+        rep = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                            rate_mbps=0)
+        rep.start()
+        assert _wait(lambda: any(a["path"] == "/buckets/b/q"
+                                 for a in handler.applies), timeout=15)
+        rep.stop()
+    finally:
+        httpd.shutdown()
+
+
+def test_replicator_holds_link_on_remote_geo_disabled_404(tmp_path):
+    """A 404 from /.geo/apply means the remote runs with geo DISABLED
+    (config rollback) — remote state, not a poison event: the link must
+    hold and deliver once geo is re-enabled, never advance the
+    checkpoint past the window."""
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=1)
+        fs.write_file("/buckets/b/g", b"v")
+        handler.disabled_next = 2  # two 404s, then geo is re-enabled
+        rep = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                            rate_mbps=0)
+        rep.start()
+        assert _wait(lambda: any(a["path"] == "/buckets/b/g"
+                                 for a in handler.applies), timeout=15)
+        rep.stop()
+    finally:
+        httpd.shutdown()
+
+
+def test_replicator_holds_link_on_skew_rejection(tmp_path):
+    """A 400 carrying the X-Seaweed-Reject: skew marker means OUR
+    clock looks broken to the remote — remote-state, clears over
+    operator time: hold the link, never skip past the checkpoint (a
+    plain 400 stays poison and is skipped)."""
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=1)
+        fs.write_file("/buckets/b/s", b"v")
+        handler.skew_next = 2  # two skew rejections, then accepted
+        rep = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                            rate_mbps=0)
+        rep.start()
+        assert _wait(lambda: any(a["path"] == "/buckets/b/s"
+                                 for a in handler.applies), timeout=15)
+        rep.stop()
+    finally:
+        httpd.shutdown()
+
+
+def test_replicator_skips_events_signed_by_remote(tmp_path):
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    httpd, handler, addr = _start_stub()  # stub reports clusterId 9
+    try:
+        fs = _StubFs(cluster_id=1)
+        # an apply FROM cluster 9 (the remote): must not ship back
+        fs.write_file("/buckets/b/from-remote", b"looped?",
+                      signatures=[9])
+        fs.write_file("/buckets/b/local", b"ship me")
+        rep = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                            rate_mbps=0)
+        rep.start()
+        assert _wait(lambda: any(a["path"] == "/buckets/b/local"
+                                 for a in handler.applies))
+        rep.stop()
+        assert not any(a["path"] == "/buckets/b/from-remote"
+                       for a in handler.applies)
+    finally:
+        httpd.shutdown()
+
+
+def test_replicator_resyncs_on_meta_log_gap(tmp_path):
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=1)
+        # memory-only ring with tiny capacity: early events evict -> a
+        # from-zero tail hits MetaLogGap -> full namespace resync
+        fs.filer.meta_log = MetaLogBuffer(capacity=4)
+        fs.filer.meta_log.observe(1)
+        for i in range(10):
+            fs.write_file(f"/buckets/b/f{i}", f"p{i}".encode())
+        rep = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                            rate_mbps=0)
+        rep.start()
+        assert _wait(lambda: len(
+            {a["path"] for a in handler.applies
+             if a["op"] == "put"}) >= 10, timeout=15)
+        rep.stop()
+        assert rep.resyncs >= 1
+        shipped = {a["path"] for a in handler.applies
+                   if a["op"] == "put"}
+        assert shipped == {f"/buckets/b/f{i}" for i in range(10)}
+        # resync events carry seq=0 (LWW-only, no watermark)
+        assert all(a["seq"] == 0 for a in handler.applies
+                   if a["op"] == "put")
+    finally:
+        httpd.shutdown()
+
+
+def test_replicator_skips_config_namespaces(tmp_path):
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=1)
+        fs.write_file("/etc/seaweedfs/filer.conf", b"local config")
+        fs.write_file("/buckets/b/real", b"object")
+        rep = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                            rate_mbps=0)
+        rep.start()
+        assert _wait(lambda: any(a["path"] == "/buckets/b/real"
+                                 for a in handler.applies))
+        rep.stop()
+        assert not any(a["path"].startswith("/etc/")
+                       for a in handler.applies)
+    finally:
+        httpd.shutdown()
+
+
+def test_replicator_file_rename_put_survives_watermark(tmp_path):
+    """A move ships delete+put halves from ONE source event: the delete
+    must ride seq=0 (LWW/tombstone-fenced) so advancing the remote
+    watermark on it cannot drop the sibling put as a duplicate."""
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=1)
+        fs.write_file("/buckets/b/old.bin", b"payload")
+        fs.filer.rename_entry("/buckets/b", "old.bin",
+                              "/buckets/b", "new.bin")
+        rep = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                            rate_mbps=0)
+        rep.start()
+        assert _wait(lambda: any(a["path"] == "/buckets/b/new.bin"
+                                 for a in handler.applies))
+        rep.stop()
+        deletes = [a for a in handler.applies if a["op"] == "delete"]
+        assert [a["path"] for a in deletes] == ["/buckets/b/old.bin"]
+        assert deletes[0]["seq"] == 0
+        put = [a for a in handler.applies
+               if a["path"] == "/buckets/b/new.bin"]
+        assert put and put[-1]["seq"] > 0
+        # replay the exact shipped stream into a REAL applier: the
+        # renamed object must exist at the new path, not vanish
+        ap, target = _applier(_StubFs(cluster_id=2, signature=888))
+        for a in handler.applies:
+            ap.apply(origin=a["origin"], source=11, seq=a["seq"],
+                     hlc=a["hlc"], op=a["op"], path=a["path"],
+                     data=a["data"])
+        assert _read(target, "/buckets/b/new.bin") == b"payload"
+        assert _read(target, "/buckets/b/old.bin") is None
+    finally:
+        httpd.shutdown()
+
+
+def test_replicator_dir_rename_reships_children(tmp_path):
+    """A renamed directory moved its children with raw store ops (no
+    per-child events): the replicator must re-ship the subtree under the
+    new path, or the remote's recursive delete destroys it forever."""
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=1)
+        fs.write_file("/buckets/b/dir/x.bin", b"xx")
+        fs.write_file("/buckets/b/dir/sub/y.bin", b"yy")
+        fs.filer.rename_entry("/buckets/b", "dir", "/buckets/b", "dir2")
+        rep = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                            rate_mbps=0)
+        rep.start()
+        assert _wait(lambda: {a["path"] for a in handler.applies
+                              if a["op"] == "put"} >= {
+            "/buckets/b/dir2/x.bin", "/buckets/b/dir2/sub/y.bin"})
+        rep.stop()
+        deletes = [a for a in handler.applies if a["op"] == "delete"]
+        assert [a["path"] for a in deletes] == ["/buckets/b/dir"]
+        assert deletes[0]["seq"] == 0
+        by_path = {a["path"]: a for a in handler.applies
+                   if a["op"] == "put"}
+        assert by_path["/buckets/b/dir2/x.bin"]["data"] == b"xx"
+        assert by_path["/buckets/b/dir2/sub/y.bin"]["data"] == b"yy"
+        # end-to-end replay: the remote ends with the subtree at the new
+        # path only
+        ap, target = _applier(_StubFs(cluster_id=2, signature=888))
+        for a in handler.applies:
+            ap.apply(origin=a["origin"], source=11, seq=a["seq"],
+                     hlc=a["hlc"], op=a["op"], path=a["path"],
+                     data=a["data"])
+        assert _read(target, "/buckets/b/dir2/x.bin") == b"xx"
+        assert _read(target, "/buckets/b/dir2/sub/y.bin") == b"yy"
+        assert _read(target, "/buckets/b/dir/x.bin") is None
+    finally:
+        httpd.shutdown()
+
+
+def test_resync_preserves_remote_origin_no_phantom_conflict(tmp_path):
+    """_resync re-ships pre-existing state with each entry's TRUE origin
+    stamp: an entry the remote itself originated must compare equal
+    there (dup) instead of inflating the conflict counter with
+    same-timestamp cluster-id mismatches."""
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=1)
+        ts = fs.filer.meta_log.next_ts()
+        # an entry the REMOTE cluster (id 9) originated, relayed here
+        # (relays carry replication signatures; the origin stamp sticks)
+        fs.write_file("/buckets/b/theirs", b"their-bytes",
+                      signatures=[9],
+                      extended={GEO_HLC_KEY: encode_hlc(ts, 9)})
+        fs.write_file("/buckets/b/ours", b"our-bytes")
+        rep = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                            rate_mbps=0)
+        rep._remote_cid = 9
+        rep._resync()
+        by_path = {a["path"]: a for a in handler.applies
+                   if a["op"] == "put"}
+        assert by_path["/buckets/b/theirs"]["origin"] == 9
+        assert by_path["/buckets/b/ours"]["origin"] == 1
+        # replayed into a remote that already holds ITS copy: equal
+        # stamps land as dup, never a phantom LWW conflict
+        ap, target = _applier(_StubFs(cluster_id=9, signature=999))
+        target.write_file("/buckets/b/theirs", b"their-bytes",
+                          signatures=[1],
+                          extended={GEO_HLC_KEY: encode_hlc(ts, 9)})
+        before = _counter("seaweedfs_geo_conflicts_total", "9", "local")
+        a = by_path["/buckets/b/theirs"]
+        out = ap.apply(origin=a["origin"], source=11, seq=0,
+                       hlc=a["hlc"], op="put", path=a["path"],
+                       data=a["data"])
+        assert out["result"] == "dup"
+        assert _counter("seaweedfs_geo_conflicts_total",
+                        "9", "local") == before
+    finally:
+        httpd.shutdown()
+
+
+def test_subscribe_no_duplicates_when_ring_overlaps_disk(tmp_path):
+    """The cold (disk) scan ts-filters at the frame header; the hand-off
+    to the live ring must not re-deliver records the filter skipped."""
+    log = MetaLogBuffer(capacity=8, dir=str(tmp_path / "log"))
+    tss = [log.append("/d", None, _entry(f"f{i}")) for i in range(6)]
+    stop = threading.Event()
+    got: list = []
+
+    def consume():
+        for ev in log.subscribe(tss[2], stop_event=stop,
+                                poll_interval=0.02):
+            got.append(ev.event_notification.new_entry.name)
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    assert _wait(lambda: len(got) >= 3)
+    log.append("/d", None, _entry("live"))
+    assert _wait(lambda: "live" in got)
+    stop.set()
+    t.join(timeout=5)
+    assert got == ["f3", "f4", "f5", "live"]
+
+
+def test_applier_refuses_far_future_hlc():
+    """A corrupt/forged far-future stamp must be rejected (400 to the
+    sender) BEFORE it poisons the local clock or fences the path."""
+    ap, fs = _applier()
+    bad = time.time_ns() + int(48 * 3600 * 1e9)  # 48h ahead
+    with pytest.raises(ValueError):
+        ap.apply(origin=1, source=11, seq=1, hlc=bad, op="delete",
+                 path="/buckets/b/poison")
+    # the clock did not fold the stamp in, and no tombstone landed
+    assert fs.filer.meta_log.next_ts() < bad
+    assert fs.filer.store.kv_get(
+        tombstone_key("/buckets/b/poison")) is None
+    # a stamp within the allowed skew still applies
+    ok = time.time_ns() + int(60 * 1e9)
+    out = ap.apply(origin=1, source=11, seq=1, hlc=ok, op="put",
+                   path="/buckets/b/skewed", data=b"v")
+    assert out["result"] == "ok"
+
+
+def test_recover_clock_survives_empty_newest_segment(tmp_path):
+    """A crash right after a segment roll leaves the newest segment
+    empty; recovery must restore the HLC from the previous segment so
+    new stamps never regress below already-issued ones."""
+    d = str(tmp_path / "log")
+    log = MetaLogBuffer(dir=d, segment_bytes=1 << 20)
+    future = time.time_ns() + int(120 * 1e9)
+    log.observe(future)  # a remote stamp ahead of the wall clock
+    log.append("/d", None, _entry("f0"))  # persisted with ts > future
+    log.close()
+    # simulate the roll-then-crash: an empty newest segment
+    open(os.path.join(d, "seg-0000000000000002.log"), "wb").close()
+    log2 = MetaLogBuffer(dir=d)
+    assert log2.last_seq() == 1
+    assert log2.next_ts() > future
+
+
+def test_recover_clock_scans_past_older_ingested_segments(tmp_path):
+    """The max issued ts is not necessarily in the NEWEST segment:
+    aggregator-ingested peer events keep their original (older) stamps
+    and can fill whole segments after a local append with a newer one.
+    Recovery must scan all retained segments or the clock regresses and
+    later stamps lose LWW remotely."""
+    d = str(tmp_path / "log")
+    log = MetaLogBuffer(dir=d, segment_bytes=256)
+    future = time.time_ns() + int(120 * 1e9)
+    log.observe(future)
+    log.append("/d", None, _entry("fresh"))  # ts > future, segment 1
+    old = time.time_ns() - int(3600 * 1e9)
+    for i in range(12):  # several segments of older-stamped peer events
+        resp = filer_pb2.SubscribeMetadataResponse(
+            directory="/d", ts_ns=old + i)
+        resp.event_notification.new_entry.CopyFrom(_entry(f"peer{i}"))
+        log.ingest(resp)
+    segs = [p for p in os.listdir(d) if p.startswith("seg-")]
+    assert len(segs) >= 2  # the newest segment holds only old stamps
+    log.close()
+    log2 = MetaLogBuffer(dir=d)
+    assert log2.next_ts() > future
+
+
+def test_read_persisted_retention_race_raises_gap(tmp_path):
+    """Retention deleting a segment mid-read must surface MetaLogGap
+    (the documented loud-gap protocol), not FileNotFoundError."""
+    d = str(tmp_path / "log")
+    log = MetaLogBuffer(dir=d, segment_bytes=256)
+    for i in range(12):  # several segments
+        log.append("/d", None, _entry(f"f{i}"))
+    segs = sorted(p for p in os.listdir(d) if p.startswith("seg-"))
+    assert len(segs) >= 3
+    gen = log._read_persisted(0, 1 << 60)
+    next(gen)  # first segment is open
+    for name in segs[1:]:  # retention removes the rest under us
+        os.remove(os.path.join(d, name))
+    with pytest.raises(MetaLogGap):
+        for _ in gen:
+            pass
+
+
+def test_applied_event_ts_stays_monotonic_for_ts_subscribers():
+    """A geo apply keeps the ORIGIN stamp on the entry but must log a
+    fresh monotonic event ts: a ts-resumed subscriber (within-cluster
+    replicator) would otherwise silently skip the applied mutation."""
+    ap, fs = _applier()
+    fs.write_file("/buckets/b/recent", b"local")  # advances the clock
+    high = fs.filer.meta_log.last_seq()
+    with fs.filer.meta_log._cond:
+        last_ts = fs.filer.meta_log._last_ts
+    old_hlc = last_ts - 10_000_000  # origin stamp BEHIND the local clock
+    out = ap.apply(origin=1, source=11, seq=1, hlc=old_hlc, op="put",
+                   path="/buckets/b/applied", data=b"remote")
+    assert out["result"] == "ok"
+    stop = threading.Event()
+    events = []
+    for seq, ev in fs.filer.meta_log.tail(high, stop_event=stop,
+                                          poll_interval=0.02):
+        events.append(ev)
+        if ev.event_notification.new_entry.name == "applied":
+            stop.set()
+    assert all(ev.ts_ns > last_ts for ev in events), \
+        "applied event logged with a regressed ts"
+    # while the ENTRY keeps the origin stamp for LWW
+    stored = fs.filer.find_entry("/buckets/b/applied")
+    assert decode_hlc(bytes(stored.extended[GEO_HLC_KEY])) == (old_hlc, 1)
+
+
+def test_relayed_event_ships_origin_stamp(tmp_path):
+    """In a 3+ cluster mesh, relaying an applied event must ship the
+    entry's ORIGIN (hlc, cluster), not the relay's — otherwise every hop
+    re-wins LWW over the original and stamps diverge around the mesh."""
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=2)
+        origin_hlc = fs.filer.meta_log.next_ts() - 5_000_000
+        # an apply from cluster 1 relayed through this cluster (2) —
+        # signed by 1, stamped (origin_hlc, 1)
+        fs.write_file("/buckets/b/relay", b"v", signatures=[1],
+                      extended={GEO_HLC_KEY: encode_hlc(origin_hlc, 1)})
+        rep = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                            rate_mbps=0)  # stub reports cluster_id 9
+        rep.start()
+        assert _wait(lambda: any(a["path"] == "/buckets/b/relay"
+                                 for a in handler.applies))
+        rep.stop()
+        a = [x for x in handler.applies
+             if x["path"] == "/buckets/b/relay"][-1]
+        assert a["origin"] == 1, "relay must not claim the event"
+        assert a["hlc"] == origin_hlc
+    finally:
+        httpd.shutdown()
+
+
+def test_applier_lww_window_serialized_with_local_writes():
+    """The stripe lock closes the check-then-act window: a newer local
+    write that lands while the applier is mid-apply must not be
+    overwritten by the older remote event."""
+    ap, fs = _applier()
+    path = "/buckets/b/raced"
+    old_hlc = fs.filer.meta_log.next_ts()
+    started, done = threading.Event(), threading.Event()
+
+    def apply_older():
+        started.set()
+        out = ap.apply(origin=1, source=11, seq=1, hlc=old_hlc,
+                       op="put", path=path, data=b"stale-remote")
+        done.set()
+        results.append(out["result"])
+
+    results: list = []
+    lock = fs.filer.path_mutation_lock(path)
+    with lock:  # hold the stripe: the applier must block…
+        t = threading.Thread(target=apply_older, daemon=True)
+        t.start()
+        started.wait(5)
+        time.sleep(0.1)
+        assert not done.is_set(), "applier ignored the mutation stripe"
+        # …while a newer local write lands (reentrant for this thread)
+        fs.write_file(path, b"newer-local")
+    t.join(timeout=10)
+    assert results == ["conflict"]
+    assert _read(fs, path) == b"newer-local"
+
+
+def test_meta_log_fsync_param_passthrough(tmp_path):
+    f = Filer(make_store("memory"), meta_log_dir=str(tmp_path / "l"),
+              meta_log_fsync=False)
+    assert f.meta_log._fsync is False
+    f2 = Filer(make_store("memory"), meta_log_dir=str(tmp_path / "l2"),
+               meta_log_fsync=True)
+    assert f2.meta_log._fsync is True
+
+
+# ---------------------------------------------------------------------------
+# classified sink applies (satellite)
+# ---------------------------------------------------------------------------
+
+
+class _FlakySink(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    codes: list = []
+    hits = 0
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _reply(self, code):
+        type(self).hits += 1
+        self.rfile.read(int(self.headers.get("Content-Length") or 0))
+        self.send_response(code)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_PUT(self):
+        self._reply(self.codes.pop(0) if self.codes else 200)
+
+    def do_DELETE(self):
+        self._reply(self.codes.pop(0) if self.codes else 204)
+
+
+def _start_sink(codes):
+    handler = type("BoundFlaky", (_FlakySink,),
+                   {"codes": list(codes), "hits": 0})
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, handler, f"127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_sink_apply_retries_5xx_then_succeeds():
+    from seaweedfs_tpu.replication.sink import FilerSink
+
+    httpd, handler, addr = _start_sink([503])
+    try:
+        FilerSink(addr).create_entry("/d", _entry("a", b"x"), b"x")
+        assert handler.hits == 2  # one 503, one success
+    finally:
+        httpd.shutdown()
+
+
+def test_sink_apply_4xx_is_permanent_no_retry():
+    from seaweedfs_tpu.replication.sink import (
+        FilerSink,
+        SinkPermanentError,
+    )
+
+    httpd, handler, addr = _start_sink([403, 200, 200])
+    try:
+        with pytest.raises(SinkPermanentError):
+            FilerSink(addr).create_entry("/d", _entry("a", b"x"), b"x")
+        assert handler.hits == 1  # no second attempt
+    finally:
+        httpd.shutdown()
+
+
+def test_sink_delete_404_is_success():
+    from seaweedfs_tpu.replication.sink import FilerSink
+
+    httpd, handler, addr = _start_sink([404])
+    try:
+        FilerSink(addr).delete_entry("/d", "gone", False)
+        assert handler.hits == 1
+    finally:
+        httpd.shutdown()
+
+
+def test_replicator_skips_permanent_rejects_and_continues():
+    """A poison event (permanent 4xx) must not dam the stream: the
+    replicator counts it and applies the NEXT event."""
+    from seaweedfs_tpu.replication.replicator import Replicator
+    from seaweedfs_tpu.replication.sink import SinkPermanentError
+
+    class _Sink:
+        def __init__(self):
+            self.created = []
+
+        def create_entry(self, directory, entry, data):
+            if entry.name == "poison":
+                raise SinkPermanentError("403 forbidden")
+            self.created.append(entry.name)
+
+        def delete_entry(self, *a):
+            pass
+
+    class _Src:
+        def read_entry_data(self, directory, entry):
+            return b"d"
+
+    sink = _Sink()
+    rep = Replicator(_Src(), sink)
+    ev = filer_pb2.EventNotification()
+    ev.new_entry.name = "poison"
+    with pytest.raises(SinkPermanentError):
+        rep.process_event("/d", ev)
+    ok = filer_pb2.EventNotification()
+    ok.new_entry.name = "fine"
+    rep.process_event("/d", ok)
+    assert sink.created == ["fine"]
+
+
+# ---------------------------------------------------------------------------
+# fleet client geo failover
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_client_fails_over_to_remote_cluster():
+    from seaweedfs_tpu.filer.fleet.fleet_client import FleetFilerClient
+    from seaweedfs_tpu.filer.fleet.router import FleetRouter
+
+    router = FleetRouter(filers=["127.0.0.1:1", "127.0.0.1:2"],
+                         remote_filers=["127.0.0.1:3"])
+    client = FleetFilerClient(router)
+    served = []
+
+    def fn(c):
+        if c.http_address in ("127.0.0.1:1", "127.0.0.1:2"):
+            raise ConnectionRefusedError("local cluster is dead")
+        served.append(c.http_address)
+        return "remote-answer"
+
+    before = _counter("seaweedfs_filer_ring_route_total", "remote")
+    assert client._run("/buckets/b/k", fn) == "remote-answer"
+    assert served == ["127.0.0.1:3"]
+    assert _counter("seaweedfs_filer_ring_route_total",
+                    "remote") == before + 1
+
+
+def test_fleet_client_prefers_local_when_alive():
+    from seaweedfs_tpu.filer.fleet.fleet_client import FleetFilerClient
+    from seaweedfs_tpu.filer.fleet.router import FleetRouter
+
+    router = FleetRouter(filers=["127.0.0.1:1"],
+                         remote_filers=["127.0.0.1:3"])
+    client = FleetFilerClient(router)
+    assert client._run("/buckets/b/k",
+                       lambda c: c.http_address) == "127.0.0.1:1"
+
+
+def test_router_without_remote_has_no_remote_candidates():
+    from seaweedfs_tpu.filer.fleet.router import FleetRouter
+
+    router = FleetRouter(filers=["127.0.0.1:1"])
+    assert router.remote_candidates("/buckets/b/k") == []
+
+
+def test_fleet_client_total_loss_beyond_try_cap_goes_remote():
+    """Geo failover must engage on TOTAL local loss even when the fleet
+    is larger than the bounded try cap: the sweep proves every local
+    shard dark before dodging to the remote cluster (a capped sweep
+    would misclassify the all-dark cluster as a partial outage and 503
+    forever)."""
+    from seaweedfs_tpu.filer.fleet.fleet_client import (
+        FleetFilerClient,
+        MAX_TRIES,
+    )
+    from seaweedfs_tpu.filer.fleet.router import FleetRouter
+
+    local = [f"127.0.0.1:{p}" for p in range(1, MAX_TRIES + 3)]
+    router = FleetRouter(filers=local, remote_filers=["127.0.0.1:99"])
+    client = FleetFilerClient(router)
+    touched = []
+
+    def fn(c):
+        touched.append(c.http_address)
+        if c.http_address != "127.0.0.1:99":
+            raise ConnectionRefusedError("down")
+        return "remote-answer"
+
+    assert client._run("/buckets/b/k", fn) == "remote-answer"
+    assert set(local) <= set(touched)  # every local shard proven dark
+    assert touched[-1] == "127.0.0.1:99"
+
+
+def test_fleet_client_partial_outage_serves_from_surviving_shard():
+    """A PARTIAL outage must never route to the remote cluster
+    (avoidable LWW conflicts + local stale reads): the full local sweep
+    reaches the surviving shard and serves from it."""
+    from seaweedfs_tpu.filer.fleet.fleet_client import (
+        FleetFilerClient,
+        MAX_TRIES,
+    )
+    from seaweedfs_tpu.filer.fleet.router import FleetRouter
+
+    local = [f"127.0.0.1:{p}" for p in range(1, MAX_TRIES + 3)]
+    alive = local[-1]
+    router = FleetRouter(filers=local, remote_filers=["127.0.0.1:99"])
+    client = FleetFilerClient(router)
+    touched = []
+
+    def fn(c):
+        touched.append(c.http_address)
+        if c.http_address != alive:
+            raise ConnectionRefusedError("down")
+        return "local-answer"
+
+    assert client._run("/buckets/b/k", fn) == "local-answer"
+    assert "127.0.0.1:99" not in touched  # remote never consulted
+
+
+def test_fleet_client_try_cap_bounds_sweep_without_geo():
+    """Without a geo fallback the bounded try cap still applies — a
+    flapping fleet must not turn one request into an unbounded sweep."""
+    from seaweedfs_tpu.filer.fleet.fleet_client import (
+        FleetFilerClient,
+        FilerUnavailable,
+        MAX_TRIES,
+    )
+    from seaweedfs_tpu.filer.fleet.router import FleetRouter
+
+    local = [f"127.0.0.1:{p}" for p in range(1, MAX_TRIES + 3)]
+    router = FleetRouter(filers=local)
+    client = FleetFilerClient(router)
+    touched = []
+
+    def fn(c):
+        touched.append(c.http_address)
+        raise ConnectionRefusedError("down")
+
+    with pytest.raises(FilerUnavailable):
+        client._run("/buckets/b/k", fn)
+    assert len(touched) == MAX_TRIES
+
+
+def test_fleet_client_discovery_failure_goes_remote():
+    """A fresh gateway (no cached ring) whose local masters are all
+    unreachable must still reach the geo fallback: discovery failures
+    are an outage, not an unclassified error."""
+    from seaweedfs_tpu.filer.fleet.fleet_client import FleetFilerClient
+    from seaweedfs_tpu.filer.fleet.router import FleetRouter
+
+    router = FleetRouter(masters=["127.0.0.1:1"],
+                         remote_filers=["127.0.0.1:99"])
+    client = FleetFilerClient(router)
+    served = []
+
+    def fn(c):
+        served.append(c.http_address)
+        return "remote-answer"
+
+    assert client._run("/buckets/b/k", fn) == "remote-answer"
+    assert served == ["127.0.0.1:99"]
+
+
+# ---------------------------------------------------------------------------
+# master geo registry
+# ---------------------------------------------------------------------------
+
+
+def test_master_geo_status_probes_peers_and_collects_link_samples():
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.pb import master_pb2
+
+    m = MasterServer(port=1, peer_clusters=["127.0.0.1:9"])  # not started
+    try:
+        snap = master_pb2.StatsSnapshot(captured_at_ms=1)
+        snap.samples.add(
+            name='seaweedfs_geo_lag_seconds{link="c1->x"}', value=0.25)
+        snap.samples.add(name="seaweedfs_request_total", value=99)
+        m.record_stats_snapshot("127.0.0.1:8888", "filer", snap)
+        doc = m.geo_status()
+        assert doc["peerClusters"]["127.0.0.1:9"]["reachable"] is False
+        links = doc["links"]["127.0.0.1:8888"]
+        assert links['seaweedfs_geo_lag_seconds{link="c1->x"}'] == 0.25
+        assert "seaweedfs_request_total" not in links
+    finally:
+        m.federation_pool.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / log-incarnation binding, body cap, lag semantics
+# ---------------------------------------------------------------------------
+
+
+def test_replicator_resyncs_on_log_incarnation_change(tmp_path):
+    """A checkpoint is bound to ONE meta-log identity: after the log dir
+    is wiped/repointed (seq restarts at 1), resuming by bare seq would
+    silently skip the new log's first N events once last_seq catches up
+    past the stale checkpoint — the link must resync instead."""
+    from seaweedfs_tpu.replication.geo import GeoReplicator
+
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=1)
+        fs.filer.meta_log = MetaLogBuffer(dir=str(tmp_path / "log-a"))
+        for i in range(3):
+            fs.write_file(f"/buckets/b/f{i}", f"p{i}".encode())
+        rep = GeoReplicator(fs, addr,
+                            journal_dir=str(tmp_path / "j"), rate_mbps=0)
+        rep.start()
+        assert _wait(lambda: len(
+            [a for a in handler.applies if a["op"] == "put"]) >= 3)
+        rep.stop()
+        stale_ckpt = rep.checkpoint()
+        assert stale_ckpt == fs.filer.meta_log.last_seq()
+        # NEW incarnation: fresh dir, seq restarts at 1 — the stale
+        # checkpoint is at-or-past the new head, so without the log_id
+        # check tail() would serve few or none of the new events
+        fs.filer.meta_log = MetaLogBuffer(dir=str(tmp_path / "log-b"))
+        for i in range(5):
+            fs.write_file(f"/buckets/b/g{i}", f"q{i}".encode())
+        n = len(handler.applies)
+        rep2 = GeoReplicator(fs, addr,
+                             journal_dir=str(tmp_path / "j"), rate_mbps=0)
+        rep2.start()
+        assert _wait(lambda: {f"/buckets/b/g{i}" for i in range(5)} <= {
+            a["path"] for a in handler.applies[n:] if a["op"] == "put"},
+            timeout=15)
+        rep2.stop()
+        # without the log_id check, tail(3) on the new log serves only
+        # seqs 4..5 — g0..g2 would be missing above; the resync path is
+        # what shipped them
+        assert rep2.resyncs >= 1
+        # drained after resync: lag reads 0, not age-of-last-event
+        assert rep2.status()["lagSeconds"] == 0.0
+        # the healed checkpoint is bound to the NEW incarnation
+        rec = rep2.journal.get(rep2._key)
+        assert rec["log_id"] == fs.filer.meta_log.log_id
+    finally:
+        httpd.shutdown()
+
+
+def test_replicator_skips_oversized_entries(tmp_path, monkeypatch):
+    """An entry above the geo body cap is skipped (counted), not
+    shipped: one multi-GB object must not OOM both filers or dam the
+    stream behind a guaranteed 413."""
+    from seaweedfs_tpu.replication import geo as geo_mod
+
+    monkeypatch.setattr(geo_mod, "MAX_BODY_BYTES", 16)
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=1)
+        fs.write_file("/buckets/b/big", b"x" * 64)
+        fs.write_file("/buckets/b/ok", b"small")
+        rep = geo_mod.GeoReplicator(fs, addr,
+                                    journal_dir=str(tmp_path),
+                                    rate_mbps=0)
+        rep.start()
+        assert _wait(lambda: any(a["path"] == "/buckets/b/ok"
+                                 for a in handler.applies))
+        rep.stop()
+        assert not any(a["path"] == "/buckets/b/big"
+                       for a in handler.applies)
+        # the stream advanced past the oversized event (checkpointed)
+        assert rep.checkpoint() == fs.filer.meta_log.last_seq()
+    finally:
+        httpd.shutdown()
+
+
+def test_geo_apply_rejects_oversized_content_length():
+    """POST /.geo/apply with a huge Content-Length is refused up front
+    (413, connection closed) — never buffered."""
+    import socket
+
+    from seaweedfs_tpu.filer.http_handlers import FilerHttpHandler
+    from seaweedfs_tpu.replication.geo import GeoApplier, MAX_BODY_BYTES
+
+    fs = _StubFs(cluster_id=1)
+    fs.geo_applier = GeoApplier(fs)
+    handler = type("BoundFilerHandler", (FilerHttpHandler,),
+                   {"filer_server": fs})
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        with socket.create_connection(httpd.server_address,
+                                      timeout=10) as s:
+            s.sendall(
+                b"POST /.geo/apply?op=put&path=/buckets/b/x HTTP/1.1\r\n"
+                b"Host: t\r\n"
+                b"Content-Length: " + str(MAX_BODY_BYTES + 1).encode()
+                + b"\r\n\r\n")
+            status = s.recv(4096).split(b"\r\n", 1)[0]
+        assert b"413" in status
+        # a small body on the same surface still applies fine
+        ts = fs.filer.meta_log.next_ts()
+        q = (f"origin=7&src=77&seq=1&hlc={ts + 5}"
+             f"&op=put&path=/buckets/b/x")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{httpd.server_address[1]}"
+            f"/.geo/apply?{q}", data=b"v1", method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["result"] == "ok"
+        assert _read(fs, "/buckets/b/x") == b"v1"
+    finally:
+        httpd.shutdown()
+
+
+def test_walk_ship_dirs_carry_true_origin(tmp_path):
+    """Resync re-ships a directory with the cluster id that CREATED it
+    (its stored stamp), not the local id — otherwise a backlog delete
+    carrying the true origin stamp mis-compares against the resynced
+    mkdir and the 'same mutation' dup/LWW tiebreak inverts."""
+    from seaweedfs_tpu.replication.geo import GeoApplier, GeoReplicator
+
+    fs = _StubFs(cluster_id=1)
+    ap = GeoApplier(fs)
+    ts = fs.filer.meta_log.next_ts()
+    ap.apply(origin=7, source=77, seq=1, hlc=ts + 5, op="mkdir",
+             path="/buckets/b/dir7")
+    httpd, handler, addr = _start_stub()
+    try:
+        rep = GeoReplicator(fs, addr, rate_mbps=0)
+        rep._walk_ship("/")
+        mk = [a for a in handler.applies
+              if a["op"] == "mkdir" and a["path"] == "/buckets/b/dir7"]
+        assert mk and mk[0]["origin"] == 7
+        assert mk[0]["hlc"] == ts + 5
+    finally:
+        httpd.shutdown()
+
+
+def test_applier_watermark_scoped_to_log_incarnation():
+    """The (source, seq) dup check only means "already applied" within
+    ONE sender log incarnation: after the sender's log dir is wiped and
+    seq restarts at 1, the new log's low seqs must APPLY — not be
+    swallowed as duplicates of the old log's higher watermark."""
+    ap, fs = _applier()
+    ts = fs.filer.meta_log.next_ts()
+    assert ap.apply(origin=1, source=11, seq=7, hlc=ts + 1, op="put",
+                    path="/buckets/b/a", data=b"v1",
+                    log="log-A")["result"] == "ok"
+    # same incarnation, re-delivered: dup
+    assert ap.apply(origin=1, source=11, seq=7, hlc=ts + 1, op="put",
+                    path="/buckets/b/a", data=b"v1",
+                    log="log-A")["result"] == "dup"
+    # NEW incarnation restarts at seq 2 < 7: must apply, not dup
+    assert ap.apply(origin=1, source=11, seq=2, hlc=ts + 5, op="put",
+                    path="/buckets/b/b", data=b"v2",
+                    log="log-B")["result"] == "ok"
+    assert _read(fs, "/buckets/b/b") == b"v2"
+    # the watermark rebound to the new incarnation...
+    assert ap.watermark(11) == (2, "log-B")
+    # ...and re-delivery within it dedupes again
+    assert ap.apply(origin=1, source=11, seq=2, hlc=ts + 5, op="put",
+                    path="/buckets/b/b", data=b"v2",
+                    log="log-B")["result"] == "dup"
+    # persistence round-trips the (seq, log) pair
+    ap.flush()
+    ap2, _ = _applier(fs)
+    assert ap2.watermark(11) == (2, "log-B")
+
+
+def test_applier_ancestor_tombstone_fences_subtree():
+    """A recursive directory delete leaves ONE tombstone at the
+    directory; a backlogged OLDER remote write inside the subtree must
+    compare against that ancestor fence — else it resurrects the
+    deleted tree on this cluster only (permanent divergence)."""
+    ap, fs = _applier()
+    fs.write_file("/buckets/b/d/f", b"v1")
+    d, n = split_path("/buckets/b/d")
+    fs.filer.delete_entry(d, n, is_recursive=True,
+                          ignore_recursive_error=True)
+    tomb = decode_hlc(fs.filer.store.kv_get(tombstone_key("/buckets/b/d")))
+    assert tomb is not None
+    out = ap.apply(origin=1, source=11, seq=1, hlc=tomb[0] - 100,
+                   op="put", path="/buckets/b/d/f2", data=b"zombie")
+    assert out["result"] == "conflict"
+    assert _read(fs, "/buckets/b/d/f2") is None
+    e = fs.filer.find_entry("/buckets/b/d")
+    assert e is None or not e.name  # the dir stayed dead too
+    # the same fence applies to a resurrecting mkdir of a SUBdirectory
+    out = ap.apply(origin=1, source=11, seq=2, hlc=tomb[0] - 50,
+                   op="mkdir", path="/buckets/b/d/sub")
+    assert out["result"] == "conflict"
+    # a STRICTLY NEWER write inside the subtree resurrects legitimately
+    out = ap.apply(origin=1, source=11, seq=3, hlc=tomb[0] + 100,
+                   op="put", path="/buckets/b/d/f3", data=b"reborn")
+    assert out["result"] == "ok"
+    assert _read(fs, "/buckets/b/d/f3") == b"reborn"
+
+
+def test_relayed_delete_ships_tombstone_origin_stamp(tmp_path):
+    """Relaying an applied DELETE (3+-cluster mesh) must ship the
+    tombstone's ORIGIN (hlc, cluster), not the relay's fresh event
+    stamp — an inflated fence at every hop would wrongly beat
+    concurrent writes the origin delete properly lost to."""
+    from seaweedfs_tpu.replication.geo import GeoApplier, GeoReplicator
+
+    httpd, handler, addr = _start_stub()
+    try:
+        fs = _StubFs(cluster_id=2)
+        ap = GeoApplier(fs)
+        fs.write_file("/buckets/b/x", b"v1")
+        h = fs.filer.meta_log.next_ts() + 1000
+        ap.apply(origin=1, source=11, seq=1, hlc=h, op="delete",
+                 path="/buckets/b/x")
+        rep = GeoReplicator(fs, addr, journal_dir=str(tmp_path),
+                            rate_mbps=0)
+        rep.start()
+        assert _wait(lambda: any(
+            a["op"] == "delete" and a["path"] == "/buckets/b/x"
+            for a in handler.applies))
+        rep.stop()
+        d = [a for a in handler.applies if a["op"] == "delete"][-1]
+        assert d["hlc"] == h
+        assert d["origin"] == 1, "relay must not claim the delete"
+    finally:
+        httpd.shutdown()
+
+
+def test_append_with_stale_reserved_ts_stays_monotonic():
+    """A stamp reserved via next_ts() before append's lock can lose the
+    append race to a later reservation; the LOGGED event ts must still
+    be arrival-monotonic or ts-resumed subscribers silently skip the
+    late-appended event on resubscribe."""
+    log = MetaLogBuffer()
+    t1 = log.next_ts()
+    t2 = log.next_ts()
+    logged_b = log.append("/d", None, _entry("b"), ts=t2)
+    logged_a = log.append("/d", None, _entry("a"), ts=t1)  # late append
+    assert logged_b == t2
+    assert logged_a > logged_b  # bumped, never regressing
