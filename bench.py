@@ -1007,8 +1007,14 @@ def _smallfile_rates(n: int = 20000, concurrency: int = 16,
                 return p
 
     tmp = tempfile.mkdtemp(prefix="swfs-smallfile-")
+    # --metrics-snapshot also runs the judgment plane during the bench:
+    # canary probes every second + the SLO engine on its burn-rate
+    # rules, so the emitted JSON carries its own SLO verdict (probe
+    # p50/p99 + any alerts that fired during the run)
     master = MasterServer(ip="127.0.0.1", port=_port(),
-                          volume_size_limit_mb=1024)
+                          volume_size_limit_mb=1024,
+                          canary_interval=1.0 if metrics_snapshot else 0.0,
+                          slo_interval=1.0 if metrics_snapshot else 0.0)
     master.start()
     vs_ = VolumeServer(directories=[tmp], ip="127.0.0.1", port=_port(),
                        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
@@ -1132,11 +1138,40 @@ def _smallfile_rates(n: int = 20000, concurrency: int = 16,
             out.update(_metrics_delta(
                 m_before,
                 _scrape_metrics(f"http://127.0.0.1:{vs_.port}/metrics")))
+            out.update(_slo_verdict(master))
         return out
     finally:
         vs_.stop()
         master.stop()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _slo_verdict(master) -> dict:
+    """Canary probe p50/p99 + alerts that fired during a bench run —
+    the run's own SLO verdict, folded into the smallfile JSON so a
+    bench regression carries its judgment with it."""
+    from seaweedfs_tpu.stats.metrics import CANARY_PROBE_SECONDS
+
+    out: dict = {}
+    canary = master.canary.status()
+    out["canary_probe_ticks"] = canary["tick"]
+    out["canary_byte_mismatches"] = canary["byteMismatches"]
+    counts, count, _total = _hist_child_snapshot(
+        CANARY_PROBE_SECONDS, "volume_rt")
+    if count:
+        buckets = CANARY_PROBE_SECONDS.buckets
+        out["canary_probe_p50_ms"] = round(
+            _hist_quantile(buckets, counts, count, 0.5) * 1e3, 3)
+        out["canary_probe_p99_ms"] = round(
+            _hist_quantile(buckets, counts, count, 0.99) * 1e3, 3)
+    fired = [h for h in master.slo.status(evaluate_if_idle=False)["history"]
+             if h["state"] == "firing"]
+    out["slo_alerts_fired"] = [
+        {"slo": h["slo"], "severity": h["severity"],
+         "burnShort": h.get("burnShort")} for h in fired]
+    out["slo_clean"] = not any(
+        h["severity"] == "page" for h in fired)
+    return out
 
 
 def _hist_child_snapshot(hist, *labels):

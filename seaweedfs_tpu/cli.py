@@ -35,6 +35,11 @@ def cmd_master(args) -> None:
 
         with open(args.lifecyclePolicy) as f:
             lifecycle_policy = json.load(f)
+    slo_specs = None
+    if args.sloSpecs:
+        from .telemetry.slo import specs_from_json
+
+        slo_specs = specs_from_json(args.sloSpecs)
     m = MasterServer(
         ip=args.ip,
         port=args.port,
@@ -57,6 +62,11 @@ def cmd_master(args) -> None:
         raft_state_dir=args.raftDir,
         peer_clusters=(args.peerClusters.split(",")
                        if args.peerClusters else None),
+        slo_interval=args.sloInterval,
+        slo_specs=slo_specs,
+        canary_interval=args.canaryInterval,
+        canary_s3=args.canaryS3,
+        alert_webhook=args.alertWebhook,
     )
     m.start()
     print(f"master listening http={args.port} grpc={m.grpc_port}")
@@ -717,6 +727,25 @@ def main(argv=None) -> None:
     m.add_argument("-peerClusters", default="",
                    help="comma-separated REMOTE-cluster master http "
                         "addresses for the /cluster/geo registry")
+    m.add_argument("-sloInterval", type=float, default=15.0,
+                   help="SLO engine evaluation tick seconds (burn-rate "
+                        "rules over family-filtered federation scrapes); "
+                        "0 = evaluate only when /cluster/alerts is read")
+    m.add_argument("-sloSpecs", default="",
+                   help="JSON file with a list of SLO spec objects "
+                        "(replaces the default suite; see METRICS.md "
+                        "'SLOs & alerts')")
+    m.add_argument("-canaryInterval", type=float, default=0.0,
+                   help="synthetic canary probe tick seconds (black-box "
+                        "write/read/delete, EC degraded read, routed "
+                        "metadata, geo sentinel); 0 disables")
+    m.add_argument("-canaryS3", default="",
+                   help="S3 gateway http address the metadata_rt canary "
+                        "routes through (empty = probe a registered "
+                        "filer directly)")
+    m.add_argument("-alertWebhook", default="",
+                   help="POST every alert state transition to this URL "
+                        "as JSON (the log sink always runs)")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume")

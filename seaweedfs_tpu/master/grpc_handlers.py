@@ -60,7 +60,16 @@ class MasterGrpcService:
                 # up, the node must rejoin on its next beat — otherwise it
                 # ghosts forever, still heartbeating into a topology that
                 # no longer contains it
-                node = self.topo.register_node(node)
+                node, was_new = self.topo.register_node(node)
+                if was_new:
+                    # a JOIN changes the EC holder map exactly like a
+                    # death: bump the cache-invalidation seq the ack
+                    # carries, or every peer's found-tier location cache
+                    # (found_ttl 300s) keeps serving the node-less map —
+                    # observed live as degraded reads failing "only 9
+                    # shards available" for minutes after a dead shard
+                    # holder REJOINED (the canary plane found this)
+                    self.master.note_topology_change(node.id)
                 if hb.max_file_key:
                     self.master.sequencer.set_max(hb.max_file_key)
                 new_vids, deleted_vids = [], []

@@ -85,18 +85,25 @@ def _scrape(url: str, timeout: float) -> str:
     return b"".join(chunks).decode("utf-8", errors="replace")
 
 
-def cluster_metrics(master) -> str:
-    """Prometheus exposition federated across every known node."""
-    fed = FederatedExposition()
+def cluster_metrics(master, family_prefixes: "list[str] | None" = None) -> str:
+    """Prometheus exposition federated across every known node.
+
+    `family_prefixes` (the validated ?family= filter) restricts the
+    merge to matching families AND rides the per-node scrape URL, so an
+    SLO evaluation tick moves a few families' worth of text per node
+    instead of the full exposition."""
+    fed = FederatedExposition(family_prefixes)
     t0 = time.perf_counter()
-    fed.add_live(_self_target(master), REGISTRY.render(),
+    fed.add_live(_self_target(master), REGISTRY.render(family_prefixes),
                  time.perf_counter() - t0)
     targets = federation_targets(master)
+    family_q = ("?family=" + ",".join(family_prefixes)
+                if family_prefixes else "")
 
     def scrape_one(t: dict):
         t1 = time.perf_counter()
         try:
-            text = _scrape(f"http://{t['http_address']}/metrics",
+            text = _scrape(f"http://{t['http_address']}/metrics{family_q}",
                            FEDERATION_TIMEOUT_S)
             return ("live", text, time.perf_counter() - t1)
         except Exception as e:  # noqa: BLE001 — any failure -> snapshot
@@ -239,4 +246,27 @@ def cluster_status(master) -> dict:
         "rateMBps": lc.rate_mbps,
         "jobStates": lc.journal.counts(),
     }
+    # judgment plane (ISSUE 13): is the cluster meeting its SLOs right
+    # now, and are the black-box canaries proving end-to-end service —
+    # the one-line health verdict cluster.status renders first
+    health: dict = {}
+    slo = getattr(master, "slo", None)
+    if slo is not None:
+        health["slo"] = slo.health_summary()
+    canary = getattr(master, "canary", None)
+    if canary is not None:
+        cs = canary.status()
+        health["canary"] = {
+            "running": cs["running"],
+            "tick": cs["tick"],
+            "byteMismatches": cs["byteMismatches"],
+            "probes": {
+                name: ("skipped" if p.get("skipped") else (
+                    "error" if any(t["result"] == "error"
+                                   for t in p.get("targets", {}).values())
+                    else "ok"))
+                for name, p in cs["probes"].items()
+            },
+        }
+    out["Health"] = health
     return out

@@ -64,6 +64,12 @@ class MasterServer:
         lifecycle_policy: dict | None = None,
         repair_deadline_s: float | None = None,  # None = env, 0 = no bound
         peer_clusters: list[str] | None = None,  # remote master http addrs
+        slo_interval: float = 0.0,    # SLO evaluation tick; 0 = on demand
+        slo_specs: list | None = None,  # None = default_specs()
+        slo_window_scale: float | None = None,  # None = env, 1.0 = real-time
+        canary_interval: float = 0.0,  # black-box probe tick; 0 disables
+        canary_s3: str = "",           # S3 gateway addr for metadata probes
+        alert_webhook: str = "",       # POST alert transitions here
     ):
         self.ip = ip
         self.port = port
@@ -158,6 +164,31 @@ class MasterServer:
         # heartbeat stats snapshots (the seaweedfs_geo_* families)
         self.peer_clusters = [p.strip() for p in (peer_clusters or [])
                               if p.strip()]
+        # judgment plane (ISSUE 13): the SLO engine evaluates burn-rate
+        # rules over family-filtered federation scrapes; the canary
+        # prober feeds it active black-box SLIs.  Both are constructed
+        # unconditionally so /cluster/alerts and the shell work on a
+        # manually driven master (engine interval 0 = evaluate-on-read;
+        # canary interval 0 = disabled).
+        from ..stats.metrics import REGISTRY as _registry
+        from ..telemetry.canary import CanaryProber
+        from ..telemetry.slo import SloEngine, WebhookSink, log_sink
+
+        from . import observability as _obs
+
+        sinks = [log_sink]
+        if alert_webhook:
+            sinks.append(WebhookSink(alert_webhook))
+        self.slo = SloEngine(
+            scrape=lambda fams: _obs.cluster_metrics(self, fams),
+            specs=slo_specs,
+            sinks=sinks,
+            interval_s=slo_interval,
+            exemplars=_registry.exemplars,
+            window_scale=slo_window_scale,
+        )
+        self.canary = CanaryProber(
+            self, interval_s=canary_interval, s3_address=canary_s3)
         self._rng = random.Random()
         # raft quorum (raft_server.go:21-46): multi-master when peers given
         self.raft = None
@@ -196,6 +227,8 @@ class MasterServer:
         if self.maintenance_interval > 0:
             threading.Thread(target=self._maintenance_loop, daemon=True).start()
         self.lifecycle.start()
+        self.slo.start()
+        self.canary.start()
         if self.is_leader():
             # journaled mass-repair jobs interrupted by a crash replay
             # as pending — resume them exactly-once from the journal
@@ -208,6 +241,8 @@ class MasterServer:
 
     def stop(self) -> None:
         self._stop.set()
+        self.canary.stop()
+        self.slo.stop()
         self.mass_repair.stop()
         self.lifecycle.stop()
         if self.raft is not None:
@@ -528,6 +563,16 @@ class MasterServer:
         self.recent_dead_nodes = (self.recent_dead_nodes + [node_id])[-8:]
         glog.warning("node %s presumed dead (seq %d)", node_id,
                      self.dead_node_seq)
+
+    def note_topology_change(self, node_id: str) -> None:
+        """A node JOINED (first heartbeat, incl. a rejoin after a
+        death): same cache-invalidation broadcast as a death, because a
+        peer's found-tier holder cache trusting the node-less map for
+        its full TTL makes degraded reads fail for minutes after the
+        holder is back."""
+        self.dead_node_seq += 1
+        glog.info("node %s joined (cache-invalidation seq %d)", node_id,
+                  self.dead_node_seq)
 
     # -- vacuum -----------------------------------------------------------
 
@@ -980,6 +1025,7 @@ _MASTER_OPS = {
     "/cluster/raft": "cluster.raft",
     "/cluster/metrics": "cluster.metrics",
     "/cluster/traces": "cluster.traces",
+    "/cluster/alerts": "cluster.alerts",
     "/cluster/lifecycle": "cluster.lifecycle",
     "/cluster/geo": "cluster.geo",
     "/vol/vacuum": "vol.vacuum", "/vol/grow": "vol.grow",
@@ -1150,15 +1196,28 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
             return
 
         if u.path == "/cluster/metrics":
+            from ..stats.metrics import parse_family_prefixes
             from . import observability
 
-            body = observability.cluster_metrics(self.master).encode()
+            try:
+                prefixes = parse_family_prefixes(qget("family"))
+            except ValueError as e:
+                return self._json(400, {"error": str(e)})
+            body = observability.cluster_metrics(
+                self.master, prefixes).encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
             return
+        if u.path == "/cluster/alerts":
+            # the judgment plane's operator surface: SLO states, active
+            # alerts (exemplar trace ids included), bounded transition
+            # history, and the canary's last probe round
+            doc = self.master.slo.status()
+            doc["canary"] = self.master.canary.status()
+            return self._json(200, doc)
         if u.path == "/cluster/lifecycle":
             # lifecycle controller status: policies, journal, job states
             return self._json(200, self.master.lifecycle.status())

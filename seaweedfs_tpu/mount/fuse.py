@@ -408,6 +408,28 @@ class FuseMount:
         )
         if self._thread:
             self._thread.join(timeout=10)
+        _restore_sigpipe()
+
+
+def _restore_sigpipe() -> None:
+    """Re-ignore SIGPIPE after a fuse session ends.
+
+    libfuse's fuse_main teardown (fuse_remove_signal_handlers) resets
+    SIGPIPE to SIG_DFL at the C level — invisible to signal.getsignal,
+    which still reports Python's SIG_IGN — so the NEXT write to a
+    half-closed socket anywhere in the process dies of SIGPIPE instead
+    of raising BrokenPipeError.  Observed as the whole test process
+    (and it would be a whole combined `weed server`) silently exiting
+    141 on a keep-alive socket long after an unmount."""
+    import signal
+
+    try:
+        signal.signal(signal.SIGPIPE, signal.SIG_IGN)
+    except ValueError:
+        # not the main thread: leave it — the interpreter forbids
+        # handler changes here, and the caller's thread context is rare
+        # (stop() is invoked from main in every current call site)
+        pass
 
 
 def _fill_stat(st: Stat, attrs: dict) -> None:

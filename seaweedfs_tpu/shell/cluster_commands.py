@@ -58,6 +58,28 @@ def cluster_status(env: CommandEnv, args: list[str]) -> str:
         lines.append(
             f"filer ring: {len(ring)} shard(s) version={ring.version()} "
             f"vnodes={ring.vnodes}/node (details: filer.ring)")
+    health = doc.get("Health") or {}
+    slo = health.get("slo") or {}
+    canary = health.get("canary") or {}
+    if slo or canary:
+        firing = slo.get("firing") or []
+        pending = slo.get("pending") or []
+        verdict = ("FIRING: " + ", ".join(firing) if firing
+                   else "pending: " + ", ".join(pending) if pending
+                   else "ok")
+        lines.append(
+            f"health: {verdict} ({slo.get('specs', 0)} SLOs, "
+            f"engine {'on' if slo.get('evaluating') else 'on-demand'}; "
+            "details: cluster.alerts)")
+        if canary:
+            probes = canary.get("probes") or {}
+            rendered = " ".join(
+                f"{name}={state}" for name, state in sorted(probes.items()))
+            lines.append(
+                f"canary: {'running' if canary.get('running') else 'off'} "
+                f"tick={canary.get('tick', 0)} "
+                f"byteMismatches={canary.get('byteMismatches', 0)}"
+                + (f" {rendered}" if rendered else ""))
     snaps = doc.get("StatsSnapshots", {})
     if snaps:
         lines.append(f"stats snapshots ({len(snaps)}):")
@@ -142,6 +164,66 @@ def filer_ring(env: CommandEnv, args: list[str]) -> str:
                 + (f"/{quota_b}" if quota_b else "")
                 + (f", weight={conf['weight']}" if "weight" in conf
                    else ""))
+    return "\n".join(lines)
+
+
+@register("cluster.alerts")
+def cluster_alerts(env: CommandEnv, args: list[str]) -> str:
+    """cluster.alerts [-json]  — SLO states, active alerts (with
+    exemplar trace ids), recent transitions, canary probe results from
+    the master's /cluster/alerts."""
+    addr = _master_http(env)
+    with connpool.request(
+            "GET", f"http://{addr}/cluster/alerts", timeout=10) as r:
+        doc = json.loads(r.read())
+    if "-json" in args:
+        return json.dumps(doc, indent=2, sort_keys=True)
+    lines = []
+    states = doc.get("states", {})
+    active = doc.get("alerts", [])
+    lines.append(f"SLOs ({len(states)}):")
+    for name in sorted(states):
+        st = states[name]
+        lines.append(
+            f"  {name} [{st.get('severity')}] {st.get('state')} "
+            f"for {st.get('sinceS', 0):.0f}s")
+    if active:
+        lines.append(f"active alerts ({len(active)}):")
+        for a in active:
+            lines.append(
+                f"  {a['slo']} [{a['severity']}] {a['state']} "
+                f"burn={a.get('burnShort', 0):.2f}/"
+                f"{a.get('burnLong', 0):.2f}"
+                + (f" value={a['value']}" if "value" in a else ""))
+            for ex in a.get("exemplars", ()):
+                lines.append(
+                    f"    exemplar trace {ex['traceId']} "
+                    f"({ex['seconds'] * 1e3:.1f}ms, le={ex['le']}) -> "
+                    f"http://{addr}{ex['traceQuery']}")
+    else:
+        lines.append("active alerts: none")
+    hist = doc.get("history", [])
+    if hist:
+        lines.append(f"recent transitions ({len(hist)}):")
+        for h in hist[-8:]:
+            lines.append(
+                f"  {h['slo']} {h.get('from', '?')} -> {h['state']}")
+    canary = doc.get("canary", {})
+    lines.append(
+        f"canary: {'running' if canary.get('running') else 'off'} "
+        f"interval={canary.get('interval_s', 0)}s "
+        f"tick={canary.get('tick', 0)} "
+        f"byteMismatches={canary.get('byteMismatches', 0)}")
+    for name in sorted(canary.get("probes", {})):
+        p = canary["probes"][name]
+        if p.get("skipped"):
+            lines.append(f"  {name}: skipped ({p['skipped']})")
+            continue
+        for target in sorted(p.get("targets", {})):
+            t = p["targets"][target]
+            lines.append(
+                f"  {name} {target}: {t['result']}"
+                + (f" ({t['error']})" if t.get("error") else ""))
     return "\n".join(lines)
 
 

@@ -10,6 +10,8 @@ protocol worth owning.
 
 from __future__ import annotations
 
+import os
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -19,6 +21,36 @@ _DEFAULT_BUCKETS = (
     0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
+
+# exemplar rotation window: each histogram bucket remembers the SLOWEST
+# recent observation's trace id for this long before a smaller sample may
+# replace it — long enough for an alert evaluation tick to pick it up,
+# short enough that a page links to the incident, not last week's spike
+EXEMPLAR_WINDOW_S = float(
+    os.environ.get("SEAWEEDFS_TPU_EXEMPLAR_WINDOW_S", "60"))
+
+_FAMILY_RE = re.compile(r"^[A-Za-z_:][A-Za-z0-9_:]*$")
+
+
+def parse_family_prefixes(raw: str) -> list[str] | None:
+    """Validated `?family=<prefix>[,<prefix>...]` filter shared by every
+    /metrics endpoint and the master's /cluster/metrics.  Empty -> None
+    (no filter); malformed -> ValueError with an operator-readable
+    message (a typo'd filter silently matching nothing would read as
+    'cluster emits no metrics' mid-incident)."""
+    raw = (raw or "").strip()
+    if not raw:
+        return None
+    prefixes = [p.strip() for p in raw.split(",") if p.strip()]
+    if not prefixes:
+        return None
+    if len(prefixes) > 16:
+        raise ValueError("family: at most 16 comma-separated prefixes")
+    for p in prefixes:
+        if not _FAMILY_RE.match(p):
+            raise ValueError(
+                f"family prefix {p!r} must match [A-Za-z_:][A-Za-z0-9_:]*")
+    return prefixes
 
 
 def escape_label_value(v: str) -> str:
@@ -126,22 +158,37 @@ class Gauge(Counter):
 
 
 class _HistogramChild:
-    __slots__ = ("buckets", "counts", "total", "count", "_lock")
+    __slots__ = ("buckets", "counts", "total", "count", "exemplars",
+                 "_lock")
 
     def __init__(self, buckets):
         self.buckets = buckets
         self.counts = [0] * len(buckets)
         self.total = 0.0
         self.count = 0
+        # bucket index (len(buckets) = +Inf) -> [value, trace_id, wall_ts]
+        # of the slowest observation in the current exemplar window
+        self.exemplars: dict[int, list] = {}
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: str | None = None) -> None:
         with self._lock:
             self.total += v
             self.count += 1
+            idx = len(self.buckets)
             for i, b in enumerate(self.buckets):
                 if v <= b:
                     self.counts[i] += 1
+                    idx = min(idx, i)
+            if trace_id:
+                cur = self.exemplars.get(idx)
+                now = time.time()
+                # keep the slowest sample per bucket, but let it rotate:
+                # a stale all-time max would pin a page's exemplar to an
+                # incident long resolved
+                if (cur is None or v >= cur[0]
+                        or now - cur[2] > EXEMPLAR_WINDOW_S):
+                    self.exemplars[idx] = [v, trace_id, now]
 
     def time(self):
         return _Timer(self)
@@ -170,8 +217,36 @@ class Histogram(Metric):
     def _make_child(self):
         return _HistogramChild(self.buckets)
 
-    def observe(self, v: float) -> None:
-        self.labels().observe(v)
+    def observe(self, v: float, trace_id: str | None = None) -> None:
+        self.labels().observe(v, trace_id=trace_id)
+
+    def exemplars(self) -> list[dict]:
+        """Per-bucket slowest-sample exemplars across every child:
+        [{labels, le, value, traceId, ageSeconds}], newest-window data
+        only (entries older than 2x the window are dropped — the alert
+        that wants them has already evaluated)."""
+        now = time.time()
+        with self._lock:
+            items = list(self._children.items())
+        out: list[dict] = []
+        for key, child in items:
+            with child._lock:
+                entries = [(i, list(e)) for i, e in child.exemplars.items()]
+            for idx, (value, trace_id, ts) in entries:
+                age = now - ts
+                if age > 2 * EXEMPLAR_WINDOW_S:
+                    continue
+                le = (format_le(self.buckets[idx])
+                      if idx < len(self.buckets) else "+Inf")
+                out.append({
+                    "family": self.name,
+                    "labels": dict(zip(self.label_names, key)),
+                    "le": le,
+                    "value": round(value, 6),
+                    "traceId": trace_id,
+                    "ageSeconds": round(age, 3),
+                })
+        return out
 
     def render(self) -> list[str]:
         out = [f"# HELP {self.name} {self.help}",
@@ -253,13 +328,35 @@ class Registry:
                         return m
         return None
 
-    def render(self) -> str:
+    def render(self, family_prefixes: "list[str] | None" = None) -> str:
+        """Text exposition; `family_prefixes` (from ?family=) restricts
+        the output to families whose name starts with any prefix — the
+        SLO engine and operators scrape a subset instead of the full
+        exposition on every evaluation tick."""
         with self._lock:
             metrics = list(self._metrics.values())
+        if family_prefixes is not None:
+            metrics = [m for m in metrics
+                       if any(m.name.startswith(p) for p in family_prefixes)]
         lines: list[str] = []
         for m in metrics:
             lines.extend(m.render())
         return "\n".join(lines) + "\n"
+
+    def exemplars(self, family_prefix: str = "") -> list[dict]:
+        """Histogram exemplars (slowest recent sample per bucket) for
+        families matching the prefix, slowest first — the trace ids a
+        firing latency alert embeds so /cluster/alerts links straight to
+        /cluster/traces."""
+        with self._lock:
+            metrics = [m for m in self._metrics.values()
+                       if m.kind == "histogram"
+                       and m.name.startswith(family_prefix)]
+        out: list[dict] = []
+        for m in metrics:
+            out.extend(m.exemplars())
+        out.sort(key=lambda e: e["value"], reverse=True)
+        return out[:32]
 
     def snapshot_samples(self, max_samples: int = 512) -> list:
         """-> [(exposition sample name incl. labels, float value)] for
@@ -746,6 +843,56 @@ GRPC_BYTES = REGISTRY.counter(
     labels=("type", "op", "direction"),  # rx | tx
 )
 
+# -- SLO engine + synthetic canary plane (telemetry/slo.py, canary.py,
+# ISSUE 13) -----------------------------------------------------------------
+# the master-resident judgment layer: declarative SLO specs evaluated as
+# multi-window multi-burn-rate rules over federated counter deltas, fed
+# by a black-box canary prober (write/read/delete round trips, EC
+# degraded-read, filer/S3 routed PUT/GET, geo sentinel) so "process up
+# but serving garbage or slow" pages.
+
+SLO_BURN_RATE = REGISTRY.gauge(
+    "seaweedfs_slo_burn_rate",
+    "error-budget burn rate per SLO and evaluation window (1.0 = "
+    "burning exactly the budget; the page tier fires at its factor in "
+    "BOTH windows)",
+    labels=("slo", "window"),  # short | long
+)
+SLO_ALERT_STATE = REGISTRY.gauge(
+    "seaweedfs_slo_alert_state",
+    "per-SLO alert state (0 ok, 1 pending, 2 firing)",
+    labels=("slo", "severity"),  # page | warn
+)
+SLO_TRANSITIONS = REGISTRY.counter(
+    "seaweedfs_slo_alert_transitions_total",
+    "alert state-machine transitions by SLO and target state",
+    labels=("slo", "to"),  # pending | firing | resolved
+)
+SLO_EVAL_SECONDS = REGISTRY.histogram(
+    "seaweedfs_slo_eval_seconds",
+    "wall time per SLO engine evaluation tick (scrape + rule pass)",
+)
+CANARY_PROBE_TOTAL = REGISTRY.counter(
+    "seaweedfs_canary_probe_total",
+    "synthetic canary probes by probe kind and outcome; `error` counts "
+    "failed or byte-divergent round trips, `skipped` counts probes with "
+    "no eligible target",
+    labels=("probe", "result"),  # ok | error | skipped
+)
+CANARY_PROBE_SECONDS = REGISTRY.histogram(
+    "seaweedfs_canary_probe_seconds",
+    "end-to-end canary probe latency (the black-box SLI the latency "
+    "SLOs judge)",
+    labels=("probe",),
+)
+CANARY_STALENESS = REGISTRY.gauge(
+    "seaweedfs_canary_staleness_seconds",
+    "seconds since a probe kind last fully succeeded (for the geo "
+    "sentinel: age of the sentinel payload observed on the remote "
+    "cluster)",
+    labels=("probe",),
+)
+
 
 def serve_metrics(port: int, registry: Registry = REGISTRY,
                   host: str = "0.0.0.0") -> ThreadingHTTPServer:
@@ -758,6 +905,8 @@ def serve_metrics(port: int, registry: Registry = REGISTRY,
             pass
 
         def do_GET(self):
+            import urllib.parse
+
             path = self.path.split("?")[0]
             if path.startswith("/debug/"):
                 from ..telemetry import serve_debug_http
@@ -769,7 +918,20 @@ def serve_metrics(port: int, registry: Registry = REGISTRY,
                 self.send_header("Content-Length", "0")
                 self.end_headers()
                 return
-            body = registry.render().encode()
+            query = urllib.parse.parse_qs(
+                urllib.parse.urlparse(self.path).query)
+            try:
+                prefixes = parse_family_prefixes(
+                    query.get("family", [""])[0])
+            except ValueError as e:
+                body = str(e).encode()
+                self.send_response(400)
+                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            body = registry.render(prefixes).encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(body)))

@@ -381,6 +381,63 @@ class EcVolume:
             )
         return n
 
+    def first_live_needle(self) -> "int | None":
+        """First non-tombstoned needle id in the .ecx, or None — the
+        canary's probe target (any live needle exercises the same
+        locate + interval + decode machinery)."""
+        esz = t.NEEDLE_MAP_ENTRY_SIZE
+        chunk = (1 << 16) // esz * esz
+        at = 0
+        while at < self.ecx_size:
+            blob = os.pread(self._ecx.fileno(),
+                            min(chunk, self.ecx_size - at), at)
+            if not blob:
+                break
+            for key, _offset, size in idx_mod.walk_index_blob(blob):
+                if not t.size_is_deleted(size):
+                    return key
+            at += len(blob) - (len(blob) % esz)
+            if len(blob) < esz:
+                break
+        return None
+
+    def canary_read(self, drop_shard: "int | None" = None) -> dict:
+        """Degraded-read canary: read one live needle with the FIRST
+        locally held interval forced through the reconstruct path (as if
+        its shard were lost), all other intervals read normally.  The
+        needle CRC check in `Needle.from_bytes` is the byte-identity
+        gate — a decode-path regression fails loudly here before a real
+        shard loss finds it.  Bypasses the interval cache/single-flight
+        (`_gather_and_decode` directly) so every probe pays a real
+        gather + decode."""
+        nid = self.first_live_needle()
+        if nid is None:
+            raise NotFoundError(
+                f"ec volume {self.volume_id}: no live needle to probe")
+        _offset, size, intervals = self.locate(nid)
+        if t.size_is_deleted(size):
+            raise NotFoundError(f"needle {nid:x} deleted")
+        parts: list[bytes] = []
+        dropped = None
+        for iv in intervals:
+            sid, off = iv.to_shard_id_and_offset(
+                self.large_block_size, self.small_block_size)
+            droppable = (sid in self.shards
+                         and (drop_shard is None or sid == drop_shard))
+            if droppable and dropped is None:
+                parts.append(
+                    self._gather_and_decode(sid, off, iv.size)[0])
+                dropped = sid
+            else:
+                parts.append(self._read_interval(iv))
+        n = Needle.from_bytes(b"".join(parts), self.version)
+        if n.id != nid:
+            raise IOError(
+                f"canary read id mismatch: want {nid:x} got {n.id:x}")
+        return {"needleId": f"{nid:x}", "droppedShard": dropped,
+                "bytes": len(bytes(n.data)),
+                "reconstructed": dropped is not None}
+
     def _reread_corrupt(self, intervals, parts) -> Needle:
         """Corruption failover for EC reads: reconstruct every interval
         from sibling shards instead of trusting the local bytes.  The

@@ -111,14 +111,27 @@ def _family_of(sample_name: str, families: dict) -> str:
 
 
 class FederatedExposition:
-    """Accumulates per-node expositions/snapshots into one rendering."""
+    """Accumulates per-node expositions/snapshots into one rendering.
 
-    def __init__(self):
+    `family_prefixes` (the /cluster/metrics ?family= filter) drops
+    non-matching families at merge time; the federation meta-families
+    (up/stale/age/scrape) always render, so a filtered scrape still
+    shows which nodes answered."""
+
+    def __init__(self, family_prefixes: "list[str] | None" = None):
         self._families: dict[str, tuple[str, str]] = dict(_META_FAMILIES)
+        self._prefixes = family_prefixes
         # family -> [rendered sample line]; insertion order = output order
         self._samples: dict[str, list[str]] = {}
 
+    def _wanted(self, family: str) -> bool:
+        if self._prefixes is None or family in _META_FAMILIES:
+            return True
+        return any(family.startswith(p) for p in self._prefixes)
+
     def _add_sample(self, family: str, line: str) -> None:
+        if not self._wanted(family):
+            return
         self._samples.setdefault(family, []).append(line)
 
     def _meta(self, name: str, node: dict, value) -> None:

@@ -17,15 +17,16 @@ timed, filer counted but never timed, volume did both by hand).
 
 from __future__ import annotations
 
-import os
 from contextlib import contextmanager
 
 from ..stats.metrics import REQUEST_COUNTER, REQUEST_HISTOGRAM
 from ..util import glog
 from . import trace
 
-SLOW_REQUEST_SECONDS = float(
-    os.environ.get("SEAWEEDFS_TPU_SLOW_REQUEST_S", "1.0"))
+# one threshold for the slow-request log AND the tracer's important-span
+# retention ring (defined in trace.py so the tracer needs no import from
+# here)
+SLOW_REQUEST_SECONDS = trace.SLOW_SPAN_SECONDS
 
 DEBUG_TRACES_PATH = "/debug/traces"
 DEBUG_FAULTS_PATH = "/debug/faults"
@@ -46,7 +47,10 @@ def record_op(server_type: str, op: str, **attrs):
             yield span
     finally:
         if span is not None:
-            hist.observe(span.duration)
+            # the span's trace id rides along as the histogram exemplar:
+            # the slowest sample per bucket window keeps its trace id, so
+            # a firing latency alert links straight to a timeline
+            hist.observe(span.duration, trace_id=span.trace_id)
             if span.duration >= SLOW_REQUEST_SECONDS:
                 glog.warning(
                     "slow request %s.%s took %.3fs trace=%s",
@@ -132,9 +136,17 @@ def serve_debug_http(handler, path: str) -> bool:
             return True
         body, ctype = debug_traces_body(limit, trace_id), "application/json"
     elif path == METRICS_PATH:
-        from ..stats.metrics import REGISTRY
+        from ..stats.metrics import REGISTRY, parse_family_prefixes
 
-        body, ctype = REGISTRY.render().encode(), "text/plain; version=0.0.4"
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlparse(handler.path).query)
+        try:
+            prefixes = parse_family_prefixes(query.get("family", [""])[0])
+        except ValueError as e:
+            _send_error(handler, 400, str(e))
+            return True
+        body, ctype = (REGISTRY.render(prefixes).encode(),
+                       "text/plain; version=0.0.4")
     elif path == DEBUG_PROFILE_PATH:
         from ..util import profiler
         from ..util.grace import profile_status

@@ -38,6 +38,18 @@ from ..util import glog
 # ring capacity: finished spans kept in memory per process
 MAX_SPANS = int(os.environ.get("SEAWEEDFS_TPU_TRACE_BUFFER", "2048"))
 
+# separate bounded ring for spans an alert will want: error-status and
+# slow spans.  Without it a burst of healthy traffic evicts the one
+# trace a firing alert's exemplar points at before anyone looks — the
+# page would link to an empty timeline.
+MAX_IMPORTANT_SPANS = int(
+    os.environ.get("SEAWEEDFS_TPU_TRACE_IMPORTANT_BUFFER", "512"))
+
+# slow-span retention threshold; same knob the middleware's slow-request
+# log uses (middleware imports this binding — one source of truth)
+SLOW_SPAN_SECONDS = float(
+    os.environ.get("SEAWEEDFS_TPU_SLOW_REQUEST_S", "1.0"))
+
 _ctx = threading.local()  # _ctx.stack: list[(trace_id, span_id)]
 
 # ids need uniqueness, not unpredictability: os.urandom costs a syscall
@@ -76,23 +88,41 @@ class Span:
 
 
 class Tracer:
-    """Bounded recorder of finished spans, grouped on read by trace id."""
+    """Bounded recorder of finished spans, grouped on read by trace id.
 
-    def __init__(self, max_spans: int = MAX_SPANS):
+    Two rings: the main ring holds everything; error-status and slow
+    spans are ALSO retained in a separate bounded ring, so a burst of
+    healthy traffic cannot evict the trace an alert needs before an
+    operator follows the exemplar link."""
+
+    def __init__(self, max_spans: int = MAX_SPANS,
+                 max_important: int = MAX_IMPORTANT_SPANS):
         self._spans: deque[Span] = deque(maxlen=max_spans)
+        self._important: deque[Span] = deque(maxlen=max_important)
         self._lock = threading.Lock()
 
     def record(self, span: Span) -> None:
         with self._lock:
             self._spans.append(span)
+            if span.status != "ok" or span.duration >= SLOW_SPAN_SECONDS:
+                self._important.append(span)
 
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
+            self._important.clear()
 
     def spans(self) -> list[Span]:
+        """Main + important rings, deduplicated (a span recent enough to
+        still sit in the main ring appears once)."""
         with self._lock:
-            return list(self._spans)
+            main = list(self._spans)
+            important = list(self._important)
+        seen = {(s.trace_id, s.span_id) for s in main}
+        merged = [s for s in important
+                  if (s.trace_id, s.span_id) not in seen]
+        merged.extend(main)
+        return merged
 
     def recent_traces(self, limit: int = 50,
                       trace_id: str | None = None) -> list[dict]:

@@ -104,12 +104,15 @@ class Topology:
 
     # -- membership -------------------------------------------------------
 
-    def register_node(self, node: DataNode) -> DataNode:
+    def register_node(self, node: DataNode) -> "tuple[DataNode, bool]":
+        """-> (node, was_new).  `was_new` is decided under the SAME lock
+        acquisition that registers, so two concurrent streams for one
+        node id can never both observe a join."""
         with self.lock:
             existing = self.nodes.get(node.id)
             if existing is None:
                 self.nodes[node.id] = node
-                return node
+                return node, True
             existing.last_seen = time.monotonic()
             existing.public_url = node.public_url
             existing.grpc_address = node.grpc_address
@@ -121,7 +124,7 @@ class Topology:
                 existing.max_volumes = node.max_volumes
             if node.max_volume_counts:
                 existing.max_volume_counts = dict(node.max_volume_counts)
-            return existing
+            return existing, False
 
     def unregister_node(self, node_id: str) -> list[int]:
         """Remove a node; returns vids whose locations changed."""

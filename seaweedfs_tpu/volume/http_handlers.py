@@ -98,6 +98,43 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
             return
         if path.path == "/debug/scrub":
             return self._send_json(200, self.volume_server.scrubber.status())
+        if path.path == "/debug/canary/ec":
+            # black-box degraded-read probe: read a live needle with one
+            # locally held shard forced through the reconstruct path, CRC
+            # (= byte identity) checked.  The master's canary prober
+            # drives this so "EC decode broken" pages before a real
+            # shard loss discovers it.
+            q = urllib.parse.parse_qs(path.query)
+            try:
+                vid = int(q.get("volume", [""])[0])
+                drop = q.get("shard", [""])[0]
+                drop_shard = int(drop) if drop else None
+            except ValueError:
+                return self._send_json(
+                    400, {"error": "volume=<int> required; shard=<int>"})
+            ev = self.store.find_ec_volume(vid)
+            if ev is None:
+                return self._send_json(
+                    404, {"ok": False,
+                          "error": f"ec volume {vid} not here"})
+            t0 = time.perf_counter()
+            try:
+                res = ev.canary_read(drop_shard=drop_shard)
+            except KeyError as e:
+                # no live needle (empty or fully tombstoned volume):
+                # nothing to probe is not a serving failure
+                return self._send_json(
+                    200, {"ok": False, "empty": True,
+                          "error": str(e)[:300]})
+            except Exception as e:  # noqa: BLE001 — probe answer, not a crash
+                return self._send_json(
+                    500, {"ok": False, "error": str(e)[:300]})
+            return self._send_json(200, {
+                "ok": True,
+                "reconstructMs": round(
+                    (time.perf_counter() - t0) * 1e3, 3),
+                **res,
+            })
         if path.path in ("/ui", "/ui/", "/ui/index.html"):
             from ..util.ui import render_status_page
 

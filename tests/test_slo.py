@@ -1,0 +1,613 @@
+"""Unit tests for the SLO engine + canary plane (ISSUE 13).
+
+Covers: burn-rate arithmetic over windowed counter deltas for all three
+SLI kinds, the pending/firing/resolved state machine, sinks, exemplar
+linking, the tracer's important-span retention ring, the validated
+?family= exposition filter, and a live in-process canary round trip
+(byte identity + failure detection + the EC drop-shard probe).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from seaweedfs_tpu.stats.metrics import Registry, parse_family_prefixes
+from seaweedfs_tpu.telemetry.slo import (
+    FIRING,
+    OK,
+    PENDING,
+    BurnWindow,
+    SloEngine,
+    SloSpec,
+    WebhookSink,
+    sample_labels,
+    spec_from_dict,
+)
+
+
+def _scrape_of(state: dict):
+    """scrape(families) closure over a mutable {sample_name: value}."""
+
+    def scrape(_families):
+        return "\n".join(f"{k} {v}" for k, v in state.items()) + "\n"
+
+    return scrape
+
+
+def _engine(state, spec, clock, sinks=None, exemplars=None):
+    return SloEngine(
+        _scrape_of(state), specs=[spec], sinks=sinks or [],
+        interval_s=0.0, window_scale=1.0,
+        now=lambda: clock["t"], exemplars=exemplars)
+
+
+RATIO_SPEC = dict(
+    name="avail", severity="page", kind="ratio",
+    bad_family="probe_total", bad_labels={"result": "error"},
+    total_family="probe_total",
+    total_labels={"result": ("ok", "error")},
+    objective=0.99, window=BurnWindow(10.0, 60.0, 2.0),
+)
+
+
+def test_sample_labels_parses_escapes():
+    name, labels = sample_labels(
+        'x_total{a="b",path="q\\"uote",n="l\\nf"}')
+    assert name == "x_total"
+    assert labels == {"a": "b", "path": 'q"uote', "n": "l\nf"}
+    assert sample_labels("plain") == ("plain", {})
+
+
+def test_ratio_spec_fires_and_resolves():
+    clock = {"t": 1000.0}
+    state = {'probe_total{result="ok"}': 100.0,
+             'probe_total{result="error"}': 0.0}
+    transitions = []
+    eng = _engine(state, SloSpec(**RATIO_SPEC), clock,
+                  sinks=[transitions.append])
+    eng.evaluate()  # baseline
+    clock["t"] += 5
+    state['probe_total{result="ok"}'] += 10
+    assert eng.evaluate() == []  # clean traffic: ok
+    # 50% of traffic failing: burn = 0.5/0.01 = 50 >> 2 in both windows
+    for _ in range(3):
+        clock["t"] += 3
+        state['probe_total{result="ok"}'] += 5
+        state['probe_total{result="error"}'] += 5
+        eng.evaluate()
+    st = eng.status(evaluate_if_idle=False)
+    assert st["states"]["avail"]["state"] == FIRING
+    assert any(t["state"] == FIRING for t in transitions)
+    alert = st["alerts"][0]
+    assert alert["burnShort"] > 2 and alert["burnLong"] > 2
+    # clean traffic again: once the SHORT window (10s) has rolled past
+    # the burst, the alert resolves even though the long window is dirty
+    for _ in range(6):
+        clock["t"] += 3
+        state['probe_total{result="ok"}'] += 10
+        eng.evaluate()
+    st = eng.status(evaluate_if_idle=False)
+    assert st["states"]["avail"]["state"] == OK
+    assert any(t["state"] == OK and t.get("from") == FIRING
+               for t in transitions)
+
+
+def test_ratio_pending_when_only_short_window_burns():
+    clock = {"t": 0.0}
+    state = {'probe_total{result="ok"}': 1000.0,
+             'probe_total{result="error"}': 0.0}
+    eng = _engine(state, SloSpec(**RATIO_SPEC), clock)
+    eng.evaluate()
+    # long clean history first, so the long window dilutes the burst
+    for _ in range(20):
+        clock["t"] += 5
+        state['probe_total{result="ok"}'] += 100
+        eng.evaluate()
+    # short sharp burst: dominates the 10s window, diluted in the 60s
+    clock["t"] += 5
+    state['probe_total{result="error"}'] += 10
+    state['probe_total{result="ok"}'] += 90
+    eng.evaluate()
+    assert eng.status(evaluate_if_idle=False)["states"]["avail"][
+        "state"] == PENDING
+
+
+def test_counter_reset_does_not_go_negative():
+    clock = {"t": 0.0}
+    state = {'probe_total{result="ok"}': 500.0,
+             'probe_total{result="error"}': 20.0}
+    eng = _engine(state, SloSpec(**RATIO_SPEC), clock)
+    eng.evaluate()
+    # node restart: counters reset below the baseline
+    clock["t"] += 5
+    state['probe_total{result="ok"}'] = 10.0
+    state['probe_total{result="error"}'] = 0.0
+    eng.evaluate()
+    st = eng.status(evaluate_if_idle=False)
+    assert st["states"]["avail"]["state"] == OK
+
+
+def test_latency_spec_from_bucket_deltas():
+    clock = {"t": 0.0}
+    state = {
+        'req_seconds_bucket{type="volumeServer",op="get",le="0.5"}': 100.0,
+        'req_seconds_bucket{type="volumeServer",op="get",le="+Inf"}': 100.0,
+        'req_seconds_count{type="volumeServer",op="get"}': 100.0,
+    }
+    spec = SloSpec(
+        name="read-p99", severity="page", kind="latency",
+        family="req_seconds",
+        labels={"type": "volumeServer", "op": "get"},
+        threshold_s=0.5, objective=0.99,
+        window=BurnWindow(10.0, 60.0, 2.0))
+    eng = _engine(state, spec, clock)
+    eng.evaluate()
+    # 90 of 100 new requests above the 0.5s bucket: burn = 0.9/0.01
+    clock["t"] += 5
+    state['req_seconds_bucket{type="volumeServer",op="get",le="0.5"}'] += 10
+    state['req_seconds_bucket{type="volumeServer",op="get",le="+Inf"}'] += 100
+    state['req_seconds_count{type="volumeServer",op="get"}'] += 100
+    eng.evaluate()
+    st = eng.status(evaluate_if_idle=False)
+    assert st["states"]["read-p99"]["state"] == FIRING
+    assert st["alerts"][0]["burnShort"] == pytest.approx(90.0)
+
+
+def test_gauge_spec_pending_for_then_firing_then_resolved():
+    clock = {"t": 0.0}
+    state = {"queue_depth": 0.0}
+    transitions = []
+    spec = SloSpec(
+        name="backlog", severity="warn", kind="gauge",
+        family="queue_depth", threshold=1.0, for_s=10.0,
+        window=BurnWindow(10.0, 60.0, 1.0))
+    eng = _engine(state, spec, clock, sinks=[transitions.append])
+    eng.evaluate()
+    assert eng.status(evaluate_if_idle=False)["states"]["backlog"][
+        "state"] == OK
+    state["queue_depth"] = 3.0
+    clock["t"] += 1
+    eng.evaluate()
+    assert eng.status(evaluate_if_idle=False)["states"]["backlog"][
+        "state"] == PENDING
+    clock["t"] += 11  # held above threshold past for_s
+    eng.evaluate()
+    st = eng.status(evaluate_if_idle=False)
+    assert st["states"]["backlog"]["state"] == FIRING
+    assert st["alerts"][0]["value"] == 3.0
+    state["queue_depth"] = 0.0
+    clock["t"] += 1
+    eng.evaluate()
+    assert eng.status(evaluate_if_idle=False)["states"]["backlog"][
+        "state"] == OK
+    assert [t["state"] for t in transitions] == [PENDING, FIRING, OK]
+
+
+def test_event_spec_counts_window_delta_and_rolls_off():
+    """An `event` spec fires on a counter increment even when the
+    underlying gauge would already have drained, and resolves once the
+    short window rolls past the burst."""
+    clock = {"t": 0.0}
+    state = {'exposed_total{exposure="1"}': 0.0}
+    spec = SloSpec(name="exposure", severity="page", kind="event",
+                   family="exposed_total", threshold=1.0, for_s=0.0,
+                   window=BurnWindow(10.0, 60.0, 1.0))
+    eng = _engine(state, spec, clock)
+    eng.evaluate()
+    # 3 volumes drop below redundancy; the repair drains them instantly
+    # (no gauge would ever read non-zero at a tick boundary)
+    clock["t"] += 2
+    state['exposed_total{exposure="1"}'] += 3
+    eng.evaluate()
+    st = eng.status(evaluate_if_idle=False)
+    assert st["states"]["exposure"]["state"] == FIRING
+    assert st["alerts"][0]["value"] == 3.0
+    # no new events: resolved once the 10s short window rolls past
+    clock["t"] += 11
+    eng.evaluate()
+    assert eng.status(evaluate_if_idle=False)["states"]["exposure"][
+        "state"] == OK
+
+
+def test_gauge_label_filter_and_max_across_instances():
+    clock = {"t": 0.0}
+    state = {
+        'lag_seconds{instance="a",link="x"}': 5.0,
+        'lag_seconds{instance="b",link="y"}': 80.0,
+        'other_seconds{instance="a"}': 500.0,
+    }
+    spec = SloSpec(name="lag", severity="warn", kind="gauge",
+                   family="lag_seconds", threshold=60.0, for_s=0.0,
+                   window=BurnWindow(10.0, 60.0, 1.0))
+    eng = _engine(state, spec, clock)
+    eng.evaluate()
+    st = eng.status(evaluate_if_idle=False)
+    assert st["states"]["lag"]["state"] == FIRING
+    assert st["alerts"][0]["value"] == 80.0
+
+
+def test_firing_alert_embeds_exemplar_trace_ids():
+    r = Registry()
+    hist = r.histogram("t13_probe_seconds", "x", labels=("probe",))
+    hist.labels("volume_rt").observe(0.4, trace_id="ab" * 16)
+    hist.labels("volume_rt").observe(0.1, trace_id="cd" * 16)
+    clock = {"t": 0.0}
+    state = {'probe_total{result="ok"}': 10.0,
+             'probe_total{result="error"}': 0.0}
+    spec = SloSpec(**{**RATIO_SPEC,
+                      "exemplar_family": "t13_probe_seconds"})
+    eng = _engine(state, spec, clock, exemplars=r.exemplars)
+    eng.evaluate()
+    clock["t"] += 5
+    state['probe_total{result="error"}'] += 10
+    transitions = eng.evaluate()
+    assert transitions and transitions[0]["state"] == FIRING
+    ex = transitions[0]["exemplars"]
+    # slowest sample first, with a ready-made trace query link
+    assert ex[0]["traceId"] == "ab" * 16
+    assert ex[0]["traceQuery"].endswith("ab" * 16)
+
+
+def test_histogram_exemplar_keeps_slowest_and_rotates(monkeypatch):
+    r = Registry()
+    hist = r.histogram("t13_rot_seconds", "x")
+    hist.observe(0.3, trace_id="aa" * 16)
+    hist.observe(0.26, trace_id="bb" * 16)  # same bucket, smaller: not kept
+    ex = r.exemplars("t13_rot_seconds")
+    assert [e["traceId"] for e in ex] == ["aa" * 16]
+    # age the entry past the window: a smaller sample may replace it
+    child = hist.labels()
+    for entry in child.exemplars.values():
+        entry[2] -= 10_000
+    hist.observe(0.25, trace_id="cc" * 16)
+    assert "cc" * 16 in {e["traceId"]
+                         for e in r.exemplars("t13_rot_seconds")}
+
+
+def test_webhook_sink_posts_alert_json():
+    received = []
+
+    class Hook(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = self.rfile.read(
+                int(self.headers.get("Content-Length") or 0))
+            received.append(json.loads(body))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Hook)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        sink = WebhookSink(
+            f"http://127.0.0.1:{httpd.server_address[1]}/alert")
+        sink({"slo": "avail", "state": "firing", "severity": "page"})
+        deadline = time.time() + 5
+        while time.time() < deadline and not received:
+            time.sleep(0.02)
+        assert received and received[0]["slo"] == "avail"
+        # a dead webhook must not raise into the engine
+        WebhookSink("http://127.0.0.1:9/alert", timeout_s=0.2)(
+            {"slo": "x", "state": "firing", "severity": "page"})
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_spec_from_dict_with_window_override():
+    spec = spec_from_dict({
+        "name": "x", "severity": "warn", "kind": "gauge",
+        "family": "f", "threshold": 2.0,
+        "window": {"shortS": 5, "longS": 25, "factor": 3},
+    })
+    w = spec.burn_window()
+    assert (w.short_s, w.long_s, w.factor) == (5.0, 25.0, 3.0)
+
+
+def test_alert_history_is_bounded():
+    clock = {"t": 0.0}
+    state = {"queue_depth": 0.0}
+    spec = SloSpec(name="b", severity="warn", kind="gauge",
+                   family="queue_depth", threshold=1.0, for_s=0.0,
+                   window=BurnWindow(1.0, 2.0, 1.0))
+    eng = SloEngine(_scrape_of(state), specs=[spec], sinks=[],
+                    interval_s=0.0, window_scale=1.0,
+                    now=lambda: clock["t"], max_history=8)
+    for i in range(40):
+        clock["t"] += 1
+        state["queue_depth"] = float(i % 2 * 5)
+        eng.evaluate()
+    assert len(eng.alert_history) == 8
+
+
+# -- tracer important-span retention ----------------------------------------
+
+
+def test_tracer_important_ring_survives_healthy_flood():
+    from seaweedfs_tpu.telemetry.trace import Span, Tracer
+
+    tr = Tracer(max_spans=10, max_important=8)
+    bad = Span(trace_id="de" * 16, span_id="11" * 8, parent_id="",
+               name="volumeServer.get", start=time.time(),
+               duration=0.01, status="error: IOError")
+    slow = Span(trace_id="fa" * 16, span_id="22" * 8, parent_id="",
+                name="filer.post", start=time.time(), duration=99.0)
+    tr.record(bad)
+    tr.record(slow)
+    for i in range(50):  # healthy flood far past the main ring bound
+        tr.record(Span(trace_id=f"{i:032x}", span_id=f"{i:016x}",
+                       parent_id="", name="ok", start=time.time(),
+                       duration=0.001))
+    trace_ids = {s.trace_id for s in tr.spans()}
+    assert bad.trace_id in trace_ids and slow.trace_id in trace_ids
+    # and the per-trace query still finds it
+    assert tr.recent_traces(100, trace_id=bad.trace_id)
+    # no duplicates when a span is in both rings
+    tr2 = Tracer(max_spans=10, max_important=8)
+    tr2.record(bad)
+    assert len(tr2.spans()) == 1
+
+
+# -- ?family= filter ---------------------------------------------------------
+
+
+def test_parse_family_prefixes_validation():
+    assert parse_family_prefixes("") is None
+    assert parse_family_prefixes("seaweedfs_canary") == [
+        "seaweedfs_canary"]
+    assert parse_family_prefixes("a_x, b_y") == ["a_x", "b_y"]
+    with pytest.raises(ValueError):
+        parse_family_prefixes("bad-name")
+    with pytest.raises(ValueError):
+        parse_family_prefixes("1leading")
+    with pytest.raises(ValueError):
+        parse_family_prefixes(",".join(f"f{i}" for i in range(17)))
+
+
+def test_registry_render_family_filter():
+    r = Registry()
+    r.counter("t13f_a_total", "x").inc()
+    r.counter("t13f_b_total", "x").inc()
+    text = r.render(["t13f_a"])
+    assert "t13f_a_total" in text and "t13f_b_total" not in text
+    assert "t13f_b_total" in r.render()
+
+
+def test_federated_exposition_family_filter_keeps_meta():
+    from seaweedfs_tpu.telemetry.federation import FederatedExposition
+
+    fed = FederatedExposition(["keep_me"])
+    node = {"instance": "1.2.3.4:80", "type": "volume"}
+    fed.add_live(node, "keep_me_total 3\ndrop_me_total 9\n", 0.01)
+    out = fed.render()
+    assert "keep_me_total" in out and "drop_me_total" not in out
+    # scrape-health meta families always survive the filter
+    assert 'seaweedfs_federation_up{instance="1.2.3.4:80"' in out
+
+
+# -- live canary round trip (in-process master + volume server) --------------
+
+
+@pytest.fixture(scope="module")
+def canary_cluster(tmp_path_factory):
+    import shutil
+
+    from helpers import free_port, make_volume
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.storage.ec import constants as ecc
+    from seaweedfs_tpu.storage.ec.encoder import (
+        generate_ec_files,
+        write_sorted_file_from_idx,
+    )
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    tmp = tmp_path_factory.mktemp("t13canary")
+    master = MasterServer(ip="127.0.0.1", port=free_port(),
+                          pulse_seconds=0.5)
+    master.start()
+    vol_dir = tmp / "vol"
+    vol_dir.mkdir()
+    vs = VolumeServer(
+        directories=[str(vol_dir)],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+        max_volume_count=16)
+    vs.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.1)
+    import urllib.request
+
+    urllib.request.urlopen(
+        f"http://127.0.0.1:{master.port}/dir/assign", timeout=10).read()
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        with master.topo.lock:
+            if any(n.volumes for n in master.topo.nodes.values()):
+                break
+        time.sleep(0.1)
+    # stage one tiny EC volume (vid 99) for the degraded-read probe
+    stage = tmp / "stage"
+    stage.mkdir()
+    svol = make_volume(str(stage), volume_id=99, n_needles=8, seed=7)
+    base = svol.file_name()
+    svol.close()
+    generate_ec_files(base, large_block_size=10000, small_block_size=100,
+                      codec_name="cpu", slice_size=1 << 20)
+    write_sorted_file_from_idx(base)
+    tbase = vs.store.locations[0].base_name(99, "")
+    shutil.copy(base + ".ecx", tbase + ".ecx")
+    for sid in range(ecc.TOTAL_SHARDS):
+        shutil.copy(base + ecc.to_ext(sid), tbase + ecc.to_ext(sid))
+    vs.store.mount_ec_shards(99, "", list(range(ecc.TOTAL_SHARDS)))
+    ev = vs.store.find_ec_volume(99)
+    ev.large_block_size = 10000
+    ev.small_block_size = 100
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        with master.topo.lock:
+            if any(n.ec_shards for n in master.topo.nodes.values()):
+                break
+        time.sleep(0.1)
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def test_canary_round_trip_live(canary_cluster):
+    from seaweedfs_tpu.stats.metrics import REGISTRY
+
+    master, _vs = canary_cluster
+
+    def counter(probe, result):
+        total = 0.0
+        for name, v in REGISTRY.snapshot_samples(max_samples=1 << 20):
+            if (name.startswith("seaweedfs_canary_probe_total")
+                    and f'probe="{probe}"' in name
+                    and f'result="{result}"' in name):
+                total += v
+        return total
+
+    ok_before = counter("volume_rt", "ok")
+    ec_before = counter("ec_degraded", "ok")
+    st = master.canary.run_once()
+    assert st["byteMismatches"] == 0
+    vt = st["probes"]["volume_rt"]["targets"]
+    assert vt and all(t["result"] == "ok" for t in vt.values())
+    ec = st["probes"]["ec_degraded"]["targets"]
+    assert ec and all(t["result"] == "ok" for t in ec.values())
+    assert counter("volume_rt", "ok") > ok_before
+    assert counter("ec_degraded", "ok") > ec_before
+    # probe spans carry exemplar trace ids for the availability alert
+    ex = REGISTRY.exemplars("seaweedfs_canary_probe_seconds")
+    assert ex and all(len(e["traceId"]) == 32 for e in ex)
+
+
+def test_canary_ec_probe_reconstructs(canary_cluster):
+    _master, vs = canary_cluster
+    ev = vs.store.find_ec_volume(99)
+    res = ev.canary_read()
+    assert res["reconstructed"] and res["droppedShard"] is not None
+    assert res["bytes"] > 0
+
+
+def test_cluster_alerts_endpoint_and_shell(canary_cluster):
+    import urllib.error
+    import urllib.request
+
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+    master, _vs = canary_cluster
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{master.port}/cluster/alerts",
+            timeout=10) as r:
+        doc = json.loads(r.read())
+    assert "availability" in doc["states"]
+    assert doc["canary"]["tick"] >= 1
+    env = CommandEnv(f"127.0.0.1:{master.grpc_port}")
+    text = run_command(env, "cluster.alerts")
+    assert "SLOs (" in text and "canary:" in text
+    status = run_command(env, "cluster.status")
+    assert "health:" in status
+    # the ?family= filter is validated at the cluster surface too
+    bad = urllib.request.Request(
+        f"http://127.0.0.1:{master.port}/cluster/metrics?family=no-dash")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(bad, timeout=10)
+    assert ei.value.code == 400
+
+
+def test_geo_sentinel_probe_measures_remote_payload_age():
+    """The geo probe writes a sentinel through the local filer and reads
+    it back from a REMOTE cluster's filer; the payload age it observes
+    becomes seaweedfs_canary_staleness_seconds{probe="geo_sentinel"}."""
+    from seaweedfs_tpu.stats.metrics import CANARY_STALENESS
+    from seaweedfs_tpu.telemetry.canary import CanaryProber
+
+    class StubMaster:
+        ip, port = "127.0.0.1", 1234
+        peer_clusters = ["peer-master:9333"]
+        lifecycle = None
+
+        def clients_snapshot(self):
+            return {"filer@a": {"type": "filer",
+                                "http_address": "local-filer:8888"}}
+
+    prober = CanaryProber(StubMaster())
+    calls = []
+    lag_s = 7.5
+
+    def fake_http(method, url, body=b"", headers=None):
+        calls.append((method, url))
+        if "/cluster/status" in url:
+            return json.dumps(
+                {"Filers": {"x": {"httpAddress": "remote-filer:8888"}}}
+            ).encode()
+        if url.startswith("http://remote-filer:8888"):
+            return json.dumps({"ts": time.time() - lag_s}).encode()
+        return b""
+
+    prober._http = fake_http
+    prober.probe_geo_sentinel()
+    st = prober.status()["probes"]["geo_sentinel"]
+    assert st["targets"]["peer-master:9333"]["result"] == "ok"
+    assert ("PUT", "http://local-filer:8888/.canary/geo-sentinel") in calls
+    staleness = CANARY_STALENESS.labels("geo_sentinel")
+    assert lag_s - 1 <= staleness.value <= lag_s + 5
+
+    # an unreachable peer counts as a probe error, never a crash
+    def broken_http(method, url, body=b"", headers=None):
+        if "/cluster/status" in url:
+            raise IOError("peer down")
+        return fake_http(method, url, body, headers)
+
+    prober._http = broken_http
+    prober.probe_geo_sentinel()
+    st = prober.status()["probes"]["geo_sentinel"]
+    assert st["targets"]["peer-master:9333"]["result"] == "error"
+
+
+def test_canary_detects_dead_volume_server(tmp_path):
+    from helpers import free_port
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    master = MasterServer(ip="127.0.0.1", port=free_port(),
+                          pulse_seconds=30.0)  # slow sweep: node stays
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path)],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+        max_volume_count=8)
+    vs.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and not master.topo.nodes:
+            time.sleep(0.1)
+        import urllib.request
+
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{master.port}/dir/assign",
+            timeout=10).read()
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            with master.topo.lock:
+                if any(n.volumes for n in master.topo.nodes.values()):
+                    break
+            time.sleep(0.1)
+        assert master.canary.run_once()["byteMismatches"] == 0
+        vs.stop()  # the process is gone but the topology still lists it
+        st = master.canary.run_once()
+        vt = st["probes"]["volume_rt"]["targets"]
+        assert any(t["result"] == "error" for t in vt.values())
+    finally:
+        master.stop()

@@ -1,0 +1,370 @@
+"""Chaos: the end-to-end judgment loop (ISSUE 13 acceptance).
+
+A live cluster (in-process master + 4 subprocess volume servers via the
+CLI) runs the canary prober and the SLO engine with second-scale burn
+windows.  The test proves:
+
+* a clean soak produces ZERO false-positive page-tier firings while
+  every canary probe passes byte-identity;
+* SIGKILL of a volume server under canary load fires the page-tier
+  `availability` alert within the fast burn window, carrying an
+  exemplar trace id that resolves through `/cluster/traces`;
+* the `ec-exposure` alert fires while dead-node mass repair has volumes
+  queued below full redundancy;
+* both alerts transition to resolved after mass repair completes and
+  the dead node leaves the probe set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from helpers import free_port, make_volume
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# master pulse: subprocess volume servers full-beat every 3s (the CLI
+# default), so the dead-node window must be 3 pulses of >= that
+PULSE_S = 3.0
+# burn windows at 1/200 scale: page tier evaluates 1.5s/18s
+WINDOW_SCALE = 0.005
+CANARY_TICK_S = 0.3
+SLO_TICK_S = 0.4
+
+
+def _spawn_volume(tmp_path, i, master_port):
+    d = tmp_path / f"vol{i}"
+    d.mkdir()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    port = free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", "volume",
+         "-dir", str(d), "-mserver", f"127.0.0.1:{master_port}",
+         "-ip", "127.0.0.1", "-port", str(port),
+         "-rack", f"rack{i % 2}", "-max", "30"],
+        cwd=str(tmp_path), env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+    return proc, f"127.0.0.1:{port}"
+
+
+def _get_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _wait(cond, deadline_s, what):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.2)
+    raise TimeoutError(what)
+
+
+def _page_firings(master, since_idx=0):
+    hist = list(master.slo.alert_history)[since_idx:]
+    return [h for h in hist
+            if h["severity"] == "page" and h["state"] == "firing"]
+
+
+@pytest.mark.chaos
+def test_chaos_kill_volume_server_fires_and_resolves(tmp_path):
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.shell.commands import CommandEnv, run_command
+
+    jd = tmp_path / "journal"
+    jd.mkdir()
+    master = MasterServer(
+        ip="127.0.0.1", port=free_port(), pulse_seconds=PULSE_S,
+        lifecycle_dir=str(jd),
+        slo_interval=SLO_TICK_S, canary_interval=0.0,
+        slo_window_scale=WINDOW_SCALE)
+    # CI boxes run this alongside heavy suites: a loaded host can push a
+    # round trip past the 2s production default without being an outage
+    master.canary.timeout_s = 5.0
+    master.start()
+    procs = []
+    try:
+        nodes = []
+        for i in range(4):
+            proc, addr = _spawn_volume(tmp_path, i, master.port)
+            procs.append(proc)
+            nodes.append(addr)
+        _wait(lambda: len(master.topo.nodes) == 4, 30,
+              "4 volume servers registered")
+
+        # writable volumes on every node + payload objects to EC-encode
+        # (placement is random: keep growing until all 4 nodes hold one)
+        def covered():
+            with master.topo.lock:
+                return sum(1 for n in master.topo.nodes.values()
+                           if n.volumes) == 4
+
+        _get_json(f"http://127.0.0.1:{master.port}/vol/grow?count=10")
+        for _ in range(8):
+            deadline = time.time() + 6
+            while time.time() < deadline and not covered():
+                time.sleep(0.3)
+            if covered():
+                break
+            _get_json(f"http://127.0.0.1:{master.port}/vol/grow?count=4")
+        _wait(covered, 10, "every node holds a volume")
+        fids = []
+        for i in range(24):
+            a = _get_json(
+                f"http://127.0.0.1:{master.port}/dir/assign?count=1")
+            body = os.urandom(1500)
+            req = urllib.request.Request(
+                f"http://{a['url']}/{a['fid']}", data=body,
+                headers={"Content-Type": "application/octet-stream"},
+                method="POST")
+            urllib.request.urlopen(req, timeout=10).read()
+            fids.append((a["fid"], a["url"]))
+
+        # EC-encode three volumes that actually HOLD data (an empty
+        # volume has no live needle for the degraded-read canary) so a
+        # node death creates real exposure
+        env = CommandEnv(f"127.0.0.1:{master.grpc_port}")
+        vids = sorted({int(fid.split(",")[0]) for fid, _u in fids})[:3]
+        for vid in vids:
+            out = run_command(env, f"ec.encode -volumeId={vid}")
+            assert "error" not in out.lower(), out
+        # spread the shards EXPLICITLY so every node holds <= 4 of each
+        # volume's 14: losing any one node must create EXPOSURE (>= 10
+        # survivors, mass-repairable), never data loss
+        from seaweedfs_tpu.shell.ec_commands import apply_ec_move
+
+        def per_node_shards(v):
+            per: dict = {}
+            for sid, ns in master.topo.lookup_ec_shards(v).items():
+                for n in ns:
+                    per.setdefault(n.id, []).append(sid)
+            return per
+
+        for v in vids:
+            _wait(lambda v=v: len(master.topo.lookup_ec_shards(v)) == 14,
+                  30, f"vid {v}: all 14 shards registered")
+            per = per_node_shards(v)
+            spare = [nid for nid in nodes for _ in range(4)]
+            for nid in per:
+                for _sid in per[nid][:4]:
+                    spare.remove(nid)
+            for nid, sids in sorted(per.items()):
+                for sid in sids[4:]:
+                    target = spare.pop(0)
+                    apply_ec_move(env, {
+                        "volumeId": v, "shardId": sid,
+                        "source": nid, "target": target})
+
+        def spread():
+            for v in vids:
+                per = per_node_shards(v)
+                held = sum(len(s) for s in per.values())
+                if held != 14 or 14 - max(
+                        len(s) for s in per.values()) < 10:
+                    return False
+            return True
+
+        _wait(spread, 30, "EC shards spread <= 4 per node")
+
+        # -- clean soak: zero page-tier false positives ------------------
+        # The canary starts AFTER setup, like an operator's would: the
+        # encode/move churn above leaves gRPC channels between the
+        # subprocess servers in reconnect backoff for tens of seconds,
+        # and probes against that are honest degraded-capability errors,
+        # not the false positives this soak measures.
+        master.canary.interval_s = CANARY_TICK_S
+        master.canary.start()
+        def error_count():
+            total = 0.0
+            from seaweedfs_tpu.stats.metrics import REGISTRY
+            for name, v in REGISTRY.snapshot_samples(max_samples=1 << 20):
+                if (name.startswith("seaweedfs_canary_probe_total")
+                        and 'result="error"' in name):
+                    total += v
+            return total
+
+        # quiet for a full LONG window: an error still inside it keeps
+        # burnLong hot, and one fresh soak blip would then co-fire both
+        long_window_s = 3600.0 * WINDOW_SCALE
+        last_count, last_change = error_count(), time.time()
+        deadline = time.time() + 90
+        while time.time() - last_change < long_window_s + 1.0:
+            if time.time() > deadline:
+                raise TimeoutError("canary error-free baseline")
+            time.sleep(0.5)
+            cur = error_count()
+            if cur != last_count:
+                last_count, last_change = cur, time.time()
+        soak_s = 8.0
+        hist_before = len(master.slo.alert_history)
+        time.sleep(soak_s)
+        assert _page_firings(master, hist_before) == [], (
+            f"false-positive page alerts during clean soak: "
+            f"{_page_firings(master, hist_before)}")
+        st = master.canary.status()
+        assert st["byteMismatches"] == 0
+        vt = st["probes"]["volume_rt"]["targets"]
+        # a node whose only volumes were EC-encoded away has nothing
+        # writable and is legitimately not write-probed — but at least
+        # 3 of 4 nodes are, and NONE of the probes errored
+        assert len(vt) >= 3 and all(
+            t["result"] == "ok" for t in vt.values()), vt
+        ec_probe = st["probes"]["ec_degraded"]["targets"]
+        assert ec_probe and all(
+            t["result"] == "ok" for t in ec_probe.values()), ec_probe
+
+        # -- SIGKILL a shard-holding, volume-holding node ----------------
+        with master.topo.lock:
+            victim_id = next(
+                n.id for n in master.topo.nodes.values()
+                if n.ec_shards and any(
+                    not v.read_only for v in n.volumes.values()))
+        victim = procs[nodes.index(victim_id)]
+        hist_idx = len(master.slo.alert_history)
+        t_kill = time.time()
+        victim.send_signal(signal.SIGKILL)
+        victim.wait(timeout=10)
+
+        # availability page alert within the fast window (+ detection
+        # lag: the canary stops probing the node 3 missed pulses after
+        # the kill, so errors accumulate for ~3*PULSE_S first)
+        fast_window_s = 300.0 * WINDOW_SCALE
+        bound_s = 3 * PULSE_S + fast_window_s + 10.0
+        _wait(lambda: any(h["slo"] == "availability"
+                          for h in _page_firings(master, hist_idx)),
+              bound_s, "availability page alert")
+        fired_at = time.time() - t_kill
+        avail = next(h for h in _page_firings(master, hist_idx)
+                     if h["slo"] == "availability")
+        assert fired_at <= bound_s
+
+        # the alert carries an exemplar trace id that resolves through
+        # the stitched-trace endpoint
+        assert avail.get("exemplars"), avail
+        tid = avail["exemplars"][0]["traceId"]
+        doc = _get_json(f"http://127.0.0.1:{master.port}"
+                        f"/cluster/traces?trace={tid}")
+        assert doc["traceId"] == tid and doc["spans"], doc
+
+        # exposure alert while mass repair has volumes queued
+        _wait(lambda: any(
+            h["slo"] == "ec-exposure" and h["state"] == "firing"
+            for h in list(master.slo.alert_history)[hist_idx:]),
+            bound_s + 30, "ec-exposure alert fired")
+
+        # -- repair completes: everything resolves -----------------------
+        def repaired():
+            return (not master.mass_repair.pending()
+                    and all(len(master.topo.lookup_ec_shards(v)) > 0
+                            for v in vids))
+
+        _wait(repaired, 90, "mass repair drained")
+
+        def all_resolved():
+            s = master.slo.status(evaluate_if_idle=False)["states"]
+            return (s["availability"]["state"] == "ok"
+                    and s["ec-exposure"]["state"] == "ok")
+
+        _wait(all_resolved, 60, "alerts resolved after repair")
+        hist = list(master.slo.alert_history)[hist_idx:]
+        assert any(h["slo"] == "availability" and h["state"] == "ok"
+                   for h in hist), hist
+        assert any(h["slo"] == "ec-exposure" and h["state"] == "ok"
+                   for h in hist), hist
+
+        # canary byte identity held across the whole incident, and the
+        # /cluster/alerts surface serves the full document over HTTP
+        assert master.canary.status()["byteMismatches"] == 0
+        doc = _get_json(
+            f"http://127.0.0.1:{master.port}/cluster/alerts")
+        assert doc["states"]["availability"]["state"] == "ok"
+        assert any(h["state"] == "firing" for h in doc["history"])
+        assert hist_before <= len(doc["history"])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        master.stop()
+
+
+@pytest.mark.chaos
+def test_chaos_ec_canary_pages_on_decode_rot(tmp_path):
+    """A volume server whose EC decode path serves garbage (flipped
+    shard byte) fails the drop-shard canary loudly — 'process up but
+    serving garbage' is exactly what black-box probing exists to page
+    on."""
+    import shutil
+
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.storage.ec import constants as ecc
+    from seaweedfs_tpu.storage.ec.encoder import (
+        generate_ec_files,
+        write_sorted_file_from_idx,
+    )
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    master = MasterServer(ip="127.0.0.1", port=free_port(),
+                          pulse_seconds=0.5)
+    master.start()
+    vol_dir = tmp_path / "vol"
+    vol_dir.mkdir()
+    vs = VolumeServer(
+        directories=[str(vol_dir)],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
+        max_volume_count=8)
+    vs.start()
+    try:
+        _wait(lambda: master.topo.nodes, 15, "node registered")
+        stage = tmp_path / "stage"
+        stage.mkdir()
+        svol = make_volume(str(stage), volume_id=7, n_needles=6, seed=3)
+        base = svol.file_name()
+        svol.close()
+        generate_ec_files(base, large_block_size=10000,
+                          small_block_size=100, codec_name="cpu",
+                          slice_size=1 << 20)
+        write_sorted_file_from_idx(base)
+        tbase = vs.store.locations[0].base_name(7, "")
+        shutil.copy(base + ".ecx", tbase + ".ecx")
+        for sid in range(ecc.TOTAL_SHARDS):
+            shutil.copy(base + ecc.to_ext(sid), tbase + ecc.to_ext(sid))
+        vs.store.mount_ec_shards(7, "", list(range(ecc.TOTAL_SHARDS)))
+        ev = vs.store.find_ec_volume(7)
+        ev.large_block_size = 10000
+        ev.small_block_size = 100
+        _wait(lambda: any(n.ec_shards
+                          for n in master.topo.nodes.values()),
+              15, "ec shards in topology")
+        st = master.canary.run_once()
+        assert all(t["result"] == "ok" for t in
+                   st["probes"]["ec_degraded"]["targets"].values())
+        # rot a byte in a DATA shard the reconstruct path reads from
+        ev._interval_cache and ev._interval_cache.clear()
+        with open(tbase + ecc.to_ext(1), "r+b") as f:
+            f.seek(10)
+            b = f.read(1)
+            f.seek(10)
+            f.write(bytes([b[0] ^ 0xFF]))
+        st = master.canary.run_once()
+        results = [t["result"] for t in
+                   st["probes"]["ec_degraded"]["targets"].values()]
+        assert "error" in results, st["probes"]["ec_degraded"]
+    finally:
+        vs.stop()
+        master.stop()

@@ -1,0 +1,171 @@
+"""Direct coverage for telemetry/stitch.py clock-skew edge cases and
+federation.py's snapshot-staleness fallback (ISSUE 13 satellite) —
+previously exercised only incidentally by the cluster acceptance test.
+"""
+
+from __future__ import annotations
+
+import time
+
+from seaweedfs_tpu.telemetry.federation import (
+    FederatedExposition,
+    inject_labels,
+    parse_exposition,
+)
+from seaweedfs_tpu.telemetry.stitch import estimate_skew, stitch_trace
+
+TID = "ab" * 16
+
+
+def _span(span_id, parent, start, dur_ms, name="op"):
+    return {"traceId": TID, "spanId": span_id, "parentId": parent,
+            "name": name, "start": start, "durationMs": dur_ms,
+            "attrs": {}, "status": "ok"}
+
+
+# -- stitch: clock skew ------------------------------------------------------
+
+
+def test_estimate_skew_symmetric_path():
+    import pytest
+
+    # node clock 0.4s ahead: sent at 100, rtt 0.2 -> midpoint 100.1
+    assert estimate_skew(100.5, 100.0, 0.2) == pytest.approx(0.4)
+    # NEGATIVE skew: node clock behind the master's
+    assert estimate_skew(99.0, 100.0, 0.2) == pytest.approx(-1.1)
+
+
+def test_stitch_negative_skew_reorders_spans():
+    """A node whose clock runs BEHIND stamps its spans too early; the
+    skew adjustment must shift them forward so the merged timeline
+    orders by true wall time."""
+    # true order: master span at t=100.0, then volume span at t=100.5,
+    # but the volume node's clock is 2s behind (stamps 98.5)
+    results = [
+        {"instance": "m:1", "type": "master",
+         "spans": [_span("aa" * 8, "", 100.0, 10.0)],
+         "skew_s": 0.0, "rtt_s": 0.0},
+        {"instance": "v:1", "type": "volume",
+         "spans": [_span("bb" * 8, "aa" * 8, 98.5, 5.0)],
+         "skew_s": -2.0, "rtt_s": 0.01},
+    ]
+    doc = stitch_trace(TID, results)
+    assert [s["spanId"] for s in doc["spans"]] == ["aa" * 8, "bb" * 8]
+    vol = doc["spans"][1]
+    assert vol["startAdjusted"] == 100.5
+    assert doc["nodes"]["v:1"]["clockSkewMs"] == -2000.0
+    # duration spans the ADJUSTED envelope, not the raw stamps
+    assert doc["durationMs"] == 505.0
+
+
+def test_stitch_missing_skew_field_defaults_to_zero():
+    """A node result without skew/rtt (e.g. a /debug/traces response
+    missing `now`) merges with no adjustment rather than crashing."""
+    results = [
+        {"instance": "v:1", "type": "volume",
+         "spans": [_span("aa" * 8, "", 50.0, 1.0)]},  # no skew_s/rtt_s
+    ]
+    doc = stitch_trace(TID, results)
+    assert doc["spans"][0]["startAdjusted"] == 50.0
+    assert doc["nodes"]["v:1"]["clockSkewMs"] == 0.0
+
+
+def test_stitch_marks_orphans_and_empty_input():
+    results = [
+        {"instance": "a:1", "type": "filer",
+         "spans": [_span("aa" * 8, "", 10.0, 1.0),
+                   _span("bb" * 8, "aa" * 8, 10.1, 1.0),
+                   _span("cc" * 8, "99" * 8, 10.2, 1.0)],  # dead parent
+         "skew_s": 0.0, "rtt_s": 0.0},
+    ]
+    doc = stitch_trace(TID, results)
+    by_id = {s["spanId"]: s for s in doc["spans"]}
+    assert not by_id["aa" * 8]["orphan"]  # root: empty parent, no orphan
+    assert not by_id["bb" * 8]["orphan"]  # parent present
+    assert by_id["cc" * 8]["orphan"]      # parent ring-evicted/process gone
+    empty = stitch_trace(TID, [])
+    assert empty["spans"] == [] and "durationMs" not in empty
+
+
+# -- federation: parse + snapshot fallback -----------------------------------
+
+
+def test_parse_exposition_groups_histograms_and_drops_malformed():
+    text = "\n".join([
+        "# HELP x_seconds latency",
+        "# TYPE x_seconds histogram",
+        'x_seconds_bucket{le="0.5"} 3',
+        "x_seconds_sum 1.5",
+        "x_seconds_count 3",
+        "# TYPE y_total counter",
+        "y_total 7 1700000000",  # timestamped sample: value still parsed
+        'broken{no_close 9',     # malformed: dropped, not corrupting
+        "bare_untyped 1",
+    ])
+    families, samples = parse_exposition(text)
+    assert families["x_seconds"][0] == "histogram"
+    by_family: dict = {}
+    for family, name, value in samples:
+        by_family.setdefault(family, []).append((name, value))
+    # histogram pieces all file under the base family (contiguity)
+    assert {n for n, _v in by_family["x_seconds"]} == {
+        'x_seconds_bucket{le="0.5"}', "x_seconds_sum", "x_seconds_count"}
+    assert ("y_total", "7") in by_family["y_total"]
+    assert "bare_untyped" in by_family
+    assert not any("broken" in f for f in by_family)
+
+
+def test_snapshot_fallback_renders_with_registry_kinds():
+    """An unreachable node served from its heartbeat snapshot: known
+    families pick up their TYPE from the local registry, unknown ones
+    render untyped, and stale/age meta-samples mark the node."""
+    fed = FederatedExposition()
+    node = {"instance": "10.0.0.9:8080", "type": "volume"}
+    fed.add_snapshot(node, [
+        ('seaweedfs_request_total{type="volumeServer",op="get"}', 42.0),
+        ("totally_unknown_total", 7.0),
+    ], age_seconds=12.5)
+    out = fed.render()
+    assert "# TYPE seaweedfs_request_total counter" in out
+    assert "# TYPE totally_unknown_total untyped" in out
+    assert ('seaweedfs_federation_stale{instance="10.0.0.9:8080"'
+            in out)
+    assert "seaweedfs_federation_snapshot_age_seconds" in out
+    assert 'seaweedfs_request_total{instance="10.0.0.9:8080"' in out
+
+
+def test_down_node_still_visible():
+    fed = FederatedExposition()
+    fed.add_down({"instance": "10.0.0.9:8080", "type": "volume"})
+    out = fed.render()
+    assert 'seaweedfs_federation_up{instance="10.0.0.9:8080"' in out
+
+
+def test_inject_labels_orders_extras_first():
+    line = inject_labels('x_total{op="get"}', {"instance": "a:1"})
+    assert line == 'x_total{instance="a:1",op="get"}'
+    assert inject_labels("x_total", {"instance": "a:1"}) == (
+        'x_total{instance="a:1"}')
+
+
+def test_federation_targets_staleness_cutoff(tmp_path):
+    """Snapshots for departed nodes are served only within the retention
+    window: a node gone 15+ minutes is an outage, not a scrape blip."""
+    from helpers import free_port
+    from seaweedfs_tpu.master import observability
+    from seaweedfs_tpu.master.server import MasterServer
+
+    master = MasterServer(ip="127.0.0.1", port=free_port())
+    # no start(): federation_targets only reads in-memory state
+    now = time.monotonic()
+    master.stats_snapshots["1.1.1.1:80"] = {
+        "type": "volume", "samples": [("x_total", 1.0)],
+        "captured_at_ms": 0, "received": now - 10.0}           # fresh
+    master.stats_snapshots["2.2.2.2:80"] = {
+        "type": "volume", "samples": [("x_total", 1.0)],
+        "captured_at_ms": 0,
+        "received": now - observability.SNAPSHOT_RETENTION_S - 5}  # stale
+    instances = {t["instance"] for t in
+                 observability.federation_targets(master)}
+    assert "1.1.1.1:80" in instances
+    assert "2.2.2.2:80" not in instances
