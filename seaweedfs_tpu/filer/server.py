@@ -367,9 +367,13 @@ class FilerServer:
         so it costs nothing)."""
         from ..util.cipher import maybe_seal
 
+        from ..operation.upload import VolumeFullError
+
         stored, cipher_key = maybe_seal(blob, self.cipher)
         last: Exception | None = None
-        for round_no in range(3):
+        round_no, rounds = 0, 3
+        while round_no < rounds:
+            round_no += 1
             result = assign_any(
                 self._master_order(), count=1, collection=collection,
                 replication=replication or self.default_replication, ttl=ttl,
@@ -381,8 +385,18 @@ class FilerServer:
                 )
             except Exception as e:  # noqa: BLE001 - re-assign and retry
                 last = e
+                reason = "reassign"
+                if isinstance(e, VolumeFullError):
+                    # typed 409: the target disk is full.  The volume
+                    # server forced a heartbeat, so the master excludes
+                    # it within ~one pulse — a short pause + extra
+                    # rounds beats failing a healthy cluster's write
+                    # during that propagation window
+                    reason = "volume_full"
+                    rounds = max(rounds, 6)
+                    time.sleep(0.2)
                 failsafe.RETRY_COUNTER.labels(
-                    "filer", "upload_chunk", "reassign").inc()
+                    "filer", "upload_chunk", reason).inc()
                 glog.warning(
                     "chunk upload to %s failed (%s); re-assigning trace=%s",
                     result.url, e, trace.current_trace_id() or "-")

@@ -121,8 +121,13 @@ class LifecycleController:
         self._run_lock = threading.Lock()  # one run_once at a time
         self._counts = {"cycles": 0, "planned": 0, "executed": 0,
                         "errors": 0, "throttle_seconds": 0.0,
-                        "backoff_seconds": 0.0}
+                        "backoff_seconds": 0.0, "emergency": 0}
         self._last_cycle = 0.0
+        # disk-fault plane: per-node rate limit for the low-space
+        # emergency reaction (the node keeps heartbeating low_space
+        # until space actually frees)
+        self._low_space_last: dict[str, float] = {}
+        self._low_space_lock = threading.Lock()
         LIFECYCLE_QUEUE_DEPTH.set(len(self.journal.active()))
 
     # -- policy persistence -----------------------------------------------
@@ -273,6 +278,73 @@ class LifecycleController:
                       backend=pol.tier_backend,
                       keep_local=pol.keep_local_dat)
         return None
+
+    # -- low-space emergency (disk-fault plane) ---------------------------
+
+    LOW_SPACE_COOLDOWN_S = 30.0
+    EMERGENCY_GARBAGE_RATIO = 0.01
+
+    def note_low_space(self, node_id: str) -> list[dict]:
+        """Heartbeat-ingest trigger: a node reports a low_space/full
+        disk.  Plan emergency space recovery for the volumes it holds —
+        vacuum anything with garbage (policy quiet windows and ratios
+        bypassed, read-only-full volumes INCLUDED via force), and tier
+        sealed volumes out when the collection's policy has a tier
+        backend.  Rate-limited per node; executes asynchronously on the
+        worker pool.  -> the accepted jobs."""
+        now = time.monotonic()
+        with self._low_space_lock:
+            if (now - self._low_space_last.get(node_id, 0.0)
+                    < self.LOW_SPACE_COOLDOWN_S):
+                return []
+            self._low_space_last[node_id] = now
+        plans = self.plan_emergency(node_id)
+        accepted = self.submit(plans)
+        if accepted:
+            self._counts["emergency"] += len(accepted)
+            glog.warning(
+                "lifecycle: node %s low on space — emergency %s",
+                node_id, [j["key"] for j in accepted])
+            keys = {j["key"] for j in accepted}
+            threading.Thread(
+                target=self.run_pending, kwargs={"wait": True,
+                                                 "keys": keys},
+                name="lifecycle-emergency", daemon=True).start()
+        return accepted
+
+    def plan_emergency(self, node_id: str) -> list[dict]:
+        """Pure: space-recovery plans for volumes held on `node_id`."""
+        states, ec_vids, _counts = self._volume_states()
+        with self.master.topo.lock:
+            node = self.master.topo.nodes.get(node_id)
+            free_bytes = min(
+                (d.get("free_bytes", 0)
+                 for d in (node.disk_health if node else {}).values()),
+                default=0)
+        plans: list[dict] = []
+        for vid, st in sorted(states.items()):
+            if node_id not in st["holders"]:
+                continue
+            pol = self.policies.for_collection(st["collection"])
+            # compaction writes the volume's LIVE bytes to a .cpd on the
+            # SAME disk: planning one that cannot fit would burn the
+            # reserved delete headroom on a doomed copy and park the job
+            live = int(st["size"] * (1.0 - st["garbage"]))
+            fits = free_bytes == 0 or free_bytes > live * 1.1 + (4 << 20)
+            if st["garbage"] >= self.EMERGENCY_GARBAGE_RATIO and fits:
+                plans.append(self._mk_plan(
+                    vid, "vacuum", st, bytes_=st["size"],
+                    ratio=self.EMERGENCY_GARBAGE_RATIO, force=True,
+                    reason="low_space"))
+            elif (pol.tier_backend and st["read_only"] and st["size"] > 0
+                    and (pol.ec_cooldown_seconds < 0 or vid in ec_vids)):
+                # sealed + tier-eligible: move the .dat off the node NOW
+                # (idle-seconds bypassed — space is the emergency)
+                plans.append(self._mk_plan(
+                    vid, "tier", st, bytes_=st["size"],
+                    backend=pol.tier_backend,
+                    keep_local=False, reason="low_space"))
+        return plans
 
     def _mk_plan(self, vid, transition, st, bytes_=0, **extra) -> dict:
         return {
@@ -576,7 +648,8 @@ class LifecycleController:
 
     def _do_vacuum(self, job: dict) -> str:
         ok = self.master.vacuum_volume(
-            job["volume_id"], threshold=job.get("ratio"))
+            job["volume_id"], threshold=job.get("ratio"),
+            force=bool(job.get("force")))
         return "compacted" if ok else "skipped (ratio below threshold)"
 
     def _do_rebalance(self, job: dict) -> str:

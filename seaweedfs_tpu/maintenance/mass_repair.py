@@ -41,6 +41,7 @@ import grpc
 from ..pb import rpc as rpclib
 from ..pb import volume_server_pb2 as vs
 from ..stats.metrics import (
+    DISK_EVACUATE_COUNTER,
     REPAIR_BATCH_BYTES,
     REPAIR_BATCH_DEADLINE_SLACK,
     REPAIR_BATCH_JOBS,
@@ -126,9 +127,13 @@ class MassRepairOrchestrator:
         self._remaining_bytes = 0
         self._counts = {"deaths": 0, "planned": 0, "repaired": 0,
                         "failed": 0, "parked": 0, "unrepairable": 0,
-                        "waves": 0}
+                        "waves": 0, "evacuated": 0}
         self._last_plan = 0.0
         self._lost_seen: set[int] = set()
+        # proactive evacuation state: node -> last finished run
+        # (cooldown), plus the set of in-flight evacuation threads
+        self._evacuations: dict[str, float] = {}
+        self._evacuating: set[str] = set()
         for rec in self.journal.jobs(("pending",)):
             if rec.get("transition") == TRANSITION and rec.get("resumed"):
                 REPAIR_BATCH_JOBS.labels("resumed").inc()
@@ -312,6 +317,146 @@ class MassRepairOrchestrator:
                 "(most exposed: %s)", node_id, len(accepted),
                 [j["volume_id"] for j in accepted[:8]])
         self.kick()
+
+    # -- proactive evacuation (failing disk, node still alive) ------------
+
+    EVACUATION_COOLDOWN_S = 30.0
+
+    def on_disk_failing(self, node_id: str) -> None:
+        """Heartbeat-ingest trigger: a node reports a FAILING disk
+        (K EIOs / statvfs errors).  Unlike on_node_dead the node is
+        still alive and its bytes still readable — the cheapest repair
+        there will ever be is to drain it NOW (arXiv:1309.0186: paying
+        a planned migration beats paying the post-death repair storm).
+        EC shards move via copy+mount-on-target then unmount+delete-on-
+        source (readable throughout); volumes whose ONLY copy lives on
+        the failing node are re-copied to a healthy peer.  Idempotent
+        and rate-limited: re-triggers (the node keeps beating `failing`)
+        pick up whatever the topology still shows on the node."""
+        if not self.enabled:
+            return
+        with self._lock:
+            last = self._evacuations.get(node_id, 0.0)
+            if time.monotonic() - last < self.EVACUATION_COOLDOWN_S:
+                return
+            if node_id in self._evacuating:
+                return
+            self._evacuating.add(node_id)
+        t = threading.Thread(target=self._evacuate, args=(node_id,),
+                             name=f"evacuate-{node_id}", daemon=True)
+        t.start()
+
+    def plan_evacuation(self, node_id: str) -> "list[dict]":
+        """Pure: what should move off `node_id` right now.  EC shards
+        held there spread to healthy nodes by free EC slots; volumes
+        with no healthy holder get one copy each."""
+        topo = self.master.topo
+        moves: list[dict] = []
+        with topo.lock:
+            node = topo.nodes.get(node_id)
+            if node is None:
+                return []
+            healthy = [n for n in topo.nodes.values()
+                       if n.id != node_id and n.has_writable_disk()]
+            ec_free = {n.id: max(n.free_ec_slots(), 0) for n in healthy}
+            vol_free = {n.id: max(n.free_slots(), 0) for n in healthy}
+            from ..storage.ec.shard_bits import ShardBits
+
+            for vid, bits in sorted(node.ec_shards.items()):
+                coll = node.ec_collections.get(vid, "")
+                # per-volume spread: stacking one volume's shards on a
+                # single node would turn that node's later death into
+                # data loss — prefer targets holding (or receiving) the
+                # fewest shards of THIS volume, then most free slots
+                vol_load = {
+                    n.id: (ShardBits(n.ec_shards[vid]).count()
+                           if vid in n.ec_shards else 0)
+                    for n in healthy}
+                for sid in bits.shard_ids():
+                    candidates = [n for n in ec_free if ec_free[n] > 0]
+                    if not candidates:
+                        break
+                    target = min(candidates, key=lambda n: (
+                        vol_load.get(n, 0), -ec_free[n], n))
+                    ec_free[target] -= 1
+                    vol_load[target] = vol_load.get(target, 0) + 1
+                    moves.append({"kind": "ec_shard", "volume_id": vid,
+                                  "shard_id": sid, "collection": coll,
+                                  "source": node_id, "target": target})
+            for vid, v in sorted(node.volumes.items()):
+                if any(vid in n.volumes for n in healthy):
+                    continue  # a healthy replica already exists
+                target = max(vol_free, key=lambda n: (vol_free[n], n),
+                             default=None)
+                if target is None or vol_free[target] <= 0:
+                    continue
+                vol_free[target] -= 1
+                moves.append({"kind": "volume", "volume_id": vid,
+                              "collection": v.collection,
+                              "source": node_id, "target": target})
+        return moves
+
+    def _evacuate(self, node_id: str) -> None:
+        moved = failed = 0
+        try:
+            moves = self.plan_evacuation(node_id)
+            if moves:
+                glog.warning(
+                    "mass repair: disk FAILING on %s — evacuating %d "
+                    "shard(s)/volume(s) proactively", node_id, len(moves))
+            for mv in moves:
+                if self._stop.is_set():
+                    break
+                try:
+                    if mv["kind"] == "ec_shard":
+                        self._evacuate_ec_shard(mv)
+                    else:
+                        self._evacuate_volume(mv)
+                    DISK_EVACUATE_COUNTER.labels(mv["kind"], "ok").inc()
+                    moved += 1
+                except Exception as e:  # noqa: BLE001 — per-move isolation
+                    DISK_EVACUATE_COUNTER.labels(mv["kind"], "error").inc()
+                    failed += 1
+                    glog.warning("evacuation move %s failed: %s", mv, e)
+            self._counts["evacuated"] += moved
+            if moved or failed:
+                glog.warning("mass repair: evacuation of %s: %d moved, "
+                             "%d failed", node_id, moved, failed)
+        finally:
+            with self._lock:
+                self._evacuating.discard(node_id)
+                self._evacuations[node_id] = time.monotonic()
+
+    def _evacuate_ec_shard(self, mv: dict) -> None:
+        """copy+mount on the target, then unmount+delete on the failing
+        source — the two-phase order keeps the shard readable
+        throughout (same discipline as the shell's ec.balance)."""
+        vid, sid, coll = mv["volume_id"], mv["shard_id"], mv["collection"]
+        tgt = self._target_stub(mv["target"])
+        from ..shell.ec_commands import _node_grpc
+
+        tgt.VolumeEcShardsCopy(vs.VolumeEcShardsCopyRequest(
+            volume_id=vid, collection=coll, shard_ids=[sid],
+            copy_ecx_file=True, copy_ecj_file=True, copy_vif_file=True,
+            copy_from_data_node=_node_grpc(mv["source"])))
+        tgt.VolumeEcShardsMount(vs.VolumeEcShardsMountRequest(
+            volume_id=vid, collection=coll, shard_ids=[sid]))
+        src = self._target_stub(mv["source"])
+        src.VolumeEcShardsUnmount(vs.VolumeEcShardsUnmountRequest(
+            volume_id=vid, shard_ids=[sid]))
+        src.VolumeEcShardsDelete(vs.VolumeEcShardsDeleteRequest(
+            volume_id=vid, collection=coll, shard_ids=[sid]))
+
+    def _evacuate_volume(self, mv: dict) -> None:
+        """Pull the sole copy of a volume onto a healthy node.  The
+        failing node's copy is left in place as extra redundancy —
+        death (or the operator) removes it; deleting the original while
+        its disk still half-works would trade durability for tidiness."""
+        from ..shell.ec_commands import _node_grpc
+
+        self._target_stub(mv["target"]).VolumeCopy(vs.VolumeCopyRequest(
+            volume_id=mv["volume_id"], collection=mv["collection"],
+            source_data_node=_node_grpc(mv["source"])))
 
     def tick(self) -> None:
         """Periodic re-evaluation (liveness cadence): re-plans degraded

@@ -89,6 +89,17 @@ class MasterGrpcService:
                     new_vids += [m.id for m in hb.new_volumes]
                     deleted_vids += [m.id for m in hb.deleted_volumes]
                 node.last_seen = time.monotonic()
+                if hb.disk_health:
+                    # disk-fault plane: record per-dir health, then
+                    # react — low_space triggers emergency vacuum via
+                    # the lifecycle plane, failing triggers proactive
+                    # evacuation via the mass-repair orchestrator
+                    node.disk_health = {
+                        d.dir: {"state": d.state,
+                                "free_bytes": d.free_bytes,
+                                "total_bytes": d.total_bytes}
+                        for d in hb.disk_health}
+                    self.master.note_disk_health(node)
                 if hb.HasField("stats"):
                     # federation fallback: keep the node's last stats
                     # snapshot for /cluster/metrics when a live scrape

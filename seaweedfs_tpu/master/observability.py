@@ -206,6 +206,15 @@ def cluster_status(master) -> dict:
                 "dataCenter": n.data_center,
                 "rack": n.rack,
                 "secondsSinceLastBeat": round(now_mono - n.last_seen, 1),
+                # disk-fault plane: per-dir watermark state + free bytes
+                # from the node's heartbeat (empty = legacy/unknown)
+                "disks": {
+                    d: {"state": info.get("state", "healthy"),
+                        "freeBytes": info.get("free_bytes", 0),
+                        "totalBytes": info.get("total_bytes", 0)}
+                    for d, info in n.disk_health.items()
+                },
+                "diskState": n.worst_disk_state(),
             }
             for n in master.topo.nodes.values()
         }

@@ -564,6 +564,27 @@ class MasterServer:
         glog.warning("node %s presumed dead (seq %d)", node_id,
                      self.dead_node_seq)
 
+    def note_disk_health(self, node) -> None:
+        """Heartbeat-ingest hook for the disk-fault plane: a low-space
+        or full disk gets the lifecycle plane's emergency vacuum/tier
+        treatment; a failing disk becomes a proactive-evacuation trigger
+        for the mass-repair orchestrator (drain it before it dies)."""
+        worst = node.worst_disk_state()
+        if worst == "healthy":
+            return
+        if worst in ("low_space", "full"):
+            try:
+                self.lifecycle.note_low_space(node.id)
+            except Exception as e:  # noqa: BLE001 — never fail the beat
+                glog.warning("low-space reaction for %s failed: %s",
+                             node.id, e)
+        if worst == "failing" and self.is_leader():
+            try:
+                self.mass_repair.on_disk_failing(node.id)
+            except Exception as e:  # noqa: BLE001
+                glog.warning("evacuation trigger for %s failed: %s",
+                             node.id, e)
+
     def note_topology_change(self, node_id: str) -> None:
         """A node JOINED (first heartbeat, incl. a rejoin after a
         death): same cache-invalidation broadcast as a death, because a
@@ -589,11 +610,18 @@ class MasterServer:
         return vacuumed
 
     def vacuum_volume(self, vid: int,
-                      threshold: float | None = None) -> bool:
+                      threshold: float | None = None,
+                      force: bool = False) -> bool:
         """Check -> Compact -> Commit one volume on every holder (the
         lifecycle controller's vacuum jobs call this directly); a failed
         phase rolls back with VacuumVolumeCleanup.  Returns True when
-        the volume was compacted."""
+        the volume was compacted.
+
+        `force=True` (the disk-fault plane's emergency vacuum) includes
+        read-only volumes: a read-only-FULL volume is exactly the one
+        that needs its garbage compacted away.  The volume server still
+        refuses remote-tiered / mid-tier volumes, so the tier race the
+        normal exemption guards against stays impossible."""
         threshold = threshold or self.garbage_threshold
         with self.topo.lock:
             nodes = [n for n in self.topo.nodes.values()
@@ -602,7 +630,7 @@ class MasterServer:
             # reference's vacuum: they are EC-encode/tier candidates,
             # and a compact commit racing a lifecycle tier upload would
             # swap the .dat mid-transfer
-            if any(n.volumes[vid].read_only for n in nodes):
+            if not force and any(n.volumes[vid].read_only for n in nodes):
                 return False
         if not nodes:
             return False

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import gzip
 import json
+import urllib.error
 import uuid
 from dataclasses import dataclass
 
@@ -34,6 +35,23 @@ class UploadResult:
     etag: str
     mime: str = ""
     gzipped: bool = False
+
+
+class VolumeFullError(RuntimeError):
+    """Typed volume-full rejection (HTTP 409 from the volume server's
+    disk-fault plane): the target cannot take this write and retrying
+    it is pointless — the caller should RE-ASSIGN immediately (the
+    master stops handing out the full volume within one heartbeat)."""
+
+
+def _is_volume_full(exc: BaseException) -> bool:
+    seen = 0
+    while exc is not None and seen < 8:
+        if isinstance(exc, urllib.error.HTTPError) and exc.code == 409:
+            return True
+        exc = exc.__cause__ or exc.__context__
+        seen += 1
+    return False
 
 
 def upload_data(
@@ -97,6 +115,9 @@ def upload_data(
             policy=policy, peer=_peer_of(url), idempotent=False,
         )
     except Exception as e:
+        if _is_volume_full(e):
+            raise VolumeFullError(
+                f"volume full at {url} (re-assign): {e}") from e
         raise RuntimeError(f"upload to {url} failed: {e}") from e
 
 

@@ -38,11 +38,20 @@ def cluster_status(env: CommandEnv, args: list[str]) -> str:
     lines.append(f"volume servers ({len(nodes)}):")
     for nid in sorted(nodes):
         n = nodes[nid]
+        disk_state = n.get("diskState", "healthy")
+        disks = n.get("disks") or {}
+        free_mb = sum(d.get("freeBytes", 0) for d in disks.values()) >> 20
+        disk_note = ""
+        if disks:
+            disk_note = f" disk={disk_state} free={free_mb}MB"
+            if disk_state not in ("healthy", "low_space"):
+                disk_note = disk_note.upper()  # full/failing must pop
         lines.append(
             f"  {nid} dc={n.get('dataCenter')} rack={n.get('rack')} "
             f"volumes={len(n.get('volumes', ()))} "
             f"ecVolumes={len(n.get('ecShards', {}))} "
-            f"lastBeat={n.get('secondsSinceLastBeat', '?')}s ago")
+            f"lastBeat={n.get('secondsSinceLastBeat', '?')}s ago"
+            + disk_note)
     filers = doc.get("Filers", {})
     lines.append(f"filers ({len(filers)}):")
     for name in sorted(filers):

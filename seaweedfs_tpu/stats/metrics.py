@@ -411,6 +411,39 @@ CHUNK_CACHE_COUNTER = REGISTRY.counter(
     labels=("result",),
 )
 
+# disk-fault survival plane (storage/disk_health.py): per-data-directory
+# statvfs watermarks + the health state machine every classified write
+# error feeds.  `state` is numeric-coded (0 healthy, 1 low_space, 2 full,
+# 3 failing) so one gauge family tells an alert rule everything.
+DISK_FREE_GAUGE = REGISTRY.gauge(
+    "seaweedfs_disk_free_bytes", "free bytes on a data directory's filesystem",
+    labels=("dir",),
+)
+DISK_TOTAL_GAUGE = REGISTRY.gauge(
+    "seaweedfs_disk_total_bytes",
+    "total bytes on a data directory's filesystem",
+    labels=("dir",),
+)
+DISK_STATE_GAUGE = REGISTRY.gauge(
+    "seaweedfs_disk_state",
+    "disk health state (0=healthy 1=low_space 2=full 3=failing)",
+    labels=("dir",),
+)
+DISK_WRITE_ERROR = REGISTRY.counter(
+    "seaweedfs_disk_write_errors_total",
+    "classified storage-write failures by kind",
+    labels=("kind",),  # enospc | eio | short | other
+)
+VOLUME_FULL_REJECT = REGISTRY.counter(
+    "seaweedfs_volume_full_rejects_total",
+    "writes rejected with the typed volume-full (409) error",
+)
+DISK_EVACUATE_COUNTER = REGISTRY.counter(
+    "seaweedfs_disk_evacuations_total",
+    "proactive failing-disk evacuation moves by kind and outcome",
+    labels=("kind", "result"),  # kind: ec_shard|volume; result: ok|error
+)
+
 # keep-alive connection pool (util/connpool.py): every internal HTTP hop
 # either reuses a pooled socket or pays a fresh dial; evictions count
 # sockets dropped for staleness, pool overflow, or a dead keep-alive

@@ -82,7 +82,16 @@ class DiskFile(BackendStorageFile):
         return os.pread(f.fileno(), size, offset)
 
     def write_at(self, offset: int, data: bytes) -> int:
+        """-> bytes actually written.  The `disk.write` faultpoint family
+        fires here (storage/disk_health.py): error/enospc/partial raise a
+        classified OSError (enospc/partial after landing a TORN half),
+        short silently truncates — so every caller's rollback and the
+        load-time torn-tail healer can be exercised without a real dying
+        disk."""
+        from .disk_health import inject_write_fault
+
         with self._lock:
+            data = inject_write_fault(self.name, self._f, offset, data)
             self._f.seek(offset)
             self._f.write(data)
             self._f.flush()

@@ -11,6 +11,7 @@ import os
 import re
 import threading
 
+from .disk_health import DiskHealth
 from .ec.volume import EcVolume
 from .super_block import SuperBlock
 from .volume import Volume
@@ -47,6 +48,9 @@ class DiskLocation:
         self.disk_type = normalize_disk_type(disk_type)
         self.volumes: dict[int, Volume] = {}
         self.ec_volumes: dict[int, EcVolume] = {}
+        # disk-fault survival plane: one health state machine per data
+        # directory; every volume's write errors feed it
+        self.health = DiskHealth(self.directory)
         self._lock = threading.RLock()
         self.load_existing_volumes()
 
@@ -65,6 +69,7 @@ class DiskLocation:
                         try:
                             v = Volume(self.directory, collection, vid)
                             v.disk_type = self.disk_type
+                            v.health = self.health
                             self.volumes[vid] = v
                         except Exception:
                             continue
@@ -100,6 +105,7 @@ class DiskLocation:
                 return self.volumes[vid]
             v = Volume(self.directory, collection, vid, super_block=super_block)
             v.disk_type = self.disk_type
+            v.health = self.health
             self.volumes[vid] = v
             return v
 

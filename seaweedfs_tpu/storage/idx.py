@@ -60,6 +60,36 @@ def parse_index_arrays(path: str | os.PathLike):
     return keys, offsets, sizes
 
 
+def heal_index_tail(path: str | os.PathLike) -> int:
+    """Truncate a torn trailing PARTIAL entry (a crash mid-put leaves
+    size % 16 != 0).  Readers already ignore the partial tail, but an
+    append landing after it would misalign every later entry — so the
+    writer path must drop it first.  -> the healed file size."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return 0
+    healed = size - size % t.NEEDLE_MAP_ENTRY_SIZE
+    if healed != size:
+        with open(path, "r+b") as f:
+            f.truncate(healed)
+    return healed
+
+
+def append_index_tombstone(path: str | os.PathLike, key: int) -> None:
+    """Record that `key`'s last index entry is dead (load-time healer:
+    its .dat record was truncated away).  Without this, the stale entry
+    would resurface on the NEXT load and claim whatever new record was
+    appended at the reclaimed offset — truncating an acked write."""
+    if not os.path.exists(path):
+        return
+    heal_index_tail(path)
+    with open(path, "ab") as f:
+        f.write(t.pack_index_entry(key, 0, t.TOMBSTONE_FILE_SIZE))
+        f.flush()
+        os.fsync(f.fileno())
+
+
 class IndexWriter:
     """Append-only .idx writer.
 
@@ -72,16 +102,40 @@ class IndexWriter:
     """
 
     def __init__(self, path: str | os.PathLike):
+        self.path = os.fspath(path)
+        heal_index_tail(self.path)  # never append after a torn entry
         self._f: io.BufferedWriter = open(path, "ab")
 
-    def put(self, key: int, actual_offset: int, size: int) -> None:
-        self._f.write(t.pack_index_entry(key, actual_offset, size))
+    def _write(self, entry: bytes) -> None:
+        # the disk.write faultpoint family covers index appends too —
+        # a torn .idx entry is exactly what a crash mid-put leaves, and
+        # the loader must shrug it off (walk drops the partial tail)
+        from .disk_health import inject_write_fault
+
+        entry = inject_write_fault(self.path, self._f, self._f.tell(),
+                                   entry)
+        self._f.write(entry)
         self._f.flush()
+
+    def put(self, key: int, actual_offset: int, size: int) -> None:
+        self._write(t.pack_index_entry(key, actual_offset, size))
 
     def delete(self, key: int, actual_offset: int) -> None:
         """Tombstone entry: offset of the delete marker, size -1."""
-        self._f.write(t.pack_index_entry(key, actual_offset, t.TOMBSTONE_FILE_SIZE))
+        self._write(t.pack_index_entry(key, actual_offset,
+                                       t.TOMBSTONE_FILE_SIZE))
+
+    def tell(self) -> int:
+        """Current append position (rollback point for a failed
+        volume mutation)."""
         self._f.flush()
+        return self._f.tell()
+
+    def truncate(self, size: int) -> None:
+        """Roll a failed append back to a previous tell()."""
+        self._f.flush()
+        self._f.truncate(size)
+        self._f.seek(size)
 
     def flush(self) -> None:
         self._f.flush()

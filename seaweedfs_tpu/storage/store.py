@@ -29,6 +29,7 @@ from .ec.shard_bits import ShardBits
 from .ec.volume import EcVolume
 from .needle import CorruptNeedleError, Needle
 from ..util.chunk_cache import NeedleCache
+from .disk_health import DiskFailingError, DiskFullError
 from .replica_placement import ReplicaPlacement
 from .super_block import CURRENT_VERSION, SuperBlock
 from .ttl import TTL
@@ -89,6 +90,11 @@ class Store:
         # server installs its Scrubber here; the read path feeds CRC
         # failures into its quarantine + confirm queue
         self.scrubber = None
+        # disk-fault plane: fired after a classified write fault (or a
+        # watermark state change) so the volume server can push a full
+        # heartbeat NOW instead of on the next pulse — the master must
+        # stop assigning to a full disk within one beat
+        self.on_disk_event = None
         # hot-needle cache: repeated small-file GETs skip needle-map
         # lookup, disk read and CRC parse.  Per-store (never process
         # global: two in-process test clusters may reuse volume ids);
@@ -227,7 +233,47 @@ class Store:
         if v is None:
             return False
         v.read_only = False
+        v.read_only_reason = ""
         return True
+
+    # -- disk-fault survival plane ----------------------------------------
+
+    def apply_disk_health(self) -> list:
+        """Poll every location's watermark state machine and reconcile
+        volume writability with it: a full/failing disk flips its
+        volumes read-only-full (reads keep serving); a recovered disk
+        flips back exactly the volumes the fault plane froze — an
+        operator's or the lifecycle plane's read-only stays.
+        -> [DiskHealth snapshot per location], heartbeat-ready."""
+        snaps = []
+        for loc in self.locations:
+            h = loc.health
+            state = h.poll()
+            writable = state not in ("full", "failing")
+            with loc._lock:
+                for v in loc.volumes.values():
+                    if not writable:
+                        if not v.read_only and not v.is_remote:
+                            v.read_only = True
+                            v.read_only_reason = "full"
+                    elif v.read_only and v.read_only_reason == "full":
+                        v.read_only = False
+                        v.read_only_reason = ""
+            snaps.append(h.snapshot())
+        return snaps
+
+    def note_write_fault(self, vid: int) -> None:
+        """A volume mutation just failed with a typed disk error: the
+        volume already flipped read-only-full; re-poll the watermarks
+        (the whole location may be full) and wake the heartbeat so the
+        master re-routes within one beat, not one pulse."""
+        self.apply_disk_health()
+        cb = self.on_disk_event
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — never fail the write path
+                pass
 
     # -- needle ops -------------------------------------------------------
 
@@ -242,7 +288,11 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
-        _offset, size = v.append_needle(n)
+        try:
+            _offset, size = v.append_needle(n)
+        except (DiskFullError, DiskFailingError):
+            self.note_write_fault(vid)
+            raise
         self.invalidate_needle(vid, n.id)
         return size
 
@@ -298,7 +348,11 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
-        freed = v.delete_needle(needle_id)
+        try:
+            freed = v.delete_needle(needle_id)
+        except (DiskFullError, DiskFailingError):
+            self.note_write_fault(vid)
+            raise
         self.invalidate_needle(vid, needle_id)
         return freed
 
@@ -327,11 +381,14 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             raise KeyError(f"volume {vid} not found")
-        if v.is_remote or v._tier_in_progress:
-            # compacting would swap the .dat under a remote placement
-            # (or under an in-flight tier upload reading it by path)
+        if (v.is_remote or v._tier_in_progress
+                or getattr(v, "_ec_encode_in_progress", False)):
+            # compacting would swap the .dat under a remote placement,
+            # an in-flight tier upload, or an EC generate — all of
+            # which read the files by path
             raise ValueError(
-                f"volume {vid} is remote-tiered or tiering; not compactable")
+                f"volume {vid} is remote-tiered, tiering or EC-encoding;"
+                " not compactable")
         on_corrupt = None
         if self.scrubber is not None:
             # a needle the copy skipped as rotten leaves the compacted
@@ -381,16 +438,24 @@ class Store:
             raise KeyError(f"volume {vid} not found")
         base = v.file_name()
         v.sync()
-        requested = codec_name or self.codec_name
-        effective, reason = effective_codec(requested)
-        if reason:
-            glog.warning(
-                "ec.encode vol=%d: codec %s unreachable (%s), using %s",
-                vid, requested, reason, effective)
-        write_ec_files(base, codec_name=requested)
-        write_sorted_file_from_idx(base)
-        save_volume_info(base + ".vif", v.version,
-                         dat_file_size=os.path.getsize(base + ".dat"))
+        # the encoder reads .dat/.idx BY PATH: a vacuum commit swapping
+        # them mid-generation (possible since the emergency path may
+        # force-vacuum read-only volumes) would mix pre- and post-
+        # compact offsets into the shards — mutual exclusion both ways
+        v._ec_encode_in_progress = True
+        try:
+            requested = codec_name or self.codec_name
+            effective, reason = effective_codec(requested)
+            if reason:
+                glog.warning(
+                    "ec.encode vol=%d: codec %s unreachable (%s), using %s",
+                    vid, requested, reason, effective)
+            write_ec_files(base, codec_name=requested)
+            write_sorted_file_from_idx(base)
+            save_volume_info(base + ".vif", v.version,
+                             dat_file_size=os.path.getsize(base + ".dat"))
+        finally:
+            v._ec_encode_in_progress = False
 
     def rebuild_ec_shards(self, vid: int, collection: str,
                           codec_name: str | None = None,
@@ -578,6 +643,9 @@ class Store:
         )
 
     def collect_heartbeat(self) -> master_pb2.Heartbeat:
+        # reconcile writability with the watermarks FIRST, so this
+        # beat's read_only bits already reflect a just-filled disk
+        disk_snaps = self.apply_disk_health()
         hb = master_pb2.Heartbeat(
             ip=self.ip,
             port=self.port,
@@ -619,6 +687,16 @@ class Store:
                     shard_size=shard_size,
                 )
         hb.max_file_key = max_key
+        # per-disk health rides every full beat: free/total bytes + the
+        # state machine verdict — the master gates assignment, triggers
+        # emergency vacuum (low_space) and proactive evacuation (failing)
+        for snap in disk_snaps:
+            hb.disk_health.add(
+                dir=snap["dir"],
+                state=snap["state"],
+                free_bytes=snap["free_bytes"],
+                total_bytes=snap["total_bytes"],
+            )
         for k, c in self.max_volume_counts.items():
             hb.max_volume_counts[k] = c
         if not hb.volumes:

@@ -94,11 +94,17 @@ def commit_compact(volume: Volume, snapshot_end: int) -> None:
             volume.collection,
             volume.volume_id,
         )
+        # location-scoped attributes survive the in-place re-init: the
+        # disk's health machine and tier must keep feeding the same
+        # state after a compaction swaps the files underneath
+        health, disk_type = volume.health, volume.disk_type
         volume.close()
         os.replace(cpd, base + ".dat")
         os.replace(cpx, base + ".idx")
         # reopen in place: swap internals from a freshly loaded volume
         volume.__init__(directory, collection, vid)
+        volume.health = health
+        volume.disk_type = disk_type
 
 
 def _replay_tail(volume: Volume, base: str, cpd: str, cpx: str,

@@ -22,9 +22,10 @@ import threading
 import time
 
 from . import types as t
-from ..util import faultpoint
+from ..util import faultpoint, glog
 from .backend import DiskFile, get_backend
-from .idx import IndexWriter, walk_index_file
+from .disk_health import DiskFullError, classify_write_error
+from .idx import IndexWriter, append_index_tombstone, walk_index_file
 from .needle import Needle, actual_size, body_length
 from .needle_map import NeedleMap
 
@@ -60,7 +61,14 @@ class Volume:
         self.volume_id = volume_id
         self.disk_type = ""  # normalized; "" == hdd (set by DiskLocation)
         self.read_only = False
+        # why the volume is read-only: "" (operator/seal), or "full"
+        # (disk-fault plane: flips back writable when space returns)
+        self.read_only_reason = ""
+        # the DiskLocation's DiskHealth (set by DiskLocation); write
+        # errors feed its state machine
+        self.health = None
         self._tier_in_progress = False
+        self._ec_encode_in_progress = False
         self._lock = threading.RLock()
         # bumped on every append/delete (and fresh on vacuum re-init):
         # the needle cache's compare-before-put token (store.py)
@@ -143,27 +151,92 @@ class Volume:
 
     # -- write path -------------------------------------------------------
 
+    def _check_writable(self, for_delete: bool = False) -> None:
+        if not self.read_only:
+            return
+        if self.read_only_reason == "full":
+            if for_delete:
+                # deletes FREE space and a tombstone is ~40 bytes: they
+                # run against the reserved watermark headroom (the disk
+                # flipped full while min-free bytes remained), otherwise
+                # a full disk could never be drained back to healthy
+                return
+            raise DiskFullError(
+                28, f"volume {self.volume_id} is full (read-only-full)")
+        raise PermissionError(f"volume {self.volume_id} is read-only")
+
+    def _fail_write(self, e: OSError, start: int,
+                    idx_pos: int | None = None) -> OSError:
+        """Roll a failed mutation back to a consistent pre-write state:
+        truncate the .dat to `start` (dropping any torn blob bytes the
+        failed write landed) and the .idx to `idx_pos`; feed the error
+        into the disk health machine; flip read-only-full on ENOSPC.
+        Returns the typed error to raise (DiskFullError/DiskFailingError).
+        No in-memory index entry exists for the unacked bytes — callers
+        only publish to the needle map after every durable write
+        succeeded."""
+        typed = classify_write_error(e, self._dat.name)
+        try:
+            self._dat.truncate(start)
+        except OSError as e2:  # rollback itself failed: disk is dying
+            glog.warning("volume %d: rollback truncate to %d failed: %s "
+                         "(load-time healer will truncate on remount)",
+                         self.volume_id, start, e2)
+        if idx_pos is not None:
+            try:
+                self._idx.truncate(idx_pos)
+            except OSError:
+                pass  # a torn trailing idx entry is dropped by the loader
+        if self.health is not None:
+            self.health.record_write_error(typed)
+        if isinstance(typed, DiskFullError):
+            # read-only-full: reads keep serving, writers get the typed
+            # 409 and re-assign; mark_writable/space recovery clears it
+            self.read_only = True
+            self.read_only_reason = "full"
+        return typed
+
     def append_needle(self, n: Needle) -> tuple[int, int]:
-        """Append; returns (actual_offset, stored_size)."""
+        """Append; returns (actual_offset, stored_size).
+
+        Crash/fault discipline: the needle map and .idx are only updated
+        after the .dat blob landed in full; any OSError rolls the .dat
+        back to its pre-append size and surfaces as a typed
+        DiskFullError/DiskFailingError — a mid-blob ENOSPC can never
+        leave a published index entry pointing at a torn tail."""
         with self._lock:
-            if self.read_only:
-                raise PermissionError(f"volume {self.volume_id} is read-only")
-            offset = self._dat.file_size()
-            if offset % t.NEEDLE_PADDING_SIZE:  # heal torn tail
-                pad = t.NEEDLE_PADDING_SIZE - offset % t.NEEDLE_PADDING_SIZE
-                self._dat.write_at(offset, b"\0" * pad)
-                offset += pad
-            if offset >= t.MAX_POSSIBLE_VOLUME_SIZE:
+            self._check_writable()
+            start = self._dat.file_size()
+            offset = start
+            pad = -offset % t.NEEDLE_PADDING_SIZE  # heal torn tail
+            if offset + pad >= t.MAX_POSSIBLE_VOLUME_SIZE:
                 raise IOError("volume size limit exceeded")
-            if not n.append_at_ns:
-                n.append_at_ns = time.time_ns()
-            self.last_modified_second = int(time.time())
-            blob = n.to_bytes(self.version)
-            self._dat.write_at(offset, blob)
+            try:
+                if pad:
+                    self._dat.write_at(offset, b"\0" * pad)
+                    offset += pad
+                if not n.append_at_ns:
+                    n.append_at_ns = time.time_ns()
+                self.last_modified_second = int(time.time())
+                blob = n.to_bytes(self.version)
+                wrote = self._dat.write_at(offset, blob)
+                if wrote != len(blob):
+                    raise OSError(
+                        5, f"short write: {wrote}/{len(blob)} bytes")
+            except OSError as e:
+                raise self._fail_write(e, start) from e
             old = self.needle_map.get(n.id)
             if old is None or old.offset < offset:
+                idx_pos = self._idx.tell()
+                try:
+                    self._idx.put(n.id, offset, n.size)
+                except OSError as e:
+                    # the blob is durable but unindexed: roll BOTH back —
+                    # an acked write must be remount-provable via the .idx
+                    raise self._fail_write(e, start, idx_pos) from e
                 self.needle_map.put(n.id, offset, n.size)
-                self._idx.put(n.id, offset, n.size)
+            if self.health is not None:
+                self.health.record_write_ok()
             self.write_seq = next(_MUTATION_SEQ)
             return offset, n.size
 
@@ -176,17 +249,36 @@ class Volume:
         mirrors) — a locally-stamped tombstone would poison tail
         watermarks under clock skew."""
         with self._lock:
-            if self.read_only:
-                raise PermissionError(f"volume {self.volume_id} is read-only")
+            self._check_writable(for_delete=True)
             existing = self.needle_map.get(needle_id)
             if existing is None:
                 return 0
             marker = Needle(id=needle_id, cookie=0, data=b"")
-            offset = self._dat.file_size()
+            start = self._dat.file_size()
+            offset = start
+            # tombstones grow the log too: the offset cap append_needle
+            # enforces guards index addressability (offsets store /8 in
+            # 32 bits), so a full-size volume must not creep past it
+            # via deletes either
+            if offset >= t.MAX_POSSIBLE_VOLUME_SIZE:
+                raise IOError("volume size limit exceeded")
             marker.append_at_ns = at_ns or time.time_ns()
-            self._dat.write_at(offset, marker.to_bytes(self.version))
+            blob = marker.to_bytes(self.version)
+            try:
+                wrote = self._dat.write_at(offset, blob)
+                if wrote != len(blob):
+                    raise OSError(
+                        5, f"short write: {wrote}/{len(blob)} bytes")
+            except OSError as e:
+                raise self._fail_write(e, start) from e
+            idx_pos = self._idx.tell()
+            try:
+                self._idx.delete(needle_id, offset)
+            except OSError as e:
+                raise self._fail_write(e, start, idx_pos) from e
             self.needle_map.delete(needle_id)
-            self._idx.delete(needle_id, offset)
+            if self.health is not None:
+                self.health.record_write_ok()
             self.last_modified_second = int(time.time())
             self.write_seq = next(_MUTATION_SEQ)
             return max(existing.size, 0)
@@ -377,8 +469,15 @@ class Volume:
                 raise IOError(
                     f"volume {self.volume_id}: remote .dat shorter than index"
                 )
-            # torn append: drop the entry and truncate to the previous record
+            if self._repad_torn_tail(last, file_size, end):
+                return
+            # torn append: drop the entry and truncate to the previous
+            # record.  The drop must ALSO reach the on-disk .idx (as a
+            # tombstone): the stale entry would otherwise resurface on
+            # the next load and claim whatever new record gets appended
+            # at the reclaimed offset — truncating an acked write
             self.needle_map.delete(last.key)
+            append_index_tombstone(self.file_name() + ".idx", last.key)
             self._dat.truncate(last.offset)
             return
         hdr = self._dat.read_at(last.offset, t.NEEDLE_HEADER_SIZE)
@@ -386,3 +485,32 @@ class Volume:
             n = Needle.parse_header(hdr)
             if n.id != last.key:
                 self.needle_map.delete(last.key)
+                append_index_tombstone(
+                    self.file_name() + ".idx", last.key)
+
+    def _repad_torn_tail(self, last, file_size: int, end: int) -> bool:
+        """Tear-at-padding-boundary heal: when ONLY trailing padding
+        bytes of the last record are missing (every real byte — header,
+        body, checksum, v3 timestamp — is present and CRC-clean), the
+        acked needle is intact; dropping it would turn a cosmetic tear
+        into acked-write loss.  Re-pad the file to the aligned end
+        instead.  -> True when healed."""
+        from .needle import padding_length
+
+        have = file_size - last.offset
+        size = max(last.size, 0)
+        unpadded = (actual_size(size, self.version)
+                    - padding_length(size, self.version))
+        if have < unpadded:
+            return False  # real bytes missing: a genuine torn append
+        try:
+            blob = self._dat.read_at(last.offset, have)
+            n = Needle.from_bytes(blob, self.version)
+        except (ValueError, struct.error, OSError):
+            return False
+        if n.id != last.key or n.size != last.size:
+            return False
+        self._dat.write_at(file_size, b"\0" * (end - file_size))
+        glog.info("volume %d: re-padded torn tail (%d pad bytes) for "
+                  "needle %x", self.volume_id, end - file_size, last.key)
+        return True

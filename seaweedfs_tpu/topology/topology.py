@@ -67,8 +67,33 @@ class DataNode:
     # per-disk-type capacity from the heartbeat's max_volume_counts map
     # (reference: Disk nodes under DataNode); empty -> one default tier
     max_volume_counts: dict = field(default_factory=dict)
+    # disk-fault plane: dir -> {"state", "free_bytes", "total_bytes"}
+    # from the heartbeat's DiskHealthMessage list; empty = unknown
+    # (legacy node), treated as healthy
+    disk_health: dict = field(default_factory=dict)
+
+    def worst_disk_state(self) -> str:
+        """The most degraded state across this node's data dirs
+        ("healthy" when the node reports nothing)."""
+        order = {"healthy": 0, "low_space": 1, "full": 2, "failing": 3}
+        worst = "healthy"
+        for d in self.disk_health.values():
+            s = d.get("state", "healthy")
+            if order.get(s, 0) > order[worst]:
+                worst = s
+        return worst
+
+    def has_writable_disk(self) -> bool:
+        """False when EVERY reported disk is full or failing: growth and
+        rebuild placement must not target this node."""
+        if not self.disk_health:
+            return True
+        return any(d.get("state") in ("healthy", "low_space", None)
+                   for d in self.disk_health.values())
 
     def free_slots(self) -> int:
+        if not self.has_writable_disk():
+            return 0
         return self.max_volumes - len(self.volumes) - (len(self.ec_shards) + 9) // 10
 
     def disk_types(self) -> list[str]:
@@ -77,7 +102,11 @@ class DataNode:
 
     def free_slots_for(self, disk_type: str) -> int:
         """Free volume slots on one disk tier (capacityByFreeVolumeCount,
-        command_ec_common.go / command_volume_tier_move.go)."""
+        command_ec_common.go / command_volume_tier_move.go).  A node
+        whose disks are all full/failing has no free slots on ANY tier —
+        the watermark gates placement before ENOSPC can."""
+        if not self.has_writable_disk():
+            return 0
         cap = self.max_volume_counts.get(disk_type)
         if cap is None:
             if disk_type == "" and not self.max_volume_counts:
@@ -89,6 +118,8 @@ class DataNode:
         return cap - used
 
     def free_ec_slots(self) -> int:
+        if not self.has_writable_disk():
+            return 0
         used = sum(ShardBits(b).count() for b in self.ec_shards.values())
         return (self.max_volumes - len(self.volumes)) * 10 - used
 

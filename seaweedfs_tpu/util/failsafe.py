@@ -375,6 +375,28 @@ def _trace_id() -> str | None:
     return trace.current_trace_id()
 
 
+def _breaker_judges_failure(e: BaseException) -> bool:
+    """Whether an exception counts against the peer's circuit breaker.
+
+    An HTTP 4xx is a full answer from a live, healthy peer — a typed
+    409 volume-full, a 404 stale location, a 403 auth miss say nothing
+    about its availability.  Opening the breaker on them makes ONE full
+    volume fail fast every other request to that server for the reset
+    window (observed live: a burst of volume-full 409s opened the
+    breaker and re-assigned uploads died on "circuit open" instead of
+    landing on the server's other volumes).  5xx and transport errors
+    still count — that is what the breaker is for."""
+    return not (isinstance(e, urllib.error.HTTPError)
+                and 400 <= e.code < 500)
+
+
+def _breaker_record(br, e: BaseException) -> None:
+    if _breaker_judges_failure(e):
+        br.record_failure()
+    else:
+        br.record_success()  # the peer answered: it is alive
+
+
 def _sleep_backoff(policy: RetryPolicy, attempt: int,
                    rng: random.Random | None = None) -> None:
     delay = policy.delay(attempt, rng)
@@ -420,7 +442,7 @@ def call(
                     # the breaker wedges open forever
                     br.release_probe()
                 else:
-                    br.record_failure()
+                    _breaker_record(br, e)
             reason, retryable = classify(e, idempotent)
             last = e
             if not retryable or attempt + 1 >= policy.max_attempts:
@@ -485,7 +507,7 @@ def call_with_failover(
                 br.release_probe()
                 raise
             except BaseException as e:  # noqa: BLE001 - classified below
-                br.record_failure()
+                _breaker_record(br, e)
                 if on_peer_failure is not None:
                     on_peer_failure(peer, e)
                 reason, _retryable = classify(e, idempotent)
@@ -507,7 +529,7 @@ def call_with_failover(
                 breaker_for(key(peer)).release_probe()
                 raise
             except BaseException as e:  # noqa: BLE001
-                breaker_for(key(peer)).record_failure()
+                _breaker_record(breaker_for(key(peer)), e)
                 if on_peer_failure is not None:
                     on_peer_failure(peer, e)
                 reason, _retryable = classify(e, idempotent)

@@ -780,9 +780,12 @@ class VolumeGrpcService:
     def VolumeServerStatus(self, request, context):
         resp = vs.VolumeServerStatusResponse()
         for loc in self.store.locations:
-            st = os.statvfs(loc.directory)
-            all_b = st.f_blocks * st.f_frsize
-            free_b = st.f_bavail * st.f_frsize
+            # one statvfs wrapper for the whole process: the health
+            # machine's poll refreshes its state + gauges on the way
+            loc.health.poll()
+            snap = loc.health.snapshot()
+            all_b = snap["total_bytes"]
+            free_b = snap["free_bytes"]
             used_b = all_b - free_b
             resp.disk_statuses.add(
                 dir=loc.directory,

@@ -20,12 +20,14 @@ from .. import images
 from ..security.jwt import token_from_header, verify_write_jwt
 from ..telemetry import http_request, serve_debug_http
 from ..storage.file_id import FileId
+from ..storage.disk_health import DiskFailingError, DiskFullError
 from ..storage.needle import (
     FLAG_HAS_MIME,
     FLAG_HAS_NAME,
     CorruptNeedleError,
     Needle,
 )
+from ..stats.metrics import VOLUME_FULL_REJECT
 from ..util import faultpoint
 
 # chaos points on the public data path; ctx is this server's host:port so
@@ -327,12 +329,30 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
             size = self.store.write_needle(fid.volume_id, n)
         except KeyError:
             return self._send_json(404, {"error": f"volume {fid.volume_id} not found"})
+        except DiskFullError as e:
+            # typed 409: the volume/disk is full — a 4xx so no layer
+            # retries HERE; the client re-assigns to a different volume
+            # immediately (not on the next heartbeat)
+            VOLUME_FULL_REJECT.inc()
+            return self._send_json(
+                409, {"error": str(e), "volumeFull": True})
+        except DiskFailingError as e:
+            # retryable 5xx: replicas/another assign absorb it while the
+            # health machine counts the EIO toward evacuation
+            return self._send_json(500, {"error": str(e)})
         except PermissionError as e:
             return self._send_json(403, {"error": str(e)})
         # replicate to peers unless this IS a replicated write
         if "replicate" not in qs.get("type", []):
             err = self.volume_server.replicate_write(fid, self.path, body, self.headers)
             if err:
+                if "status 409" in err:
+                    # a replica's disk filled: surface the same typed
+                    # re-assign signal, not an opaque 500
+                    VOLUME_FULL_REJECT.inc()
+                    return self._send_json(
+                        409, {"error": f"replication: {err}",
+                              "volumeFull": True})
                 return self._send_json(500, {"error": f"replication: {err}"})
         self._send_json(201, {"name": name.decode(errors="replace"), "size": int(size), "eTag": f"{n.checksum:x}"})
 
@@ -376,6 +396,11 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
             size = self.store.delete_needle(fid.volume_id, fid.key)
         except KeyError:
             return self._send_json(404, {"error": "not found"})
+        except (DiskFullError, DiskFailingError) as e:
+            # retryable 5xx, NOT the write path's 409: "re-assign" is
+            # meaningless for a delete — the client's failover sends it
+            # to a replica, whose fan-out tombstones this copy too
+            return self._send_json(500, {"error": str(e)})
         except CorruptNeedleError as e:
             # cannot cookie-check rotten bytes; the retryable error sends
             # the delete to a healthy replica, whose fan-out tombstones

@@ -134,6 +134,12 @@ class VolumeServer:
         self._loc_caches: "weakref.WeakSet" = weakref.WeakSet()
         self._loc_caches_lock = threading.Lock()
         self._dead_node_seq = 0
+        # disk-fault plane: a classified write fault (ENOSPC/EIO) sets
+        # this so the heartbeat generator pushes a full beat NOW — the
+        # master must stop assigning to the full disk within one beat,
+        # not one pulse later
+        self._beat_now = threading.Event()
+        self.store.on_disk_event = self._beat_now.set
 
     # -- lifecycle --------------------------------------------------------
 
@@ -266,7 +272,7 @@ class VolumeServer:
             yield self._with_stats(self.store.collect_heartbeat())
             last_full = time.monotonic()
             while not self._stop.is_set():
-                time.sleep(min(self.pulse_seconds / 3, 1.0))
+                self._beat_now.wait(min(self.pulse_seconds / 3, 1.0))
                 nv, dv, ne, de = self.store.drain_deltas()
                 if nv or dv or ne or de:
                     yield master_pb2.Heartbeat(
@@ -278,7 +284,13 @@ class VolumeServer:
                         new_ec_shards=ne,
                         deleted_ec_shards=de,
                     )
-                if time.monotonic() - last_full >= self.pulse_seconds:
+                beat_now = self._beat_now.is_set()
+                if (beat_now or time.monotonic() - last_full
+                        >= self.pulse_seconds):
+                    # a disk-fault event forces the full beat early: the
+                    # read_only/disk_health bits must reach the master
+                    # before the next client write lands on the full disk
+                    self._beat_now.clear()
                     last_full = time.monotonic()
                     self.update_gauges()
                     yield self._with_stats(self.store.collect_heartbeat())

@@ -1,0 +1,294 @@
+"""Disk-fault survival plane cluster acceptance (ISSUE 14): ENOSPC on
+one volume server's disk under concurrent writers — zero acked-write
+loss, typed-409 re-assign with zero steady-state client 5xx, master
+stops assigning to the full disk within one heartbeat — plus the
+failing-disk proactive-evacuation trigger and the low-space emergency
+vacuum reaction."""
+
+from __future__ import annotations
+
+import errno
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from helpers import free_port
+
+from seaweedfs_tpu.master.server import MasterServer
+from seaweedfs_tpu.operation.assign import assign_any
+from seaweedfs_tpu.operation.upload import VolumeFullError, upload_data
+from seaweedfs_tpu.util import faultpoint
+from seaweedfs_tpu.volume.server import VolumeServer
+
+GB = 1 << 30
+MB = 1 << 20
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture()
+def cluster(tmp_path_factory):
+    mport = free_port()
+    master = MasterServer(ip="127.0.0.1", port=mport,
+                          volume_size_limit_mb=64)
+    master.start()
+    servers, dirs = [], []
+    for i in range(3):
+        d = tmp_path_factory.mktemp(f"dfvol{i}")
+        vs_ = VolumeServer(
+            directories=[str(d)],
+            master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+            ip="127.0.0.1",
+            port=free_port(),
+            pulse_seconds=0.5,
+            max_volume_count=50,
+        )
+        vs_.start()
+        servers.append(vs_)
+        dirs.append(str(d))
+    deadline = time.time() + 15
+    while time.time() < deadline and len(master.topo.nodes) < 3:
+        time.sleep(0.1)
+    assert len(master.topo.nodes) == 3
+    try:
+        yield master, servers, dirs
+    finally:
+        faultpoint.clear_fault("all")
+        for s in servers:
+            s.stop()
+        master.stop()
+
+
+def _get(url: str) -> tuple[int, bytes]:
+    try:
+        with urllib.request.urlopen(f"http://{url}", timeout=10) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _put_with_reassign(master_grpc: str, payload: bytes,
+                       stats: dict, lock: threading.Lock):
+    """The filer's discipline: assign -> upload -> on failure re-assign.
+    -> the final fid/url, or raises after bounded rounds."""
+    last = None
+    for _ in range(6):
+        res = assign_any([master_grpc], count=1)
+        try:
+            upload_data(res.fid_url(), payload, filename="t.bin",
+                        retries=1)
+            return res
+        except VolumeFullError as e:
+            last = e
+            with lock:
+                stats["full_409"] += 1
+            continue  # typed 409: immediate re-assign, no backoff
+        except Exception as e:  # noqa: BLE001
+            last = e
+            with lock:
+                stats["other_fail"] += 1
+            time.sleep(0.1)
+            continue
+    raise AssertionError(f"write failed after re-assigns: {last}")
+
+
+def test_enospc_chaos_survival(cluster):
+    master, servers, dirs = cluster
+    master_grpc = f"127.0.0.1:{master.grpc_port}"
+    victim, victim_dir = servers[0], dirs[0]
+
+    # baseline traffic so every server grows volumes
+    stats = {"full_409": 0, "other_fail": 0}
+    lock = threading.Lock()
+    baseline = {}
+    for i in range(8):
+        payload = b"base-%d" % i * 50
+        res = _put_with_reassign(master_grpc, payload, stats, lock)
+        baseline[res.fid] = (res.url, payload)
+
+    # the victim's disk "fills": statvfs reports almost nothing free
+    # AND the backend throws torn mid-blob ENOSPC writes (the
+    # disk.write.enospc faultpoint, scoped to the victim's data dir)
+    victim.store.locations[0].health._statvfs = (
+        lambda _d: (100 * GB, 10 * MB))
+    faultpoint.set_fault("disk.write.enospc", "error", count=-1,
+                         match=victim_dir)
+
+    # concurrent writers keep hammering through the fault window
+    written: dict = {}
+    wlock = threading.Lock()
+    errors: list = []
+
+    def writer(w: int) -> None:
+        for i in range(12):
+            payload = (b"w%d-%d-" % (w, i)) * 60
+            try:
+                res = _put_with_reassign(master_grpc, payload, stats,
+                                         lock)
+            except AssertionError as e:
+                errors.append(str(e))
+                return
+            with wlock:
+                written[res.fid] = (res.url, payload)
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+    # zero steady-state failures: every logical write eventually landed
+    assert not errors, errors
+    assert len(written) == 4 * 12
+
+    # every acked write reads back byte-identical
+    for fid, (url, payload) in {**baseline, **written}.items():
+        code, body = _get(f"{url}/{fid}")
+        assert code == 200, (fid, code, body[:200])
+        assert body == payload, f"acked write {fid} not byte-identical"
+
+    # the master stopped assigning to the full node (one heartbeat was
+    # forced by the first classified write fault): a fresh burst of
+    # assigns must all avoid it
+    victim_url = f"{victim.ip}:{victim.port}"
+    time.sleep(1.5)  # > one pulse: the full beat definitely landed
+    for _ in range(20):
+        res = assign_any([master_grpc], count=1)
+        assert res.url != victim_url, (
+            "master still assigns to the full disk")
+
+    # the typed 409 actually drove re-assigns (the fault fired) and the
+    # cluster sees the disk as full
+    node = master.topo.nodes.get(victim_url)
+    assert node is not None and node.worst_disk_state() == "full"
+
+    # recovery: space returns -> volumes unfreeze -> assignment resumes
+    faultpoint.clear_fault("disk.write.enospc")
+    victim.store.locations[0].health._statvfs = (
+        lambda _d: (100 * GB, 50 * GB))
+    deadline = time.time() + 10
+    recovered = False
+    while time.time() < deadline and not recovered:
+        recovered = node.worst_disk_state() == "healthy"
+        time.sleep(0.2)
+    assert recovered, "full state did not clear after space returned"
+    res = _put_with_reassign(master_grpc, b"post-recovery" * 20, stats,
+                             lock)
+    code, body = _get(f"{res.url}/{res.fid}")
+    assert code == 200 and body == b"post-recovery" * 20
+
+
+def test_failing_disk_triggers_evacuation(cluster):
+    """K EIOs flip the disk to `failing`; the heartbeat carries it and
+    the master proactively copies the node's sole-copy volumes to a
+    healthy peer BEFORE the disk dies."""
+    master, servers, dirs = cluster
+    master_grpc = f"127.0.0.1:{master.grpc_port}"
+    stats = {"full_409": 0, "other_fail": 0}
+    lock = threading.Lock()
+
+    # land at least one volume on every node, then find a volume whose
+    # ONLY copy lives on the victim
+    fids = [
+        _put_with_reassign(master_grpc, b"evac-%d" % i * 40, stats, lock)
+        for i in range(10)
+    ]
+    # victim: any server that actually holds a volume (growth placement
+    # is free to leave some node empty)
+    victim = next(
+        s for s in servers
+        if any(loc.volumes for loc in s.store.locations))
+    victim_url = f"{victim.ip}:{victim.port}"
+    victim_vids = sorted(
+        vid for loc in victim.store.locations for vid in loc.volumes)
+
+    # the victim's disk starts throwing EIOs: cross the K threshold
+    health = victim.store.locations[0].health
+    for _ in range(3):
+        health.record_write_error(OSError(errno.EIO, "injected"))
+    assert health.state == "failing"
+
+    # within a couple beats the master must have copied the victim's
+    # sole-copy volumes to a healthy node (the victim keeps its copy)
+    others = [s for s in servers if s is not victim]
+    deadline = time.time() + 20
+    evacuated = False
+    while time.time() < deadline and not evacuated:
+        for vid in victim_vids:
+            holders = [
+                s for s in others
+                if any(vid in loc.volumes for loc in s.store.locations)
+            ]
+            if holders:
+                evacuated = True
+                break
+        time.sleep(0.3)
+    assert evacuated, "no volume was evacuated off the failing disk"
+    assert master.mass_repair.status()["counts"]["evacuated"] >= 1
+
+    # reads still served throughout (victim alive, data intact)
+    for res in fids:
+        code, body = _get(f"{res.url}/{res.fid}")
+        assert code == 200
+
+    # the failing node is not a growth target: every fresh assign
+    # avoids it once the failing beat landed
+    time.sleep(1.0)
+    node = master.topo.nodes.get(victim_url)
+    assert node is not None and node.worst_disk_state() == "failing"
+    assert node.free_slots() == 0 and node.free_ec_slots() == 0
+
+
+def test_low_space_triggers_emergency_vacuum(cluster):
+    """A low_space heartbeat makes the lifecycle plane vacuum the
+    node's garbage immediately (quiet windows and policy ratios
+    bypassed)."""
+    master, servers, dirs = cluster
+    master_grpc = f"127.0.0.1:{master.grpc_port}"
+    stats = {"full_409": 0, "other_fail": 0}
+    lock = threading.Lock()
+
+    # build garbage: write then delete most of a volume's needles
+    results = [
+        _put_with_reassign(master_grpc, b"g%03d" % i * 500, stats, lock)
+        for i in range(12)
+    ]
+    victim = None
+    for s in servers:
+        if any(s.store.find_volume(int(r.fid.split(",")[0]))
+               for r in results):
+            victim = s
+            break
+    assert victim is not None
+    for r in results[:10]:
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{r.url}/{r.fid}", method="DELETE"), timeout=10
+        ).read()
+
+    before = {
+        vid: loc.volumes[vid].content_size
+        for loc in victim.store.locations
+        for vid in loc.volumes
+    }
+    # the victim reports low_space from now on
+    victim.store.locations[0].health._statvfs = (
+        lambda _d: (100 * GB, 2 * GB))
+
+    deadline = time.time() + 20
+    compacted = False
+    while time.time() < deadline and not compacted:
+        if master.lifecycle._counts.get("emergency", 0) > 0:
+            for loc in victim.store.locations:
+                for vid, v in loc.volumes.items():
+                    if v.content_size < before.get(vid, 0):
+                        compacted = True
+        time.sleep(0.3)
+    assert compacted, "emergency vacuum did not reclaim space"
+
+    # surviving needles byte-identical after the compaction
+    for i, r in enumerate(results[10:], start=10):
+        code, body = _get(f"{r.url}/{r.fid}")
+        assert code == 200 and body == b"g%03d" % i * 500

@@ -1,0 +1,251 @@
+"""Disk-fault survival plane units (ISSUE 14): the health state
+machine, typed error classification, ENOSPC append/delete rollback via
+the `disk.write` faultpoint family, the tombstone size cap, and the
+heartbeat's per-disk payload."""
+
+from __future__ import annotations
+
+import errno
+import os
+
+import pytest
+
+from helpers import make_volume
+
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.disk_health import (
+    DiskFailingError,
+    DiskFullError,
+    DiskHealth,
+    classify_write_error,
+    disk_stats,
+)
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.util import faultpoint
+
+GB = 1 << 30
+MB = 1 << 20
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    yield
+    faultpoint.clear_fault("all")
+
+
+def _health(free_seq, total=100 * GB, min_free_mb=64,
+            min_free_percent=1.0, eio_threshold=3):
+    """DiskHealth over a scripted statvfs: free_seq values are consumed
+    per poll (last value repeats)."""
+    seq = list(free_seq)
+
+    def fake(_dir):
+        return total, seq.pop(0) if len(seq) > 1 else seq[0]
+
+    return DiskHealth("/fake", min_free_mb=min_free_mb,
+                      min_free_percent=min_free_percent,
+                      eio_threshold=eio_threshold, statvfs=fake)
+
+
+# ---------------------------------------------------------------------------
+# state machine
+# ---------------------------------------------------------------------------
+
+
+def test_watermark_transitions():
+    # floor = max(64MB, 1% of 100GB) = 1GB; low-space = 4GB
+    h = _health([50 * GB, 3 * GB, 512 * MB, 2 * GB, 50 * GB])
+    assert h.poll() == "healthy"
+    assert h.poll() == "low_space"
+    assert h.poll() == "full"
+    assert not h.writable
+    assert h.poll() == "low_space"  # space freed above the floor
+    assert h.poll() == "healthy"
+    assert h.writable
+
+
+def test_enospc_forces_full_until_space_returns():
+    h = _health([50 * GB, 50 * GB])
+    assert h.poll() == "healthy"
+    h.record_write_error(OSError(errno.ENOSPC, "no space"))
+    assert h.state == "full"  # trusted over a stale statvfs
+    assert h.poll() == "healthy"  # poll shows room again: cleared
+
+
+def test_eio_threshold_failing_and_sticky():
+    h = _health([50 * GB], eio_threshold=3)
+    h.poll()
+    for _ in range(2):
+        h.record_write_error(OSError(errno.EIO, "io error"))
+        assert h.state != "failing"
+    h.record_write_error(OSError(errno.EIO, "io error"))
+    assert h.state == "failing"
+    # sticky: one good write (or a clean poll) does not un-fail a disk
+    h.record_write_ok()
+    assert h.poll() == "failing"
+    h.mark_repaired()
+    assert h.state == "healthy"
+
+
+def test_classify_write_error():
+    full = classify_write_error(OSError(errno.ENOSPC, "x"), "/d/1.dat")
+    assert isinstance(full, DiskFullError)
+    eio = classify_write_error(OSError(errno.EIO, "x"), "/d/1.dat")
+    assert isinstance(eio, DiskFailingError)
+
+
+def test_disk_stats_real_directory(tmp_path):
+    total, free = disk_stats(str(tmp_path))
+    assert total > 0 and 0 <= free <= total
+
+
+# ---------------------------------------------------------------------------
+# append/delete hardening via the disk.write faultpoint family
+# ---------------------------------------------------------------------------
+
+
+def _payload(i: int, size: int = 900) -> bytes:
+    return bytes((i * 31 + j) % 256 for j in range(size))
+
+
+def test_append_enospc_rolls_back_cleanly(tmp_path):
+    vol = make_volume(str(tmp_path), n_needles=3)
+    base = vol.file_name()
+    pre_dat = os.path.getsize(base + ".dat")
+    pre_idx = os.path.getsize(base + ".idx")
+    faultpoint.set_fault("disk.write.enospc", "error", count=1,
+                         match=base + ".dat")
+    with pytest.raises(DiskFullError):
+        vol.append_needle(Needle(cookie=1, id=50, data=_payload(50)))
+    # rollback: no torn tail on disk, no index entry (memory or .idx)
+    assert os.path.getsize(base + ".dat") == pre_dat
+    assert os.path.getsize(base + ".idx") == pre_idx
+    assert vol.needle_map.get(50) is None
+    # the volume flipped read-only-full with the typed error
+    assert vol.read_only and vol.read_only_reason == "full"
+    with pytest.raises(DiskFullError):
+        vol.append_needle(Needle(cookie=1, id=51, data=b"x"))
+    # remount: durability invariant holds, prior needles byte-identical
+    vol.close()
+    vol2 = Volume(str(tmp_path), "", 1)
+    assert vol2.read_needle(1).id == 1
+    with pytest.raises(KeyError):
+        vol2.read_needle(50)
+    # a fresh volume is writable again (the flip was in-memory state)
+    off, _size = vol2.append_needle(
+        Needle(cookie=1, id=52, data=_payload(52)))
+    assert vol2.read_needle(52).data == _payload(52)
+    assert off % t.NEEDLE_PADDING_SIZE == 0
+    vol2.close()
+
+
+def test_append_eio_rolls_back_and_counts(tmp_path):
+    vol = make_volume(str(tmp_path), n_needles=2)
+    base = vol.file_name()
+    pre = os.path.getsize(base + ".dat")
+    faultpoint.set_fault("disk.write.partial", "error", count=1,
+                         match=base + ".dat")
+    with pytest.raises(DiskFailingError):
+        vol.append_needle(Needle(cookie=1, id=9, data=_payload(9)))
+    assert os.path.getsize(base + ".dat") == pre
+    assert not vol.read_only  # EIO does not flip read-only-full
+    assert vol.health is None  # bare Volume: no location health attached
+    # next write goes through fine
+    vol.append_needle(Needle(cookie=1, id=9, data=_payload(9)))
+    assert vol.read_needle(9).data == _payload(9)
+    vol.close()
+
+
+def test_short_write_detected_and_rolled_back(tmp_path):
+    vol = make_volume(str(tmp_path), n_needles=2)
+    base = vol.file_name()
+    pre = os.path.getsize(base + ".dat")
+    # `short` models a lying device: write_at silently lands half
+    faultpoint.set_fault("disk.write.short", "partial", count=1,
+                         match=base + ".dat")
+    with pytest.raises(DiskFailingError):
+        vol.append_needle(Needle(cookie=1, id=9, data=_payload(9)))
+    assert os.path.getsize(base + ".dat") == pre
+    vol.close()
+
+
+def test_delete_enforces_volume_size_cap(tmp_path, monkeypatch):
+    vol = make_volume(str(tmp_path), n_needles=3)
+    # shrink the cap under the current file size: tombstones must be
+    # refused exactly like appends (offset addressability, not policy)
+    monkeypatch.setattr(t, "MAX_POSSIBLE_VOLUME_SIZE", 64)
+    with pytest.raises(IOError, match="size limit"):
+        vol.delete_needle(2)
+    assert vol.read_needle(2).id == 2  # nothing was tombstoned
+    vol.close()
+
+
+def test_delete_enospc_rolls_back(tmp_path):
+    vol = make_volume(str(tmp_path), n_needles=3)
+    base = vol.file_name()
+    pre = os.path.getsize(base + ".dat")
+    faultpoint.set_fault("disk.write.enospc", "error", count=1,
+                         match=base + ".dat")
+    with pytest.raises(DiskFullError):
+        vol.delete_needle(2)
+    assert os.path.getsize(base + ".dat") == pre
+    assert vol.read_needle(2).id == 2  # still live: the delete failed
+    # deletes are allowed on a read-only-FULL volume (they free space)
+    assert vol.read_only and vol.read_only_reason == "full"
+    assert vol.delete_needle(2) > 0
+    with pytest.raises(KeyError):
+        vol.read_needle(2)
+    # appends stay refused
+    with pytest.raises(DiskFullError):
+        vol.append_needle(Needle(cookie=1, id=77, data=b"x"))
+    vol.close()
+
+
+# ---------------------------------------------------------------------------
+# store-level reconciliation + heartbeat payload
+# ---------------------------------------------------------------------------
+
+
+def test_store_heartbeat_carries_disk_health(tmp_path):
+    store = Store([str(tmp_path)], needle_cache_mb=0)
+    store.add_volume(1, "")
+    hb = store.collect_heartbeat()
+    assert len(hb.disk_health) == 1
+    d = hb.disk_health[0]
+    assert d.dir == str(tmp_path)
+    assert d.state == "healthy"
+    assert 0 < d.free_bytes <= d.total_bytes
+    store.close()
+
+
+def test_store_watermark_flips_and_recovers_volumes(tmp_path):
+    store = Store([str(tmp_path)], needle_cache_mb=0)
+    store.add_volume(1, "")
+    loc = store.locations[0]
+    free = [50 * GB]
+    loc.health._statvfs = lambda _d: (100 * GB, free[0])
+    events = []
+    store.on_disk_event = lambda: events.append(1)
+    assert store.apply_disk_health()[0]["state"] == "healthy"
+    v = store.find_volume(1)
+    assert not v.read_only
+    # disk fills: the full beat flips every volume read-only-full
+    free[0] = 100 * MB
+    snaps = store.apply_disk_health()
+    assert snaps[0]["state"] == "full"
+    assert v.read_only and v.read_only_reason == "full"
+    with pytest.raises(DiskFullError):
+        store.write_needle(1, Needle(cookie=1, id=5, data=b"x"))
+    assert events  # the write fault woke the heartbeat
+    # space returns: exactly the fault-plane flip is undone
+    free[0] = 50 * GB
+    store.apply_disk_health()
+    assert not v.read_only
+    store.write_needle(1, Needle(cookie=1, id=5, data=b"x"))
+    # an operator read-only volume is NOT touched by recovery
+    v.read_only, v.read_only_reason = True, ""
+    store.apply_disk_health()
+    assert v.read_only
+    store.close()

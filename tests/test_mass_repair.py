@@ -501,3 +501,71 @@ def test_eager_cache_invalidation_registry(tmp_path):
     assert s.invalidate_location_caches() == 2
     for c in s._loc_caches:
         assert c._fetched_at == float("-inf")
+
+
+# -- proactive evacuation (ISSUE 14: failing-disk trigger) ----------------
+
+
+def _set_disk_state(node, state):
+    node.disk_health = {"/d": {"state": state, "free_bytes": 1,
+                               "total_bytes": 2}}
+
+
+def test_plan_evacuation_spreads_and_skips(tmp_path):
+    """EC shards on a failing node spread across healthy nodes by free
+    EC slots; full/failing nodes are never targets; replicated volumes
+    (a healthy copy exists) are not copied; sole-copy volumes are."""
+    from seaweedfs_tpu.topology.topology import VolumeInfo
+
+    master = _fake_master(tmp_path, journal=False)
+    sick = _register(master, "sick:80", "r0", {
+        1: ([0, 1, 2], 64), 2: ([5], 64)})
+    sick.volumes = {7: VolumeInfo(volume_id=7),   # sole copy
+                    8: VolumeInfo(volume_id=8)}   # replicated on b
+    _set_disk_state(sick, "failing")
+    a = _register(master, "a:80", "r0", {1: ([3, 4], 64)})
+    b = _register(master, "b:80", "r1", {})
+    b.volumes = {8: VolumeInfo(volume_id=8)}
+    full = _register(master, "full:80", "r1", {})
+    _set_disk_state(full, "full")
+
+    moves = master.mass_repair.plan_evacuation("sick:80")
+    ec = [m for m in moves if m["kind"] == "ec_shard"]
+    vols = [m for m in moves if m["kind"] == "volume"]
+    # every shard the sick node holds is planned off it
+    assert sorted((m["volume_id"], m["shard_id"]) for m in ec) == [
+        (1, 0), (1, 1), (1, 2), (2, 5)]
+    assert all(m["target"] in ("a:80", "b:80") for m in moves), moves
+    # volume 7 (sole copy) moves; volume 8 already has a healthy holder
+    assert [m["volume_id"] for m in vols] == [7]
+
+
+def test_on_disk_failing_rate_limited_and_executes(tmp_path, monkeypatch):
+    """The heartbeat-ingest trigger runs one evacuation per cooldown
+    window and drives the per-move rpc helpers."""
+    from seaweedfs_tpu.topology.topology import VolumeInfo
+
+    master = _fake_master(tmp_path, journal=False)
+    sick = _register(master, "sick:80", "r0", {3: ([0, 1], 64)})
+    sick.volumes = {9: VolumeInfo(volume_id=9)}
+    _set_disk_state(sick, "failing")
+    _register(master, "a:80", "r0", {})
+
+    done = []
+    monkeypatch.setattr(
+        master.mass_repair, "_evacuate_ec_shard",
+        lambda mv: done.append(("ec", mv["volume_id"], mv["shard_id"])))
+    monkeypatch.setattr(
+        master.mass_repair, "_evacuate_volume",
+        lambda mv: done.append(("vol", mv["volume_id"])))
+    master.note_disk_health(sick)
+    deadline = time.time() + 5
+    while time.time() < deadline and len(done) < 3:
+        time.sleep(0.05)
+    assert sorted(done) == [("ec", 3, 0), ("ec", 3, 1), ("vol", 9)]
+    assert master.mass_repair._counts["evacuated"] == 3
+    # cooldown: an immediate re-trigger is a no-op
+    done.clear()
+    master.note_disk_health(sick)
+    time.sleep(0.3)
+    assert done == []

@@ -149,16 +149,28 @@ def test_volume_write_read_delete(tmp_path):
 def test_volume_torn_tail_truncated(tmp_path):
     vol = make_volume(str(tmp_path), n_needles=5)
     base = vol.file_name()
+    last = vol.needle_map.get(5)
     vol.close()
-    # tear the last record: chop bytes off the .dat tail
     size = os.path.getsize(base + ".dat")
+    # tear ONLY trailing padding: every real byte of needle 5 is intact
+    # and CRC-clean, so the load-time healer re-pads instead of dropping
+    # an acked write (padding is 1..8 bytes, so -1 is always pad-only)
     with open(base + ".dat", "r+b") as f:
-        f.truncate(size - 3)
+        f.truncate(size - 1)
     vol2 = Volume(str(tmp_path), "", 1)
-    with pytest.raises(KeyError):
-        vol2.read_needle(5)  # torn needle dropped
-    assert vol2.read_needle(4).id == 4
+    assert vol2.read_needle(5).id == 5  # healed, not dropped
+    assert os.path.getsize(base + ".dat") == size  # re-padded to aligned
     vol2.close()
+    # tear into the record's REAL bytes: the torn needle is dropped and
+    # the .dat truncated back to the previous record
+    with open(base + ".dat", "r+b") as f:
+        f.truncate(last.offset + 10)
+    vol3 = Volume(str(tmp_path), "", 1)
+    with pytest.raises(KeyError):
+        vol3.read_needle(5)  # torn needle dropped
+    assert vol3.read_needle(4).id == 4
+    assert os.path.getsize(base + ".dat") == last.offset
+    vol3.close()
 
 
 @pytest.mark.skipif(not os.path.isdir(REF_EC_DIR), reason="reference fixture absent")

@@ -1,0 +1,130 @@
+"""Load-time torn-tail healing coverage (ISSUE 14 satellite): direct
+unit tests for `Volume.check_and_fix_integrity` — mid-blob tear,
+mid-idx-entry tear, tear at the padding boundary, and a tombstone as
+the last record — independent of the crash-torture harness."""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from helpers import make_volume
+
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.needle import Needle, actual_size, padding_length
+from seaweedfs_tpu.storage.volume import Volume
+
+
+def _reload(tmp_path) -> Volume:
+    return Volume(str(tmp_path), "", 1)
+
+
+def _last_entry(vol):
+    last = None
+    for v in vol.needle_map.items_ascending():
+        if last is None or v.offset > last.offset:
+            last = v
+    return last
+
+
+def test_mid_blob_tear_truncates_to_previous_record(tmp_path):
+    vol = make_volume(str(tmp_path), n_needles=6)
+    base = vol.file_name()
+    last = _last_entry(vol)
+    vol.close()
+    # chop into the middle of the last blob's DATA bytes
+    with open(base + ".dat", "r+b") as f:
+        f.truncate(last.offset + t.NEEDLE_HEADER_SIZE
+                   + max(last.size // 2, 1))
+    vol2 = _reload(tmp_path)
+    with pytest.raises(KeyError):
+        vol2.read_needle(6)
+    assert vol2.read_needle(5).id == 5
+    # the torn bytes are gone: the file ends at the previous record
+    assert os.path.getsize(base + ".dat") == last.offset
+    # and the volume accepts (and persists) new appends
+    vol2.append_needle(Needle(cookie=7, id=100, data=b"after-heal"))
+    vol2.close()
+    vol3 = _reload(tmp_path)
+    assert vol3.read_needle(100).data == b"after-heal"
+    vol3.close()
+
+
+def test_mid_idx_entry_tear_drops_partial_entry(tmp_path):
+    vol = make_volume(str(tmp_path), n_needles=6)
+    base = vol.file_name()
+    vol.close()
+    idx_size = os.path.getsize(base + ".idx")
+    assert idx_size == 6 * t.NEEDLE_MAP_ENTRY_SIZE
+    # tear mid-entry: the last entry loses its final 9 bytes
+    with open(base + ".idx", "r+b") as f:
+        f.truncate(idx_size - 9)
+    vol2 = _reload(tmp_path)
+    # the partial entry is dropped; its needle is unindexed (the .dat
+    # bytes remain as unreferenced garbage until vacuum)
+    with pytest.raises(KeyError):
+        vol2.read_needle(6)
+    assert vol2.read_needle(5).id == 5
+    # appends still work and re-index cleanly
+    vol2.append_needle(Needle(cookie=7, id=101, data=b"idx-heal"))
+    vol2.close()
+    vol3 = _reload(tmp_path)
+    assert vol3.read_needle(101).data == b"idx-heal"
+    assert os.path.getsize(base + ".idx") % t.NEEDLE_MAP_ENTRY_SIZE == 0
+    vol3.close()
+
+
+def test_tear_at_padding_boundary_repads(tmp_path):
+    vol = make_volume(str(tmp_path), n_needles=4)
+    base = vol.file_name()
+    last = _last_entry(vol)
+    version = vol.version
+    vol.close()
+    end = last.offset + actual_size(last.size, version)
+    pad = padding_length(last.size, version)
+    # truncate EXACTLY at the padding boundary: every real byte of the
+    # record (header+body+crc+ts) is present, only padding is missing
+    with open(base + ".dat", "r+b") as f:
+        f.truncate(end - pad)
+    vol2 = _reload(tmp_path)
+    # the acked needle survives — dropping it here would be data loss
+    n = vol2.read_needle(4)
+    assert n.id == 4
+    # the file was re-padded back to alignment and appends continue
+    assert os.path.getsize(base + ".dat") == end
+    vol2.append_needle(Needle(cookie=7, id=102, data=b"padded"))
+    assert vol2.read_needle(102).data == b"padded"
+    vol2.close()
+
+
+def test_tombstone_as_last_record(tmp_path):
+    vol = make_volume(str(tmp_path), n_needles=5)
+    base = vol.file_name()
+    assert vol.delete_needle(5) > 0
+    vol.close()
+    # clean reload: the delete persists, the tombstone tail is benign
+    vol2 = _reload(tmp_path)
+    with pytest.raises(KeyError):
+        vol2.read_needle(5)
+    assert vol2.read_needle(4).id == 4
+    vol2.append_needle(Needle(cookie=7, id=103, data=b"post-delete"))
+    assert vol2.read_needle(103).data == b"post-delete"
+    vol2.close()
+
+
+def test_torn_tombstone_keeps_delete_durable(tmp_path):
+    vol = make_volume(str(tmp_path), n_needles=5)
+    base = vol.file_name()
+    pre = os.path.getsize(base + ".dat")
+    assert vol.delete_needle(5) > 0
+    vol.close()
+    # tear INTO the tombstone marker record: the .idx tombstone entry
+    # (written before close) is what makes the delete durable
+    with open(base + ".dat", "r+b") as f:
+        f.truncate(pre + 4)
+    vol2 = _reload(tmp_path)
+    with pytest.raises(KeyError):
+        vol2.read_needle(5)  # still deleted
+    assert vol2.read_needle(4).id == 4
+    vol2.close()
