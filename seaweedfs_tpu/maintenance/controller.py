@@ -509,6 +509,10 @@ class LifecycleController:
         t0 = time.monotonic()
         gate = self._gate(job.get("node", ""))
         with gate:
+            if not self.master.is_leader():
+                # fenced (ISSUE 17): work queued before a depose must not
+                # execute against volume servers the new leader now owns
+                return {"key": key, "state": "fenced"}
             cur = self.journal.get(key)
             if cur is None or cur.get("state") != "pending":
                 return {"key": key, "state": cur and cur.get("state")}
@@ -553,6 +557,22 @@ class LifecycleController:
 
         return rpclib.volume_server_stub(_node_grpc(node), timeout=600)
 
+    def _epoch(self) -> int:
+        """Fencing epoch stamped on every outgoing mutating rpc: the
+        raft term this job runs under (0 = unfenced single master)."""
+        fn = getattr(self.master, "leader_epoch", None)
+        return fn() if callable(fn) else 0
+
+    def fence(self, term: int) -> None:
+        """Deposed (ISSUE 17): queued executor work no-ops (the
+        is_leader check at claim time), in-flight jobs fail their next
+        journal write (propose refuses off-leader), and the volume
+        servers reject any still-outbound rpc by stale epoch."""
+        self._counts["fenced"] = self._counts.get("fenced", 0) + 1
+        glog.warning("lifecycle: fenced at term %d — executor queue "
+                     "cancelled, running jobs will fail their journal "
+                     "writes instead of racing the new leader", term)
+
     def _live_holders(self, job: dict) -> list[str]:
         with self.master.topo.lock:
             return [n.id for n in self.master.topo.nodes.values()
@@ -563,7 +583,8 @@ class LifecycleController:
         holders = self._live_holders(job) or job["holders"]
         for node in holders:
             self._stub(node).VolumeMarkReadonly(
-                vs.VolumeMarkReadonlyRequest(volume_id=vid))
+                vs.VolumeMarkReadonlyRequest(
+                    volume_id=vid, leader_epoch=self._epoch()))
         return f"sealed on {sorted(holders)}"
 
     def _do_ttl_expire(self, job: dict) -> str:
@@ -577,7 +598,8 @@ class LifecycleController:
                 f"volume {vid}: no live holder to delete from")
         for node in holders:
             self._stub(node).VolumeDelete(
-                vs.VolumeDeleteRequest(volume_id=vid))
+                vs.VolumeDeleteRequest(
+                    volume_id=vid, leader_epoch=self._epoch()))
             # drop the vid from the writable sets NOW (per holder —
             # unregister is keyed by node id): waiting for the
             # deleted-volume heartbeat delta would leave a window where
@@ -595,7 +617,8 @@ class LifecycleController:
         detail = do_ec_encode(
             env, self.master.topo.to_topology_info(),
             vid, job["collection"],
-            codec=job.get("codec", ""), delete_source=False)
+            codec=job.get("codec", ""), delete_source=False,
+            leader_epoch=self._epoch())
         if job.get("keep_source"):
             return detail  # a tier stage follows; the sealed .dat stays
         # zero-downtime source drop: the shell flow deletes the volume
@@ -613,7 +636,8 @@ class LifecycleController:
                 break
         for node in self._live_holders(job):
             self._stub(node).VolumeDelete(
-                vs.VolumeDeleteRequest(volume_id=vid))
+                vs.VolumeDeleteRequest(
+                    volume_id=vid, leader_epoch=self._epoch()))
         return detail + "; source volume dropped"
 
     def _do_tier(self, job: dict) -> str:
@@ -624,7 +648,8 @@ class LifecycleController:
         stub = self._stub(node)
         try:
             stub.VolumeMarkReadonly(
-                vs.VolumeMarkReadonlyRequest(volume_id=vid))
+                vs.VolumeMarkReadonlyRequest(
+                    volume_id=vid, leader_epoch=self._epoch()))
         except grpc.RpcError:
             pass  # already sealed / racing — the move checks again
         processed = 0
@@ -634,6 +659,7 @@ class LifecycleController:
                     volume_id=vid,
                     destination_backend_name=job["backend"],
                     keep_local_dat_file=job.get("keep_local", False),
+                    leader_epoch=self._epoch(),
                 )
             ):
                 processed = resp.processed

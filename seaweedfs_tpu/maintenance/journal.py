@@ -53,6 +53,14 @@ class JobJournal:
         self._lock = threading.Lock()
         self._jobs: dict[str, dict] = {}
         self._lines = 0
+        # raft replication (ISSUE 17): when the master wires a proposer
+        # (`proposer(op, payload) -> bool`, op "put"|"drop"), every
+        # mutation is proposed through the raft log instead of written
+        # here, and lands via apply_replicated()/apply_drop() — in log
+        # order, on every quorum member — so a freshly elected leader
+        # holds the exact committed job set.  A failed propose (deposed,
+        # quorum lost) raises: a job the quorum didn't record must not run.
+        self.proposer = None
         if path:
             self._replay()
 
@@ -121,11 +129,26 @@ class JobJournal:
         must not exist."""
         rec = dict(job)
         rec["updated_ms"] = int(time.time() * 1000)
+        if self.proposer is not None:
+            self._propose("put", rec)
+            return
         with self._lock:
             self._append_locked(rec)
             self._jobs[rec["key"]] = rec
 
     def update(self, key: str, **changes) -> dict | None:
+        if self.proposer is not None:
+            # merge on the proposing leader, replicate the FULL record:
+            # followers apply an upsert, never a delta, so a mirror that
+            # missed an earlier record still converges
+            with self._lock:
+                rec = self._jobs.get(key)
+                if rec is None:
+                    return None
+                new = {**rec, **changes,
+                       "updated_ms": int(time.time() * 1000)}
+            self._propose("put", new)
+            return dict(new)
         with self._lock:
             rec = self._jobs.get(key)
             if rec is None:
@@ -137,9 +160,63 @@ class JobJournal:
             return dict(new)
 
     def drop(self, key: str) -> None:
+        if self.proposer is not None:
+            self._propose("drop", {"key": key})
+            return
         with self._lock:
             if self._jobs.pop(key, None) is not None and self.path:
                 self._compact_locked()
+
+    # -- raft replication (ISSUE 17) --------------------------------------
+
+    def _propose(self, op: str, payload: dict) -> None:
+        # same loud-failure discipline as a local append: the write
+        # faultpoint fires first, and an uncommitted propose raises so
+        # the caller never runs work the quorum didn't record
+        faultpoint.inject(FP_JOURNAL_WRITE, ctx=payload.get("key", ""))
+        if not self.proposer(op, payload):
+            raise RuntimeError(
+                f"journal {op} {payload.get('key', '')!r} not committed "
+                "(not the leader, or quorum unavailable)")
+
+    def apply_replicated(self, rec: dict) -> None:
+        """Raft apply_fn target: upsert one committed record into the
+        local mirror (every quorum member, leader included, in log
+        order).  Bypasses the write faultpoint — the fault already had
+        its chance at propose time on the leader."""
+        with self._lock:
+            if self.path:
+                line = json.dumps(rec, sort_keys=True)
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                self._lines += 1
+            self._jobs[rec["key"]] = dict(rec)
+            if (self.path
+                    and self._lines > len(self._jobs) + self.COMPACT_SLACK):
+                self._compact_locked()
+
+    def apply_drop(self, key: str) -> None:
+        with self._lock:
+            if self._jobs.pop(key, None) is not None and self.path:
+                self._compact_locked()
+
+    def resume_stale_running(self) -> int:
+        """Failover resume: `running` records inherited from a deposed
+        leader demote to `pending` with a bumped `resumed` marker —
+        through the proposer when replicated, so every mirror agrees the
+        job is runnable exactly once."""
+        resumed = 0
+        for rec in self.jobs(("running",)):
+            new = self.update(rec["key"], state="pending",
+                              resumed=rec.get("resumed", 0) + 1)
+            if new is not None:
+                resumed += 1
+        if resumed:
+            glog.warning("lifecycle journal: failover — demoted %d "
+                         "running job(s) to pending", resumed)
+        return resumed
 
     def jobs(self, states: tuple = ()) -> list[dict]:
         with self._lock:

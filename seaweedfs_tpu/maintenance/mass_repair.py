@@ -121,6 +121,10 @@ class MassRepairOrchestrator:
         self._submit_fallback_lock = threading.Lock()
         self._runner: threading.Thread | None = None
         self._stop = threading.Event()
+        # leader fencing (ISSUE 17): set on depose, cleared on resume —
+        # a running wave stops issuing batch rpcs the moment the raft
+        # role flips, instead of racing the new leader's plan
+        self._fence = threading.Event()
         # current batch accounting for the deadline bound: set when jobs
         # are accepted, cleared when the queue drains
         self._deadline_at = 0.0
@@ -302,7 +306,7 @@ class MassRepairOrchestrator:
     def on_node_dead(self, node_id: str) -> None:
         """Liveness-sweep hook: the node is already out of the topology,
         so plan() sees exactly the post-death shard map."""
-        if not self.enabled:
+        if not self.enabled or not self._warmed():
             return
         self._counts["deaths"] += 1
         try:
@@ -438,7 +442,8 @@ class MassRepairOrchestrator:
         tgt.VolumeEcShardsCopy(vs.VolumeEcShardsCopyRequest(
             volume_id=vid, collection=coll, shard_ids=[sid],
             copy_ecx_file=True, copy_ecj_file=True, copy_vif_file=True,
-            copy_from_data_node=_node_grpc(mv["source"])))
+            copy_from_data_node=_node_grpc(mv["source"]),
+            leader_epoch=self._epoch()))
         tgt.VolumeEcShardsMount(vs.VolumeEcShardsMountRequest(
             volume_id=vid, collection=coll, shard_ids=[sid]))
         src = self._target_stub(mv["source"])
@@ -456,14 +461,16 @@ class MassRepairOrchestrator:
 
         self._target_stub(mv["target"]).VolumeCopy(vs.VolumeCopyRequest(
             volume_id=mv["volume_id"], collection=mv["collection"],
-            source_data_node=_node_grpc(mv["source"])))
+            source_data_node=_node_grpc(mv["source"]),
+            leader_epoch=self._epoch()))
 
     def tick(self) -> None:
         """Periodic re-evaluation (liveness cadence): re-plans degraded
         volumes whose earlier jobs failed or were deferred behind other
         transitions, and keeps the runner alive while jobs are pending.
         Cheap and rate-limited — a healthy cluster scans nothing."""
-        if not self.enabled or not self.master.is_leader():
+        if (not self.enabled or not self.master.is_leader()
+                or not self._warmed()):
             return
         now = time.monotonic()
         if now - self._last_plan < 5.0:
@@ -478,9 +485,29 @@ class MassRepairOrchestrator:
         if self.pending():
             self.kick()
 
+    def _warmed(self) -> bool:
+        """Planning gate: a freshly elected leader must finish its
+        warm-up barrier (log tail applied + heartbeat cycle seen) before
+        planning repairs, or it plans duplicates of work the deposed
+        leader's committed journal already covers."""
+        fn = getattr(self.master, "control_warmed", None)
+        return fn() if callable(fn) else True
+
+    def _epoch(self) -> int:
+        fn = getattr(self.master, "leader_epoch", None)
+        return fn() if callable(fn) else 0
+
+    def fence(self, term: int) -> None:
+        """Deposed: cancel the running wave between chunks; the volume
+        servers reject anything already on the wire by stale epoch."""
+        self._fence.set()
+        glog.warning("mass repair: fenced at term %d — running waves "
+                     "cancelled", term)
+
     def resume(self) -> None:
         """Master start: journaled mass-repair jobs that were pending or
         running at the crash replayed as pending — run them."""
+        self._fence.clear()
         if self.pending():
             glog.warning("mass repair: resuming %d journaled job(s)",
                          len(self.pending()))
@@ -561,6 +588,8 @@ class MassRepairOrchestrator:
             # exposure order preserved chunk by chunk: the most exposed
             # volumes ride (and finish) the first rpcs
             for at in range(0, len(tjobs), self.jobs_per_rpc):
+                if self._fence.is_set() or not self.master.is_leader():
+                    return  # deposed mid-wave: leave the rest pending
                 run_target_chunk(target, tjobs[at:at + self.jobs_per_rpc])
 
         def run_target_chunk(target: str, tjobs: "list[dict]") -> None:
@@ -582,6 +611,7 @@ class MassRepairOrchestrator:
                 stub = self._target_stub(target)
                 resp = stub.VolumeEcShardsBatchRebuild(
                     vs.VolumeEcShardsBatchRebuildRequest(
+                        leader_epoch=self._epoch(),
                         jobs=[vs.BatchRebuildJob(
                             volume_id=j["volume_id"],
                             collection=j.get("collection", ""),

@@ -45,6 +45,15 @@ class MasterGrpcService:
         node: DataNode | None = None
         try:
             for hb in request_iterator:
+                if not self.master.is_leader():
+                    # deposed mid-stream: hand the volume server the new
+                    # leader hint immediately instead of letting it ride
+                    # a dead stream until its next full-pulse timeout
+                    yield master_pb2.HeartbeatResponse(
+                        leader=self.master.leader(),
+                        leader_grpc=self.master.leader_grpc(),
+                    )
+                    return
                 if node is None:
                     node = DataNode(
                         id=f"{hb.ip}:{hb.port}",
@@ -133,6 +142,9 @@ class MasterGrpcService:
                 if rate > 0:
                     rate = max(rate, self.master.mass_repair
                                .rate_floor_mbps())
+                # warm-up barrier input: one processed beat on a fresh
+                # leader means a volume server found us and re-registered
+                self.master._beat_count += 1
                 yield master_pb2.HeartbeatResponse(
                     volume_size_limit=self.topo.volume_size_limit,
                     leader=self.master.leader(),
@@ -142,6 +154,10 @@ class MasterGrpcService:
                     # server drop its EC holder-location caches eagerly
                     dead_node_seq=self.master.dead_node_seq,
                     dead_nodes=self.master.recent_dead_nodes,
+                    # fencing epoch: the committed raft term this ack was
+                    # produced under — volume servers reject mutating
+                    # rpcs stamped with anything older
+                    leader_epoch=self.master.leader_epoch(),
                 )
         finally:
             if node is not None and context.code() is None:

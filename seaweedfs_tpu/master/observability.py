@@ -278,4 +278,21 @@ def cluster_status(master) -> dict:
             },
         }
     out["Health"] = health
+    # HA control plane (ISSUE 17): raft state + fencing epoch — the
+    # operator's answer to "who is the leader, how stable is it, and is
+    # the control plane warmed up after the last failover"
+    raft = getattr(master, "raft", None)
+    if raft is not None:
+        with raft.lock:
+            out["Raft"] = {
+                "term": raft.term,
+                "role": raft.role,
+                "leaderId": raft.leader_id,
+                "commitIndex": raft.commit_index,
+                "lastApplied": raft.last_applied,
+                "logEntries": len(raft.log),
+                "peers": list(raft.peers),
+            }
+        out["Raft"]["leaderEpoch"] = master.leader_epoch()
+        out["Raft"]["warmedUp"] = master.control_warmed()
     return out

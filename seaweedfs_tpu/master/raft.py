@@ -24,11 +24,20 @@ import threading
 import time
 from dataclasses import dataclass
 
-from ..util import glog
+from ..util import faultpoint, glog
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
 LEADER = "leader"
+
+_ROLE_CODE = {FOLLOWER: 0, CANDIDATE: 1, LEADER: 2}
+
+# partition chaos: fires before every outbound raft rpc with
+# ctx "<src>-><dst>:<type>", so a `match` substring arms symmetric
+# ("8001"), one-way ("a->b") or rpc-type-scoped (":append") drops and
+# delays — the asymmetric-partition shapes the paper's safety argument
+# must survive
+FP_SEND = faultpoint.register("raft.send")
 
 
 @dataclass
@@ -74,6 +83,10 @@ class RaftNode:
         self.send = send
         self.apply_fn = apply_fn or (lambda cmd: None)
         self.state_path = state_path
+        # fired (role, term) from a daemon thread on leadership gain/loss
+        # only — the owner fences its control plane here (cancel waves on
+        # depose, warm up before planning on elect)
+        self.on_role_change = None
 
         self.lock = threading.RLock()
         self.term = 0
@@ -89,6 +102,9 @@ class RaftNode:
         self._election_timeout = election_timeout
         self._heartbeat_interval = heartbeat_interval
         self._last_heard = time.monotonic()
+        # check-quorum lease: a leader that cannot reach a majority for a
+        # full election timeout steps down instead of split-brain-serving
+        self._last_quorum_ack = time.monotonic()
         self._stop = threading.Event()
         self._commit_cv = threading.Condition(self.lock)
         # parallel peer RPC pool: one slow/dead peer must never serialize an
@@ -228,20 +244,31 @@ class RaftNode:
             if msg["leader_commit"] > self.commit_index:
                 self.commit_index = min(msg["leader_commit"], self._last_index())
                 self._apply_committed()
+            self._note_metrics()
             return {"term": self.term, "success": True,
                     "match": prev_index + len(entries)}
 
     # -- state transitions ---------------------------------------------------
 
     def _become_follower(self, term: int) -> None:
+        was_leader = self.role == LEADER
+        if term > self.term:
+            # votedFor is PER TERM (Raft fig. 2): resetting it at the same
+            # term would let this node vote twice in one term after a
+            # candidate->follower or check-quorum step-down
+            self.voted_for = None
         self.term = term
         self.role = FOLLOWER
-        self.voted_for = None
         self._persist()
+        self._note_metrics()
+        if was_leader:
+            glog.warning("raft %s: deposed at term %d", self.id, term)
+            self._notify_role(FOLLOWER, term)
 
     def _become_leader(self) -> None:
         self.role = LEADER
         self.leader_id = self.id
+        self._last_quorum_ack = time.monotonic()
         self.progress = {
             p: Progress(next_index=self._last_index() + 1) for p in self.peers
         }
@@ -249,6 +276,30 @@ class RaftNode:
         # (Raft §5.4.2 commit-only-current-term rule needs a current entry)
         self.log.append(LogEntry(self.term, {"op": "noop"}))
         self._persist()
+        self._note_metrics()
+        glog.info("raft %s: elected leader at term %d", self.id, self.term)
+        self._notify_role(LEADER, self.term)
+
+    def _notify_role(self, role: str, term: int) -> None:
+        from ..stats.metrics import RAFT_LEADER_CHANGES
+
+        RAFT_LEADER_CHANGES.labels(self.id).inc()
+        cb = self.on_role_change
+        if cb is not None:
+            # asynchronously: the callback fences executors/journals and
+            # must never run under (or wait on) the raft lock
+            threading.Thread(
+                target=cb, args=(role, term), daemon=True,
+                name=f"raft-role-{self.id}",
+            ).start()
+
+    def _note_metrics(self) -> None:
+        from ..stats import metrics as m
+
+        m.RAFT_TERM.labels(self.id).set(self.term)
+        m.RAFT_ROLE.labels(self.id).set(_ROLE_CODE[self.role])
+        m.RAFT_COMMIT_INDEX.labels(self.id).set(self.commit_index)
+        m.RAFT_LOG_ENTRIES.labels(self.id).set(len(self.log))
 
     def _apply_committed(self) -> None:
         while self.last_applied < self.commit_index:
@@ -282,6 +333,17 @@ class RaftNode:
             with self.lock:
                 if self.role == LEADER:
                     self._last_heard = time.monotonic()
+                    # check quorum: a partitioned leader cannot commit, so
+                    # keeping the LEADER role only extends the split-brain
+                    # window in which it hands out assigns and repair
+                    # batches another leader will conflict with
+                    if (time.monotonic() - self._last_quorum_ack
+                            > self._election_timeout[1]):
+                        glog.warning(
+                            "raft %s: lost quorum contact for %.1fs, "
+                            "stepping down", self.id,
+                            time.monotonic() - self._last_quorum_ack)
+                        self._become_follower(self.term)
                     continue
                 waited = time.monotonic() - self._last_heard
             if waited >= deadline:
@@ -295,6 +357,7 @@ class RaftNode:
             self.voted_for = self.id
             self.leader_id = None
             self._persist()
+            self._note_metrics()
             term = self.term
             req = {
                 "type": "vote",
@@ -362,12 +425,14 @@ class RaftNode:
                     "leader_commit": self.commit_index,
                 }
         futures = self._submit_sends(reqs)
+        acks = 1  # self
         try:
             for fut in concurrent.futures.as_completed(futures, timeout=2.0):
                 p = futures[fut]
                 resp = fut.result()
                 if resp is None:
                     continue
+                acks += 1  # any live response is quorum contact
                 with self.lock:
                     if resp.get("term", 0) > self.term:
                         self._become_follower(resp["term"])
@@ -387,6 +452,9 @@ class RaftNode:
                 self._advance_commit()
         except concurrent.futures.TimeoutError:
             pass
+        if acks >= (len(self.peers) + 1) // 2 + 1:
+            with self.lock:
+                self._last_quorum_ack = time.monotonic()
 
     def _advance_commit(self) -> None:
         with self.lock:
@@ -401,13 +469,26 @@ class RaftNode:
                 if count >= (len(self.peers) + 1) // 2 + 1:
                     self.commit_index = n
                     self._apply_committed()
+                    self._note_metrics()
                     break
 
     def _send_to(self, peer: str, msg: dict) -> dict | None:
+        from ..stats.metrics import RAFT_RPC
+
+        kind = msg.get("type", "?")
         try:
-            return self.send(peer, msg)
+            # drop / delay / one-way partitions arm here by ctx substring
+            faultpoint.inject(FP_SEND, ctx=f"{self.id}->{peer}:{kind}")
         except Exception:
+            RAFT_RPC.labels(kind, "dropped").inc()
             return None
+        try:
+            resp = self.send(peer, msg)
+        except Exception:
+            RAFT_RPC.labels(kind, "error").inc()
+            return None
+        RAFT_RPC.labels(kind, "ok").inc()
+        return resp
 
     def _submit_sends(self, reqs: dict) -> dict:
         """Submit parallel peer sends; {} once the node is stopping (the
@@ -427,6 +508,13 @@ class RaftNode:
     def is_leader(self) -> bool:
         with self.lock:
             return self.role == LEADER
+
+    def leader_epoch(self) -> int:
+        """Fencing epoch = the term this node leads under; 0 off-throne.
+        Terms are monotonic across failovers, so any rpc stamped with an
+        older epoch is provably from a deposed leader."""
+        with self.lock:
+            return self.term if self.role == LEADER else 0
 
     def propose(self, command: dict, timeout: float = 5.0) -> bool:
         """Leader-only: append, replicate, wait for commit+apply."""

@@ -10,6 +10,7 @@ reference's [master.maintenance] script block (master_server.go:187-242).
 from __future__ import annotations
 
 import json
+import os
 import random
 import threading
 import time
@@ -213,6 +214,19 @@ class MasterServer:
                     addr, peer_list, self._raft_send,
                     apply_fn=self._raft_apply, state_path=state_path,
                 )
+        # leader-fenced control plane (ISSUE 17): the warm-up barrier
+        # holds assigns and repair planning on a freshly elected leader
+        # until the committed log tail is applied and a heartbeat cycle
+        # has been seen; role transitions fence the deposed side.
+        self._warmed = threading.Event()
+        self._beat_count = 0  # full-state heartbeats processed as leader
+        if self.raft is None:
+            self._warmed.set()  # single master: always warm
+        else:
+            self.raft.on_role_change = self._on_role_change
+            # lifecycle + mass-repair journal records replicate through
+            # the raft log; every quorum member mirrors the job set
+            self.lifecycle.journal.proposer = self._journal_propose
 
     # -- lifecycle --------------------------------------------------------
 
@@ -305,7 +319,78 @@ class MasterServer:
                     self.topo.max_volume_id, int(cmd["value"])
                 )
                 return self.topo.max_volume_id
+        if op == "journal":  # lifecycle/mass-repair job record mirror
+            self.lifecycle.journal.apply_replicated(cmd["rec"])
+            return True
+        if op == "journal_drop":
+            self.lifecycle.journal.apply_drop(cmd["key"])
+            return True
+        if op == "barrier":  # warm-up: committing this proves the new
+            return True      # leader has applied every prior entry
         return None
+
+    def _journal_propose(self, op: str, payload: dict) -> bool:
+        """JobJournal proposer: replicate one journal mutation through
+        raft; False (-> the journal raises) when not the leader or the
+        quorum is unreachable."""
+        if op == "drop":
+            return self.raft.propose(
+                {"op": "journal_drop", "key": payload["key"]})
+        return self.raft.propose({"op": "journal", "rec": payload})
+
+    def _on_role_change(self, role: str, term: int) -> None:
+        """Raft leadership transition (fires from a raft daemon thread).
+
+        Deposed: fence the whole control plane NOW — cancel lifecycle
+        executor queues and running mass-repair waves so this master
+        stops racing the new leader (its in-flight rpcs are additionally
+        rejected volume-server-side by epoch).
+
+        Elected: warm-up barrier before serving — (1) commit a barrier
+        entry, which proves the old leader's committed tail (journal
+        records, vid increments) is applied here; (2) wait for one
+        heartbeat cycle (bounded) so assigns see real topology; then
+        resume journaled jobs exactly-once."""
+        if role != "leader":
+            self._warmed.clear()
+            self.lifecycle.fence(term)
+            self.mass_repair.fence(term)
+            glog.warning("master %s:%d deposed at term %d — "
+                         "control plane fenced", self.ip, self.port, term)
+            return
+        self._warmed.clear()
+        beats0 = self._beat_count
+        if not self.raft.propose({"op": "barrier"}, timeout=10.0):
+            glog.warning("master %s:%d elected at term %d but barrier "
+                         "did not commit (deposed again?)",
+                         self.ip, self.port, term)
+            return
+        grace = float(os.environ.get("SEAWEEDFS_TPU_WARMUP_GRACE_S", "2.0"))
+        deadline = time.monotonic() + grace
+        while (time.monotonic() < deadline
+               and self._beat_count == beats0
+               and self.raft.is_leader()
+               and not self._stop.is_set()):
+            time.sleep(0.05)
+        if not self.raft.is_leader() or self._stop.is_set():
+            return
+        resumed = self.lifecycle.journal.resume_stale_running()
+        self._warmed.set()
+        glog.info("master %s:%d warmed up at term %d (resumed=%d)",
+                  self.ip, self.port, term, resumed)
+        # journaled jobs inherited from the deposed leader restart
+        # exactly-once: the replicated journal is the dedup memory
+        self.mass_repair.resume()
+
+    def control_warmed(self) -> bool:
+        """True once this master may hand out fids / plan repairs: not
+        mid-failover-warm-up (always true without raft)."""
+        return self._warmed.is_set()
+
+    def leader_epoch(self) -> int:
+        """The fencing epoch stamped on every leader->volume-server
+        mutating rpc; 0 without raft (fencing off, single master)."""
+        return self.raft.leader_epoch() if self.raft is not None else 0
 
     def is_leader(self) -> bool:
         return self.raft is None or self.raft.is_leader()
@@ -410,6 +495,13 @@ class MasterServer:
 
     def _assign(self, count: int, collection: str, replication: str,
                 ttl: str, data_center: str = "", rack: str = "") -> tuple[str, str, str, int]:
+        # warm-up barrier (ISSUE 17): a freshly elected leader must not
+        # hand out fids until the deposed leader's committed tail is
+        # applied and a heartbeat cycle has refreshed topology — close
+        # the fid-reuse window by BLOCKING briefly (clients see a slow
+        # assign during failover, never a 5xx)
+        if not self._warmed.wait(timeout=15.0):
+            raise RuntimeError("control plane warming up after failover")
         layout = self.get_layout(collection, replication, ttl)
         try:
             vid, node_ids = layout.pick_for_write()
@@ -635,9 +727,11 @@ class MasterServer:
         if not nodes:
             return False
         try:
+            epoch = self.leader_epoch()
             ratios = [
                 rpclib.volume_server_stub(n.grpc_address, timeout=30)
-                .VacuumVolumeCheck(vs.VacuumVolumeCheckRequest(volume_id=vid))
+                .VacuumVolumeCheck(vs.VacuumVolumeCheckRequest(
+                    volume_id=vid, leader_epoch=epoch))
                 .garbage_ratio
                 for n in nodes
             ]
@@ -645,18 +739,22 @@ class MasterServer:
                 return False
             for n in nodes:
                 rpclib.volume_server_stub(n.grpc_address, timeout=600).VacuumVolumeCompact(
-                    vs.VacuumVolumeCompactRequest(volume_id=vid)
+                    vs.VacuumVolumeCompactRequest(
+                        volume_id=vid, leader_epoch=epoch)
                 )
             for n in nodes:
                 rpclib.volume_server_stub(n.grpc_address, timeout=600).VacuumVolumeCommit(
-                    vs.VacuumVolumeCommitRequest(volume_id=vid)
+                    vs.VacuumVolumeCommitRequest(
+                        volume_id=vid, leader_epoch=epoch)
                 )
             return True
         except grpc.RpcError:
             for n in nodes:
                 try:
                     rpclib.volume_server_stub(n.grpc_address, timeout=30).VacuumVolumeCleanup(
-                        vs.VacuumVolumeCleanupRequest(volume_id=vid)
+                        vs.VacuumVolumeCleanupRequest(
+                            volume_id=vid,
+                            leader_epoch=self.leader_epoch())
                     )
                 except grpc.RpcError:
                     pass

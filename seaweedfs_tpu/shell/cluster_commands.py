@@ -34,6 +34,16 @@ def cluster_status(env: CommandEnv, args: list[str]) -> str:
         f"isLeader={doc.get('IsLeader')} "
         f"maxVolumeId={doc.get('MaxVolumeId')}",
     ]
+    raft = doc.get("Raft")
+    if raft:
+        warm = "warmed" if raft.get("warmedUp") else "WARMING UP"
+        lines.append(
+            f"raft: term={raft.get('term')} role={raft.get('role')} "
+            f"leader={raft.get('leaderId')} "
+            f"commit={raft.get('commitIndex')}/"
+            f"{raft.get('logEntries')} entries "
+            f"epoch={raft.get('leaderEpoch')} "
+            f"quorum={len(raft.get('peers', ())) + 1} {warm}")
     nodes = doc.get("DataNodes", {})
     lines.append(f"volume servers ({len(nodes)}):")
     for nid in sorted(nodes):

@@ -133,7 +133,8 @@ def ec_encode(env: CommandEnv, args: list[str]) -> str:
 
 
 def do_ec_encode(env: CommandEnv, topo, vid: int, collection: str,
-                 codec: str = "", delete_source: bool = True) -> str:
+                 codec: str = "", delete_source: bool = True,
+                 leader_epoch: int = 0) -> str:
     """Encode one volume to EC shards and spread them.
 
     `delete_source=False` (the lifecycle controller's tier pipeline)
@@ -161,16 +162,19 @@ def do_ec_encode(env: CommandEnv, topo, vid: int, collection: str,
             collection = st.collection
         except grpc.RpcError:
             pass
-    # 1. freeze writes on every replica
+    # 1. freeze writes on every replica (`leader_epoch` fences the
+    # lifecycle-driven path; 0 = an operator at the shell, unfenced)
     for loc in locations:
         env.volume_server(_node_grpc(loc)).VolumeMarkReadonly(
-            vs.VolumeMarkReadonlyRequest(volume_id=vid)
+            vs.VolumeMarkReadonlyRequest(
+                volume_id=vid, leader_epoch=leader_epoch)
         )
     source = locations[0]
     # 2. generate shards on the source (the TPU codec dispatch point)
     env.volume_server(_node_grpc(source)).VolumeEcShardsGenerate(
         vs.VolumeEcShardsGenerateRequest(
-            volume_id=vid, collection=collection, codec=codec
+            volume_id=vid, collection=collection, codec=codec,
+            leader_epoch=leader_epoch,
         )
     )
     # 3. spread shards by free EC slots
@@ -196,6 +200,7 @@ def do_ec_encode(env: CommandEnv, topo, vid: int, collection: str,
                 copy_ecj_file=True,
                 copy_vif_file=True,
                 copy_from_data_node=_node_grpc(source),
+                leader_epoch=leader_epoch,
             )
         )
         env.volume_server(_node_grpc(target)).VolumeEcShardsMount(
@@ -217,7 +222,8 @@ def do_ec_encode(env: CommandEnv, topo, vid: int, collection: str,
     if delete_source:
         for loc in locations:
             env.volume_server(_node_grpc(loc)).VolumeDelete(
-                vs.VolumeDeleteRequest(volume_id=vid)
+                vs.VolumeDeleteRequest(
+                    volume_id=vid, leader_epoch=leader_epoch)
             )
     return f"ec.encode {vid}: spread {dict((k, v) for k, v in plan.items())}"
 

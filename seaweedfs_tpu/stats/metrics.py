@@ -577,6 +577,44 @@ FAULT_COUNTER = REGISTRY.counter(
     labels=("point",),
 )
 
+# -- raft consensus (master/raft.py) ----------------------------------------
+# one gauge set per quorum member (`node` = ip:port) so a federated scrape
+# of three masters shows term skew, commit lag and role at a glance; the
+# leader-change counter is what the flap SLO pages on.
+
+RAFT_TERM = REGISTRY.gauge(
+    "seaweedfs_raft_term", "current raft term", labels=("node",),
+)
+RAFT_ROLE = REGISTRY.gauge(
+    "seaweedfs_raft_role",
+    "raft role (0 follower, 1 candidate, 2 leader)",
+    labels=("node",),
+)
+RAFT_COMMIT_INDEX = REGISTRY.gauge(
+    "seaweedfs_raft_commit_index", "highest committed log index",
+    labels=("node",),
+)
+RAFT_LOG_ENTRIES = REGISTRY.gauge(
+    "seaweedfs_raft_log_entries", "entries in the raft log",
+    labels=("node",),
+)
+RAFT_LEADER_CHANGES = REGISTRY.counter(
+    "seaweedfs_raft_leader_changes_total",
+    "times this node gained or lost leadership",
+    labels=("node",),
+)
+RAFT_RPC = REGISTRY.counter(
+    "seaweedfs_raft_rpc_total",
+    "outbound raft rpcs by type (vote|append) and result (ok|error|dropped)",
+    labels=("type", "result"),
+)
+STALE_EPOCH_REJECTED = REGISTRY.counter(
+    "seaweedfs_stale_epoch_rejected_total",
+    "volume-server rpcs refused because they carried a deposed leader's "
+    "epoch, by rpc method",
+    labels=("method",),
+)
+
 # -- saturation telemetry (ISSUE 5 leg 3) -----------------------------------
 # a stalled pool is invisible in throughput counters until the damage is
 # done; queue depth + active workers make "which stage is the bottleneck"

@@ -244,6 +244,17 @@ def default_specs() -> list[SloSpec]:
             threshold=1.0, for_s=0.0,
         ),
         SloSpec(
+            name="leader-flapping", severity="page", kind="event",
+            description="raft leader changes in the fast window — more "
+                        "than a couple means elections are churning "
+                        "(partitioned quorum, clock trouble, or an "
+                        "overloaded master losing its heartbeats) and "
+                        "every flap re-runs the control-plane warm-up "
+                        "barrier",
+            family="seaweedfs_raft_leader_changes_total",
+            threshold=3.0, for_s=0.0,
+        ),
+        SloSpec(
             name="repair-backlog", severity="warn", kind="gauge",
             description="mass-repair jobs journaled but unfinished — "
                         "sustained depth means repair is not keeping up "

@@ -21,11 +21,30 @@ from ..storage.needle import Needle, actual_size
 
 COPY_CHUNK = 1024 * 1024
 
+# typed rejection prefix for epoch fencing — clients/tests match on it
+STALE_EPOCH_DETAIL = "stale leader epoch"
+
 
 class VolumeGrpcService:
     def __init__(self, server):
         self.server = server  # VolumeServer
         self.store = server.store
+
+    def _check_epoch(self, request, context, method: str) -> None:
+        """Epoch fence on master-driven mutating rpcs: a request stamped
+        with a leader epoch OLDER than the highest this node has learned
+        from heartbeat acks came from a deposed leader — reject it before
+        it mutates anything.  Epoch 0 (shell operators, single-master
+        deployments) is unfenced and always passes."""
+        epoch = getattr(request, "leader_epoch", 0)
+        known = getattr(self.server, "_leader_epoch", 0)
+        if epoch and known and epoch < known:
+            from ..stats.metrics import STALE_EPOCH_REJECTED
+
+            STALE_EPOCH_REJECTED.labels(method).inc()
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"{STALE_EPOCH_DETAIL} {epoch} < {known}")
 
     # -- volume lifecycle -------------------------------------------------
 
@@ -51,10 +70,12 @@ class VolumeGrpcService:
         return vs.VolumeUnmountResponse()
 
     def VolumeDelete(self, request, context):
+        self._check_epoch(request, context, "VolumeDelete")
         self.store.delete_volume(request.volume_id)
         return vs.VolumeDeleteResponse()
 
     def VolumeMarkReadonly(self, request, context):
+        self._check_epoch(request, context, "VolumeMarkReadonly")
         if not self.store.mark_readonly(request.volume_id):
             context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
         return vs.VolumeMarkReadonlyResponse()
@@ -160,14 +181,17 @@ class VolumeGrpcService:
     # -- vacuum (4-phase protocol) ----------------------------------------
 
     def VacuumVolumeCheck(self, request, context):
+        self._check_epoch(request, context, "VacuumVolumeCheck")
         ratio = self.store.check_compact_volume(request.volume_id)
         return vs.VacuumVolumeCheckResponse(garbage_ratio=ratio)
 
     def VacuumVolumeCompact(self, request, context):
+        self._check_epoch(request, context, "VacuumVolumeCompact")
         self.store.compact_volume(request.volume_id)
         return vs.VacuumVolumeCompactResponse()
 
     def VacuumVolumeCommit(self, request, context):
+        self._check_epoch(request, context, "VacuumVolumeCommit")
         self.store.commit_compact_volume(request.volume_id)
         v = self.store.find_volume(request.volume_id)
         return vs.VacuumVolumeCommitResponse(
@@ -175,6 +199,7 @@ class VolumeGrpcService:
         )
 
     def VacuumVolumeCleanup(self, request, context):
+        self._check_epoch(request, context, "VacuumVolumeCleanup")
         self.store.cleanup_compact_volume(request.volume_id)
         return vs.VacuumVolumeCleanupResponse()
 
@@ -241,6 +266,7 @@ class VolumeGrpcService:
     def VolumeCopy(self, request, context):
         """Pull a whole volume (.dat/.idx/.vif) from another volume server.
         `disk_type` places the copy on that tier (volume.tier.move)."""
+        self._check_epoch(request, context, "VolumeCopy")
         loc = self.store.has_free_location(request.disk_type)
         if loc is None:
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "no free slot")
@@ -279,6 +305,7 @@ class VolumeGrpcService:
                   svc.mode + "-service" if svc is not None else "direct")
 
     def VolumeEcShardsGenerate(self, request, context):
+        self._check_epoch(request, context, "VolumeEcShardsGenerate")
         self._log_ec_dispatch(
             "VolumeEcShardsGenerate", request.volume_id, request.codec)
         try:
@@ -292,6 +319,7 @@ class VolumeGrpcService:
         return vs.VolumeEcShardsGenerateResponse()
 
     def VolumeEcShardsRebuild(self, request, context):
+        self._check_epoch(request, context, "VolumeEcShardsRebuild")
         self._log_ec_dispatch(
             "VolumeEcShardsRebuild", request.volume_id, request.codec)
         try:
@@ -319,6 +347,7 @@ class VolumeGrpcService:
         MassPartialSession (cross-volume aggregated rpcs per source
         server) and mounts its rebuilt shards locally; per-volume errors
         come back in the response instead of failing the batch."""
+        self._check_epoch(request, context, "VolumeEcShardsBatchRebuild")
         self._log_ec_dispatch(
             "VolumeEcShardsBatchRebuild",
             request.jobs[0].volume_id if request.jobs else 0, request.codec)
@@ -337,6 +366,7 @@ class VolumeGrpcService:
 
     def VolumeEcShardsCopy(self, request, context):
         """Pull shard files from the source node (server-side pull protocol)."""
+        self._check_epoch(request, context, "VolumeEcShardsCopy")
         loc = self.store.has_free_location() or self.store.locations[0]
         base = loc.base_name(request.volume_id, request.collection)
         src = rpclib.volume_server_stub(request.copy_from_data_node)
@@ -655,6 +685,7 @@ class VolumeGrpcService:
         every uploaded byte is charged to the node's shared background
         bucket (the scrubber's) so a tier move and a scrub pass together
         stay within one budget."""
+        self._check_epoch(request, context, "VolumeTierMoveDatToRemote")
         v = self.store.find_volume(request.volume_id)
         if v is None:
             context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")

@@ -101,6 +101,10 @@ class VolumeServer:
                     counts.get(loc.disk_type, 0) + max_volume_count)
             self.store.max_volume_counts = counts
         self.current_leader: str | None = None
+        # highest leader epoch (raft term) learned from heartbeat acks;
+        # mutating rpcs stamped with an older epoch are rejected — a
+        # deposed master cannot drive rebuilds/vacuums on this node
+        self._leader_epoch = 0
         self.metrics_port = metrics_port
         self.jwt_signing_key = (
             jwt_signing_key.encode() if isinstance(jwt_signing_key, str)
@@ -240,6 +244,14 @@ class VolumeServer:
             was_leader_hint = master == self.current_leader
             try:
                 self._heartbeat_once(master)
+                if self.current_leader and self.current_leader != master:
+                    continue  # fresh leader hint: chase it immediately
+                if self.current_leader == master:
+                    # the pinned master ended the stream WITHOUT naming a
+                    # successor — a deposed leader cut off from its quorum
+                    # does not know who won.  Unpin and rotate the seed
+                    # list, or we heartbeat the minority side forever
+                    self.current_leader = None
                 # clean return = follower ended the stream (no leader yet):
                 # back off instead of busy-spinning through the master list
                 time.sleep(min(self.pulse_seconds, 1.0))
@@ -249,6 +261,11 @@ class VolumeServer:
                     # instead of hammering a dead address forever (a fresh
                     # hint set during this attempt is kept)
                     self.current_leader = None
+                if self.current_leader and self.current_leader != master:
+                    # deposed master handed us the new leader mid-stream:
+                    # re-register NOW — backing off here is a whole
+                    # election timeout of missing heartbeats
+                    continue
                 time.sleep(min(self.pulse_seconds, 1.0))
 
     def _with_stats(self, hb: master_pb2.Heartbeat) -> master_pb2.Heartbeat:
@@ -317,6 +334,15 @@ class VolumeServer:
                     "dead-node notice seq=%d (%s): invalidated %d "
                     "location cache(s)", resp.dead_node_seq,
                     ",".join(resp.dead_nodes) or "?", dropped)
+            if resp.leader_epoch:
+                if resp.leader_epoch < self._leader_epoch:
+                    # a deposed leader still streaming acks: drop the
+                    # stream and chase the real leader — adopting its
+                    # budget/dead-node pushes would act on stale plans
+                    if self.current_leader == master:
+                        self.current_leader = None
+                    raise grpc.RpcError()
+                self._leader_epoch = resp.leader_epoch
             if resp.leader_grpc and resp.leader_grpc != master:
                 self.current_leader = resp.leader_grpc
                 raise grpc.RpcError()  # reconnect to leader

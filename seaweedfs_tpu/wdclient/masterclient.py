@@ -23,6 +23,23 @@ from .vid_map import Location, VidMap
 # the KeepConnected stream — the master's federation fallback data
 STATS_INTERVAL_S = 10.0
 
+# typed NOT_LEADER rejection detail emitted by the master's grpc layer —
+# the suffix is the leader's grpc address, so a client can re-resolve in
+# one hop instead of rotating through the seed list on backoff
+NOT_LEADER_PREFIX = "not the leader; leader is "
+
+
+def parse_leader_hint(err: Exception) -> str:
+    """leader grpc address out of a NOT_LEADER grpc error, or ''."""
+    details = getattr(err, "details", None)
+    detail = details() if callable(details) else str(err)
+    if detail and NOT_LEADER_PREFIX in detail:
+        hint = detail.split(NOT_LEADER_PREFIX, 1)[1].strip()
+        # "None" = the deposed master does not know the new leader yet
+        if hint and hint != "None":
+            return hint
+    return ""
+
 
 class MasterClient:
     def __init__(self, name: str, master_grpc_addresses: list[str],
@@ -72,11 +89,18 @@ class MasterClient:
                 i += 1
             try:
                 self._stream_from(master)
-            except grpc.RpcError:
-                pass
+            except grpc.RpcError as e:
+                hint = parse_leader_hint(e)
+                if hint and hint in self.masters:
+                    self._leader_hint = hint
             if self._connected.is_set():
                 backoff.reset()  # the stream was live; reconnect fast
             self._connected.clear()
+            if self._leader_hint:
+                # deposed leader handed us its successor: reconnect NOW
+                # — a fixed backoff here leaves lookups pointed at a
+                # follower for a whole rotation cycle after failover
+                continue
             self._stop.wait(backoff.next())
 
     def _registration(self) -> master_pb2.KeepConnectedRequest:
@@ -145,9 +169,21 @@ class MasterClient:
                 return locs
 
         def ask(master: str) -> master_pb2.LookupVolumeResponse:
-            return rpclib.master_stub(master, timeout=10).LookupVolume(
-                master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)])
-            )
+            req = master_pb2.LookupVolumeRequest(
+                volume_or_file_ids=[str(vid)])
+            try:
+                return rpclib.master_stub(
+                    master, timeout=10).LookupVolume(req)
+            except grpc.RpcError as e:
+                hint = parse_leader_hint(e)
+                if hint and hint in self.masters and hint != master:
+                    # follower named the leader: one extra hop beats
+                    # burning a failover round on the rest of the seeds
+                    self.current_master = hint
+                    self._leader_hint = hint
+                    return rpclib.master_stub(
+                        hint, timeout=10).LookupVolume(req)
+                raise
 
         try:
             resp = failsafe.call_with_failover(

@@ -131,3 +131,47 @@ def free_port() -> int:
         return port
     raise RuntimeError(
         "free_port: test port band 20000-22767 exhausted or blocked")
+
+
+def start_master_cluster(base_dir: str, **kw):
+    """Start SEAWEEDFS_TPU_TEST_MASTERS in-process masters (default 1)
+    and return ``(leader, all_masters)``.
+
+    n=1 reproduces the classic single-master setup exactly (no peers,
+    no raft).  n>=3 starts a raft quorum — each master gets its own
+    ``lifecycle_dir`` subdirectory under the caller's (the maintenance
+    journal is raft-replicated, so the elected leader's view is the
+    cluster's) — letting CI re-run the chaos suites against a 3-master
+    quorum without a second copy of every test."""
+    import os
+    import time
+
+    from seaweedfs_tpu.master.server import MasterServer
+
+    n = int(os.environ.get("SEAWEEDFS_TPU_TEST_MASTERS", "1"))
+    if n <= 1:
+        m = MasterServer(ip="127.0.0.1", port=free_port(), **kw)
+        m.start()
+        return m, [m]
+    ports = [free_port() for _ in range(n)]
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    raft_dir = os.path.join(base_dir, "raft-state")
+    os.makedirs(raft_dir, exist_ok=True)
+    masters = []
+    for i, p in enumerate(ports):
+        mkw = dict(kw)
+        if "lifecycle_dir" in mkw:
+            d = os.path.join(mkw["lifecycle_dir"], f"m{i}")
+            os.makedirs(d, exist_ok=True)
+            mkw["lifecycle_dir"] = d
+        m = MasterServer(ip="127.0.0.1", port=p, peers=peers,
+                         raft_state_dir=raft_dir, **mkw)
+        m.start()
+        masters.append(m)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        leaders = [m for m in masters if m.is_leader()]
+        if len(leaders) == 1 and masters[0].leader():
+            return leaders[0], masters
+        time.sleep(0.05)
+    raise AssertionError("master quorum elected no leader")

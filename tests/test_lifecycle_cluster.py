@@ -19,7 +19,12 @@ import urllib.request
 import numpy as np
 import pytest
 
-from helpers import free_port, make_volume, start_s3_stub
+from helpers import (
+    free_port,
+    make_volume,
+    start_master_cluster,
+    start_s3_stub,
+)
 
 from seaweedfs_tpu.storage.backend import BackendStorage, register_backend
 
@@ -117,8 +122,8 @@ def test_chaos_pipeline_seal_ec_tier_vacuum_under_reads(tmp_path_factory):
                                  "bucket": "cold"})
 
     jd = str(tmp_path_factory.mktemp("lifecycle-journal"))
-    master = MasterServer(
-        ip="127.0.0.1", port=free_port(), volume_size_limit_mb=4,
+    master, cluster = start_master_cluster(
+        jd, volume_size_limit_mb=4,
         lifecycle_dir=jd,
         lifecycle_policy={"*": {
             "seal_full_percent": 10.0,
@@ -127,12 +132,12 @@ def test_chaos_pipeline_seal_ec_tier_vacuum_under_reads(tmp_path_factory):
             "tier_idle_seconds": 0.0,
             "vacuum_garbage_ratio": 0.25,
         }})
-    master.start()
     vols = []
     for i in range(2):
         v = VolumeServer(
             directories=[str(tmp_path_factory.mktemp(f"lcvol{i}"))],
-            master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+            master_addresses=[f"127.0.0.1:{m.grpc_port}"
+                              for m in cluster],
             ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
             max_volume_count=16)
         v.start()
@@ -266,7 +271,8 @@ def test_chaos_pipeline_seal_ec_tier_vacuum_under_reads(tmp_path_factory):
             stop.set()
         for v in vols:
             v.stop()
-        master.stop()
+        for m in cluster:
+            m.stop()
         stub.shutdown()
         stub.server_close()
 
@@ -292,12 +298,11 @@ def test_chaos_ttl_expired_volume_deleted(tmp_path_factory):
     os.utime(os.path.join(vol_dir, "21.dat"), (old, old))
 
     jd = str(tmp_path_factory.mktemp("ttl-journal"))
-    master = MasterServer(ip="127.0.0.1", port=free_port(),
-                          volume_size_limit_mb=64, lifecycle_dir=jd)
-    master.start()
+    master, cluster = start_master_cluster(
+        jd, volume_size_limit_mb=64, lifecycle_dir=jd)
     vs_ = VolumeServer(
         directories=[vol_dir],
-        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        master_addresses=[f"127.0.0.1:{m.grpc_port}" for m in cluster],
         ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
         max_volume_count=16)
     vs_.start()
@@ -319,7 +324,8 @@ def test_chaos_ttl_expired_volume_deleted(tmp_path_factory):
         assert not os.path.exists(os.path.join(vol_dir, "21.dat"))
     finally:
         vs_.stop()
-        master.stop()
+        for m in cluster:
+            m.stop()
 
 
 # ---------------------------------------------------------------------------
@@ -509,8 +515,8 @@ def test_chaos_lifecycle_throughput_respects_token_bucket(
 
     rate_mbps = 2.0
     jd = str(tmp_path_factory.mktemp("throttle-journal"))
-    master = MasterServer(
-        ip="127.0.0.1", port=free_port(), volume_size_limit_mb=64,
+    master, cluster = start_master_cluster(
+        jd, volume_size_limit_mb=64,
         lifecycle_dir=jd, lifecycle_rate_mbps=rate_mbps,
         lifecycle_policy={
             "*": {"seal_full_percent": 0.0, "vacuum_garbage_ratio": 0.0,
@@ -522,10 +528,9 @@ def test_chaos_lifecycle_throughput_respects_token_bucket(
                      "vacuum_garbage_ratio": 0.0,
                      "ttl_expire": False},
         })
-    master.start()
     vs_ = VolumeServer(
         directories=[vol_dir],
-        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        master_addresses=[f"127.0.0.1:{m.grpc_port}" for m in cluster],
         ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
         max_volume_count=16)
     vs_.start()
@@ -599,4 +604,5 @@ def test_chaos_lifecycle_throughput_respects_token_bucket(
                     vs_.store.read_needle(vid, nid).data) == want
     finally:
         vs_.stop()
-        master.stop()
+        for m in cluster:
+            m.stop()

@@ -42,7 +42,7 @@ from seaweedfs_tpu.storage.ec.encoder import (
 )
 from seaweedfs_tpu.util import faultpoint
 
-from helpers import free_port, make_volume
+from helpers import free_port, make_volume, start_master_cluster
 
 LARGE = 10000
 SMALL = 100
@@ -94,12 +94,14 @@ def _stage_volumes(tmp_path, servers, n_volumes, victim_sids):
 def _start_servers(tmp_path, master_grpc, n=N_SRV):
     from seaweedfs_tpu.volume.server import VolumeServer
 
+    addrs = ([master_grpc] if isinstance(master_grpc, str)
+             else list(master_grpc))
     servers = []
     for i in range(n):
         d = tmp_path / f"vol{i}"
         d.mkdir()
         s = VolumeServer(
-            directories=[str(d)], master_addresses=[master_grpc],
+            directories=[str(d)], master_addresses=addrs,
             ip="127.0.0.1", port=free_port(), pulse_seconds=0.5,
             rack=f"rack{i % 2}", data_center="dc1", max_volume_count=600)
         s.start()
@@ -117,14 +119,13 @@ def test_chaos_dead_node_mass_repair_under_reads(tmp_path):
     deadline_s = 90.0
     jd = tmp_path / "journal"
     jd.mkdir()
-    master = MasterServer(ip="127.0.0.1", port=free_port(),
-                          volume_size_limit_mb=64, pulse_seconds=0.5,
-                          lifecycle_dir=str(jd),
-                          repair_deadline_s=deadline_s)
-    master.start()
+    master, cluster = start_master_cluster(
+        str(jd), volume_size_limit_mb=64, pulse_seconds=0.5,
+        lifecycle_dir=str(jd), repair_deadline_s=deadline_s)
     servers = []
     try:
-        servers = _start_servers(tmp_path, f"127.0.0.1:{master.grpc_port}")
+        servers = _start_servers(
+            tmp_path, [f"127.0.0.1:{m.grpc_port}" for m in cluster])
         deadline = time.time() + 20
         while time.time() < deadline and len(master.topo.nodes) < N_SRV:
             time.sleep(0.1)
@@ -227,7 +228,8 @@ def test_chaos_dead_node_mass_repair_under_reads(tmp_path):
     finally:
         for s in servers[1:]:
             s.stop()
-        master.stop()
+        for m in cluster:
+            m.stop()
 
 
 # ---------------------------------------------------------------------------

@@ -210,6 +210,114 @@ def test_raft_apply_time_increment_unique_across_failover(tmp_path):
         n.stop()
 
 
+def test_raft_restart_mid_election_cannot_double_vote(tmp_path):
+    """A node that voted, crashed, and restarted in the SAME term must
+    honor its persisted voted_for — re-granting the vote to a second
+    candidate would allow two leaders in one term."""
+    path = str(tmp_path / "n0.raft")
+    node = RaftNode("n0", ["n0", "n1", "n2"], lambda d, m: None,
+                    state_path=path)
+    vote_a = {"type": "vote", "term": 5, "candidate": "n1",
+              "last_log_index": 0, "last_log_term": 0}
+    assert node.handle(vote_a)["granted"] is True
+    # crash + restart: only what _persist() wrote survives
+    reborn = RaftNode("n0", ["n0", "n1", "n2"], lambda d, m: None,
+                      state_path=path)
+    assert reborn.term == 5
+    assert reborn.voted_for == "n1"
+    vote_b = {"type": "vote", "term": 5, "candidate": "n2",
+              "last_log_index": 0, "last_log_term": 0}
+    assert reborn.handle(vote_b)["granted"] is False
+    # the original candidate may retry (vote is idempotent per term)
+    assert reborn.handle(vote_a)["granted"] is True
+
+
+def test_raft_same_term_stepdown_keeps_vote(tmp_path):
+    """_become_follower at an UNCHANGED term (candidate losing, leader
+    check-quorum step-down) must not clear voted_for: votedFor is per
+    term (Raft fig. 2)."""
+    node = RaftNode("n0", ["n0", "n1", "n2"], lambda d, m: None,
+                    state_path=str(tmp_path / "n0.raft"))
+    vote = {"type": "vote", "term": 3, "candidate": "n1",
+            "last_log_index": 0, "last_log_term": 0}
+    assert node.handle(vote)["granted"] is True
+    with node.lock:
+        node._become_follower(node.term)  # same-term step-down
+    assert node.voted_for == "n1"
+    rival = {"type": "vote", "term": 3, "candidate": "n2",
+             "last_log_index": 0, "last_log_term": 0}
+    assert node.handle(rival)["granted"] is False
+    # a HIGHER term does reset the vote
+    later = {"type": "vote", "term": 4, "candidate": "n2",
+             "last_log_index": 0, "last_log_term": 0}
+    assert node.handle(later)["granted"] is True
+
+
+def test_raft_conflicting_entries_truncated_to_converge(tmp_path):
+    """A follower holding uncommitted entries from a deposed leader
+    truncates them when the new leader's AppendEntries conflicts, and
+    converges on the new leader's log."""
+    applied = []
+    node = RaftNode("n0", ["n0", "n1", "n2"], lambda d, m: None,
+                    apply_fn=applied.append,
+                    state_path=str(tmp_path / "n0.raft"))
+    # deposed leader at term 1 replicated two entries, never committed
+    stale = {"type": "append", "term": 1, "leader": "n1",
+             "prev_log_index": 0, "prev_log_term": 0,
+             "entries": [{"term": 1, "command": {"op": "max_vid",
+                                                 "value": 7}},
+                         {"term": 1, "command": {"op": "max_vid",
+                                                 "value": 8}}],
+             "leader_commit": 0}
+    assert node.handle(stale)["success"] is True
+    assert len(node.log) == 2
+    # new leader at term 2 won without those entries; its first append
+    # conflicts at index 1 — both stale entries must go
+    fresh = {"type": "append", "term": 2, "leader": "n2",
+             "prev_log_index": 0, "prev_log_term": 0,
+             "entries": [{"term": 2, "command": {"op": "noop"}},
+                         {"term": 2, "command": {"op": "max_vid",
+                                                 "value": 9}}],
+             "leader_commit": 2}
+    assert node.handle(fresh)["success"] is True
+    assert [e.term for e in node.log] == [2, 2]
+    assert {"op": "max_vid", "value": 9} in applied
+    assert {"op": "max_vid", "value": 7} not in applied
+    assert {"op": "max_vid", "value": 8} not in applied
+    # restart: the truncation was persisted, not just in memory
+    reborn = RaftNode("n0", ["n0", "n1", "n2"], lambda d, m: None,
+                      state_path=str(tmp_path / "n0.raft"))
+    assert [e.term for e in reborn.log] == [2, 2]
+
+
+def test_raft_partitioned_leader_steps_down(tmp_path):
+    """Check-quorum: a leader cut off from every follower deposes ITSELF
+    within an election timeout instead of reigning over a phantom
+    cluster — the hook that lets the control plane fence its executors
+    on the minority side of an asymmetric partition."""
+    net, nodes, applied = make_cluster(3, tmp_path)
+    for n in nodes:
+        n.start()
+    leader = wait_leader(nodes)
+    deposed = threading.Event()
+    leader.on_role_change = lambda role, term: (
+        deposed.set() if role != LEADER else None)
+    for o in nodes:
+        if o is not leader:
+            net.partition(leader.id, o.id)
+    assert not leader.propose({"op": "max_vid", "value": 50}, timeout=1.0)
+    assert deposed.wait(5.0), "partitioned leader never stepped down"
+    assert not leader.is_leader()
+    # the unreplicated entry must not have been applied anywhere
+    for n in nodes:
+        assert {"op": "max_vid", "value": 50} not in applied[n.id]
+    net.heal()
+    new_leader = wait_leader(nodes)
+    assert new_leader.propose({"op": "max_vid", "value": 51}, timeout=3)
+    for n in nodes:
+        n.stop()
+
+
 def test_master_peers_mismatch_rejected(tmp_path):
     from seaweedfs_tpu.master.server import MasterServer
 

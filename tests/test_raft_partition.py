@@ -1,0 +1,315 @@
+"""Partition chaos (ISSUE 17): the leader-fenced control plane under an
+asymmetric network partition.
+
+Test 1 — a 3-master quorum runs a mass repair (held open by a delay
+fault) while clients assign continuously.  The leader is then cut off
+from its peers via `raft.send` faults (volume servers still reach it —
+the asymmetric case).  Proves: exactly one leader survives, the deposed
+leader steps down via check-quorum and fences its executors, zero
+duplicate fid across both sides' assign logs, volume servers reject a
+stale-epoch batch rpc with the typed FAILED_PRECONDITION, the new
+leader resumes the replicated journal's running jobs exactly-once (with
+`resumed` markers), and the quorum side serves zero 5xx throughout.
+
+Test 2 — the heartbeat failover regression: after the leader is
+partitioned away, its volume servers re-register with the NEW leader
+within an election-timeout budget (immediate leader re-resolve, not the
+fixed rotation backoff).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import grpc
+import pytest
+
+from seaweedfs_tpu.pb import rpc as rpclib
+from seaweedfs_tpu.pb import volume_server_pb2 as vs_pb
+from seaweedfs_tpu.util import faultpoint
+from seaweedfs_tpu.volume.grpc_handlers import STALE_EPOCH_DETAIL
+
+from helpers import free_port
+
+N_SRV = 5
+V = 6
+
+
+def _start_masters(tmp_path, n=3):
+    from seaweedfs_tpu.master.server import MasterServer
+
+    ports = [free_port() for _ in range(n)]
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    (tmp_path / "raft").mkdir(exist_ok=True)
+    masters = []
+    for i, p in enumerate(ports):
+        jd = tmp_path / f"journal{i}"
+        jd.mkdir()
+        m = MasterServer(
+            ip="127.0.0.1", port=p, peers=peers,
+            raft_state_dir=str(tmp_path / "raft"),
+            lifecycle_dir=str(jd), volume_size_limit_mb=64,
+            pulse_seconds=0.5, repair_deadline_s=90.0,
+            # collision-free ids across masters: duplicate-fid scanning
+            # below asserts the whole pipeline, not sequencer luck
+            sequencer="snowflake", sequencer_node_id=i + 1)
+        m.start()
+        masters.append(m)
+    return masters
+
+
+def _start_volume_servers(tmp_path, master_grpcs, n=N_SRV, pulse=0.5):
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    servers = []
+    for i in range(n):
+        d = tmp_path / f"vol{i}"
+        d.mkdir()
+        s = VolumeServer(
+            directories=[str(d)], master_addresses=list(master_grpcs),
+            ip="127.0.0.1", port=free_port(), pulse_seconds=pulse,
+            rack=f"rack{i % 2}", data_center="dc1", max_volume_count=600)
+        s.start()
+        servers.append(s)
+    return servers
+
+
+def _wait_single_leader(masters, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        leaders = [m for m in masters if m.is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.05)
+    raise AssertionError("no single leader")
+
+
+@pytest.mark.chaos
+def test_chaos_asymmetric_partition_mid_mass_repair(tmp_path):
+    from test_mass_repair_cluster import _stage_volumes
+
+    masters = _start_masters(tmp_path)
+    grpcs = [f"127.0.0.1:{m.grpc_port}" for m in masters]
+    servers = []
+    try:
+        leader = _wait_single_leader(masters)
+        quorum = [m for m in masters if m is not leader]
+        old_epoch = leader.leader_epoch()
+        assert old_epoch > 0
+
+        servers = _start_volume_servers(tmp_path, grpcs)
+        deadline = time.time() + 30
+        while time.time() < deadline and len(leader.topo.nodes) < N_SRV:
+            time.sleep(0.1)
+        assert len(leader.topo.nodes) == N_SRV
+
+        needles = _stage_volumes(
+            tmp_path, servers, V,
+            victim_sids=lambda v: [v % 14, (v + 1) % 14])
+        deadline = time.time() + 30
+        while time.time() < deadline and any(
+                len(leader.topo.lookup_ec_shards(v)) < 14
+                for v in range(1, V + 1)):
+            time.sleep(0.2)
+        assert all(len(leader.topo.lookup_ec_shards(v)) == 14
+                   for v in range(1, V + 1))
+
+        # -- concurrent assigns, recording every fid and every 5xx -----
+        fids: list = []
+        errs: list = []  # (t, port, code)
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                live = [m for m in masters if m.is_leader()]
+                if not live:
+                    time.sleep(0.05)  # election gap: no leader to ask
+                    continue
+                m = live[0]
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{m.port}/dir/assign",
+                            timeout=20) as r:
+                        doc = json.loads(r.read())
+                        if "fid" in doc:
+                            fids.append(doc["fid"])
+                except urllib.error.HTTPError as e:
+                    if e.code >= 500:
+                        errs.append((time.time(), m.port, e.code))
+                    e.close()
+                except OSError:
+                    pass  # connection-level, not a served 5xx
+                time.sleep(0.02)
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        deadline = time.time() + 20
+        while time.time() < deadline and len(fids) < 5:
+            time.sleep(0.1)
+        assert len(fids) >= 5, f"assigns never started: {errs}"
+
+        # -- trigger mass repair, hold it open, partition the leader ---
+        faultpoint.set_fault("repair.batch.source", "delay", delay=1.5)
+        victim = servers[0]
+        victim.stop()
+        follower_journal = quorum[0].lifecycle.journal
+        deadline = time.time() + 60
+        running = []
+        while time.time() < deadline and not running:
+            # read the RUNNING records off a FOLLOWER's journal mirror:
+            # the raft-replicated maintenance state, not the leader's
+            # local file
+            running = [j for j in follower_journal.jobs(("running",))
+                       if j.get("transition") == "mass_repair"]
+            time.sleep(0.05)
+        assert running, "no mass_repair job replicated as running"
+
+        t_cut = time.time()
+        # cut the leader off from BOTH peers, both directions (its
+        # address appears in the ctx as src or dst); volume servers
+        # still reach it — the asymmetric case
+        faultpoint.set_fault("raft.send", "error",
+                             match=f"127.0.0.1:{leader.port}")
+        faultpoint.clear_fault("repair.batch.source")
+
+        new_leader = _wait_single_leader(quorum, timeout=20)
+        new_epoch = new_leader.leader_epoch()
+        assert new_epoch > old_epoch
+
+        # check-quorum: the cut-off leader deposes itself
+        deadline = time.time() + 10
+        while time.time() < deadline and leader.is_leader():
+            time.sleep(0.05)
+        assert not leader.is_leader(), "partitioned leader never stepped down"
+        assert sum(1 for m in masters if m.is_leader()) == 1
+
+        # -- the repair completes exactly-once under the new leader ----
+        survivors = servers[1:]
+
+        def all_mounted():
+            for v in range(1, V + 1):
+                held: dict = {}
+                for s in survivors:
+                    for sid in s.store.status()["ec_volumes"].get(v, []):
+                        held[sid] = held.get(sid, 0) + 1
+                if sorted(held) != list(range(14)):
+                    return False
+                dup = {sid: c for sid, c in held.items() if c != 1}
+                assert not dup, f"duplicate shard holders: vol {v} {dup}"
+            return True
+
+        deadline = time.time() + 120
+        while time.time() < deadline and not all_mounted():
+            time.sleep(0.5)
+        assert all_mounted()
+
+        mass = {j["key"]: j
+                for j in new_leader.lifecycle.journal.jobs()
+                if j.get("transition") == "mass_repair"}
+        assert len(mass) == V, sorted(mass)
+        assert all(j["state"] == "done" for j in mass.values()), mass
+        assert any(j.get("resumed") for j in mass.values()), \
+            "no resumed marker: the new leader never replayed the journal"
+
+        # -- stale-epoch fencing: the deposed leader's rpc is refused --
+        target = survivors[0]
+        deadline = time.time() + 20
+        while time.time() < deadline and target._leader_epoch < new_epoch:
+            time.sleep(0.1)
+        assert target._leader_epoch >= new_epoch
+        stub = rpclib.volume_server_stub(
+            f"127.0.0.1:{target.port + 10000}", timeout=10)
+        with pytest.raises(grpc.RpcError) as ei:
+            stub.VolumeEcShardsBatchRebuild(
+                vs_pb.VolumeEcShardsBatchRebuildRequest(
+                    leader_epoch=old_epoch,
+                    jobs=[vs_pb.BatchRebuildJob(volume_id=1)]))
+        assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        assert STALE_EPOCH_DETAIL in (ei.value.details() or "")
+        # epoch 0 (shell operator) stays unfenced: vacuum-check passes
+        stub.VacuumVolumeCheck(vs_pb.VacuumVolumeCheckRequest(volume_id=1))
+
+        # -- heal: the old leader rejoins as a follower and converges --
+        faultpoint.clear_fault("raft.send")
+        deadline = time.time() + 20
+        while time.time() < deadline and (
+                leader.is_leader()
+                or leader.leader() != f"127.0.0.1:{new_leader.port}"):
+            time.sleep(0.1)
+        assert not leader.is_leader()
+        assert leader.leader() == f"127.0.0.1:{new_leader.port}"
+
+        stop.set()
+        t.join(timeout=20)
+        # zero duplicate fid across BOTH sides' assign logs
+        assert len(fids) == len(set(fids)), "duplicate fid assigned"
+        # zero 5xx served by the quorum side (the minority-side leader
+        # may legitimately fail a grow mid-partition; quorum must not)
+        quorum_ports = {m.port for m in quorum}
+        bad = [e for e in errs
+               if e[1] in quorum_ports or e[0] < t_cut]
+        assert not bad, f"5xx on the quorum side: {bad}"
+
+        # byte identity through the healed cluster
+        reader = survivors[0]
+        for v in (1, V):
+            for fid, want in list(needles[v].items())[:3]:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{reader.port}/{fid}",
+                        timeout=15) as r:
+                    assert r.read() == want, f"corrupt read {fid}"
+    finally:
+        faultpoint.clear_fault("raft.send")
+        faultpoint.clear_fault("repair.batch.source")
+        for s in servers[1:]:
+            s.stop()
+        for m in masters:
+            try:
+                m.stop()
+            except Exception:
+                pass
+
+
+@pytest.mark.chaos
+def test_chaos_vs_reregisters_with_new_leader_quickly(tmp_path):
+    """Satellite regression: a volume server heartbeating a leader that
+    gets partitioned away re-registers with the NEW leader within an
+    election-timeout budget — the deposed leader ends the stream, the
+    server unpins and chases without the old fixed rotation backoff."""
+    masters = _start_masters(tmp_path)
+    grpcs = [f"127.0.0.1:{m.grpc_port}" for m in masters]
+    vs = None
+    try:
+        leader = _wait_single_leader(masters)
+        quorum = [m for m in masters if m is not leader]
+        (vs,) = _start_volume_servers(tmp_path, grpcs, n=1, pulse=0.2)
+        deadline = time.time() + 20
+        while time.time() < deadline and not leader.topo.nodes:
+            time.sleep(0.1)
+        assert leader.topo.nodes
+
+        faultpoint.set_fault("raft.send", "error",
+                             match=f"127.0.0.1:{leader.port}")
+        new_leader = _wait_single_leader(quorum, timeout=20)
+        t0 = time.time()
+        # budget: one election timeout (the deposed leader's check-
+        # quorum step-down, <=0.8s) + two 0.2s pulses to detect the
+        # ended stream and rebeat, + scheduling slack on a loaded host
+        deadline = t0 + 3.0
+        while time.time() < deadline and not new_leader.topo.nodes:
+            time.sleep(0.02)
+        elapsed = time.time() - t0
+        assert new_leader.topo.nodes, \
+            f"VS did not re-register within {elapsed:.1f}s"
+        assert f"127.0.0.1:{vs.port}" in new_leader.topo.nodes
+    finally:
+        faultpoint.clear_fault("raft.send")
+        if vs is not None:
+            vs.stop()
+        for m in masters:
+            try:
+                m.stop()
+            except Exception:
+                pass
