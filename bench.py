@@ -934,6 +934,8 @@ _SNAPSHOT_PREFIXES = (
     "seaweedfs_connpool_evict_total", "seaweedfs_retry_total",
     "seaweedfs_replication_error_total", "seaweedfs_request_total",
     "seaweedfs_ec_service_jobs_total", "seaweedfs_ec_service_flush_total",
+    "seaweedfs_fsync_batch_", "seaweedfs_sendfile_",
+    "seaweedfs_ec_preadv_batches_total",
 )
 
 
@@ -969,7 +971,8 @@ def _metrics_delta(before: dict, after: dict) -> dict:
 
 def _smallfile_rates(n: int = 20000, concurrency: int = 16,
                      payload_bytes: int = 1024,
-                     metrics_snapshot: bool = False) -> dict:
+                     metrics_snapshot: bool = False,
+                     verify_bytes: bool = False) -> dict:
     """The reference's ONLY published benchmark: random write then read
     of 1KB files at c=16 through the full HTTP data path (README.md:
     514-567, `weed benchmark` defaults benchmark.go:57-59).  Runs an
@@ -1101,6 +1104,7 @@ def _smallfile_rates(n: int = 20000, concurrency: int = 16,
         }
 
         lat.clear()
+        mismatches = [0]
 
         def read_one(i: int) -> None:
             # Weyl-sequence index scramble: "random" reads without
@@ -1111,8 +1115,12 @@ def _smallfile_rates(n: int = 20000, concurrency: int = 16,
             try:
                 c.request("GET", f"/{fid}")
                 resp = c.getresponse()
-                resp.read()
+                body = resp.read()
                 if resp.status >= 300:
+                    return
+                if verify_bytes and body != payload:
+                    with lat_lock:
+                        mismatches[0] += 1
                     return
             except (http.client.HTTPException, OSError):
                 c.close()
@@ -1134,6 +1142,8 @@ def _smallfile_rates(n: int = 20000, concurrency: int = 16,
                 lat[int(len(lat) * 0.99) - 1] * 1000, 2) if lat else None,
             "smallfile_read_failed": n - len(lat),
         })
+        if verify_bytes:
+            out["smallfile_byte_mismatches"] = mismatches[0]
         if m_before is not None:
             out.update(_metrics_delta(
                 m_before,
@@ -1197,6 +1207,423 @@ def _hist_quantile(buckets, counts, count, q: float) -> float:
                 (rank - prev_cum) / (cum - prev_cum))
         prev_cum, prev_bound = cum, bound
     return buckets[-1] if buckets else 0.0
+
+
+def _serving_rates() -> dict:
+    """ISSUE 18 serving-plane stage, leg by leg:
+
+    * **fsync A/B** (`serving_fsync_write_speedup`): direct concurrent
+      Volume appends with SEAWEEDFS_TPU_DURABILITY=sync (one fsync pair
+      per mutation — the per-write strawman) vs =batch (one fsync pair
+      per group-commit barrier).  Same threads, same payloads; the
+      speedup is pure fsync batching, and the batch run's commit/write
+      counter deltas report the achieved mean batch size.
+    * **sendfile A/B** (`serving_sendfile_read_speedup`): whole-needle
+      GETs through the volume HTTP path with SEAWEEDFS_TPU_SENDFILE
+      toggled per phase (the env is read per request), needle cache off
+      so every GET takes the disk path.  Every response is sha256'd
+      against the written payload in BOTH phases —
+      `serving_byte_identity` gates the speedup.
+    * **keep-alive leg** (ISSUE 18f): parks >=2000 idle keep-alive
+      sockets on the event-loop front end, then drives M active
+      clients — req/s, p99, the server's own open-socket gauge,
+      per-socket RSS delta (client+server share this process, so the
+      delta is an upper bound on the server's share), and a post-run
+      probe of every idle socket proving zero resets.
+    """
+    import hashlib
+    import http.client
+    import os
+    import resource
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from seaweedfs_tpu.stats.metrics import (
+        FSYNC_BATCH_COMMITS,
+        FSYNC_BATCH_WRITES,
+        HTTPD_OPEN_SOCKETS,
+        SENDFILE_BYTES,
+        SENDFILE_FALLBACK,
+    )
+    from seaweedfs_tpu.storage import Needle, SuperBlock
+    from seaweedfs_tpu.storage.volume import Volume
+
+    out: dict = {}
+
+    def emit(**kv) -> None:
+        print(json.dumps({"partial": True, **kv}), flush=True)
+
+    def _with_env(key: str, val: str | None):
+        """Set/unset one env var, returning an undo callable."""
+        old = os.environ.get(key)
+        if val is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = val
+
+        def undo() -> None:
+            if old is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = old
+        return undo
+
+    # ---- leg 1: fsync A/B (direct volume appends, no HTTP) ---------------
+    n_threads = int(os.environ.get("SEAWEEDFS_TPU_BENCH_FSYNC_THREADS", "16"))
+    per_thread = int(os.environ.get("SEAWEEDFS_TPU_BENCH_FSYNC_WRITES", "64"))
+    payload_1k = os.urandom(1024)
+
+    def _fsync_writes_per_s(mode: str) -> float:
+        tmp = tempfile.mkdtemp(prefix=f"swfs-fsync-{mode}-")
+        undo = _with_env("SEAWEEDFS_TPU_DURABILITY", mode)
+        # a parked writer can't queue a second mutation, so the barrier
+        # can only ever hold n_threads pendings — cap the batch there or
+        # the leader burns the full max-delay waiting for writers that
+        # cannot arrive
+        undo_batch = _with_env("SEAWEEDFS_TPU_FSYNC_MAX_BATCH",
+                               str(n_threads))
+        try:
+            v = Volume(tmp, "", 1, super_block=SuperBlock())
+            start = threading.Barrier(n_threads)
+
+            def writer(tid: int) -> None:
+                start.wait()
+                for k in range(per_thread):
+                    v.append_needle(Needle(
+                        cookie=0x5EAF00D,
+                        id=1 + tid * per_thread + k,
+                        data=payload_1k))
+
+            threads = [threading.Thread(target=writer, args=(t,))
+                       for t in range(n_threads)]
+            t0 = time.perf_counter()
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            dt = time.perf_counter() - t0
+            v.close()
+            return n_threads * per_thread / dt
+        finally:
+            undo()
+            undo_batch()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    sync_rate = _fsync_writes_per_s("sync")
+    commits0 = FSYNC_BATCH_COMMITS.labels().value
+    writes0 = FSYNC_BATCH_WRITES.labels().value
+    batch_rate = _fsync_writes_per_s("batch")
+    commits = FSYNC_BATCH_COMMITS.labels().value - commits0
+    writes = FSYNC_BATCH_WRITES.labels().value - writes0
+    out.update({
+        "serving_fsync_sync_writes_per_s": round(sync_rate, 1),
+        "serving_fsync_batch_writes_per_s": round(batch_rate, 1),
+        "serving_fsync_write_speedup": round(batch_rate / sync_rate, 2)
+        if sync_rate else None,
+        "serving_fsync_batch_commits": int(commits),
+        "serving_fsync_mean_batch_size": round(writes / commits, 1)
+        if commits else None,
+        "serving_fsync_concurrency": n_threads,
+    })
+    emit(**{k: out[k] for k in (
+        "serving_fsync_write_speedup", "serving_fsync_mean_batch_size")})
+
+    # ---- legs 2+3 share one in-process master + volume server ------------
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    reserved: set[int] = set()
+
+    def _port() -> int:
+        import socket
+
+        while True:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                p = s.getsockname()[1]
+            if (p <= 55000 and p not in reserved
+                    and p + 10000 not in reserved):
+                reserved.update((p, p + 10000))
+                return p
+
+    tmp = tempfile.mkdtemp(prefix="swfs-serving-")
+    # cache off: a needle-cache hit declines sendfile by design, so the
+    # A/B must keep every GET on the disk path to measure the copy
+    undo_cache = _with_env("SEAWEEDFS_TPU_NEEDLE_CACHE_MB", "0")
+    master = MasterServer(ip="127.0.0.1", port=_port(),
+                          volume_size_limit_mb=1024)
+    master.start()
+    vs_ = VolumeServer(directories=[tmp], ip="127.0.0.1", port=_port(),
+                       master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+                       pulse_seconds=0.5, max_volume_count=16)
+    vs_.start()
+    local = threading.local()
+
+    def conn() -> http.client.HTTPConnection:
+        c = getattr(local, "c", None)
+        if c is None:
+            c = http.client.HTTPConnection("127.0.0.1", vs_.port,
+                                           timeout=30)
+            local.c = c
+        return c
+
+    def _post(fid: str, payload: bytes) -> int:
+        body = (b"--bb\r\nContent-Disposition: form-data; "
+                b'name="file"; filename="b.bin"\r\n\r\n'
+                + payload + b"\r\n--bb--\r\n")
+        c = conn()
+        try:
+            c.request("POST", f"/{fid}", body, {
+                "Content-Type": "multipart/form-data; boundary=bb"})
+            resp = c.getresponse()
+            resp.read()
+            return resp.status
+        except (http.client.HTTPException, OSError):
+            c.close()
+            local.c = None
+            return 599
+
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and len(master.topo.nodes) < 1:
+            time.sleep(0.1)
+
+        # ---- leg 2: sendfile A/B -----------------------------------------
+        big_n = int(os.environ.get("SEAWEEDFS_TPU_BENCH_SENDFILE_N", "48"))
+        big_bytes = int(os.environ.get(
+            "SEAWEEDFS_TPU_BENCH_SENDFILE_KB", "512")) * 1024
+        rounds = int(os.environ.get(
+            "SEAWEEDFS_TPU_BENCH_SENDFILE_ROUNDS", "8"))
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{master.port}/dir/assign?count={big_n + 4096}",
+            timeout=20,
+        ) as r:
+            first = json.loads(r.read())
+        vid, _, rest = first["fid"].partition(",")
+        key_hex, cookie = rest[:-8], rest[-8:]
+        base_key = int(key_hex, 16)
+
+        def fid(i: int) -> str:
+            return f"{vid},{base_key + i:x}{cookie}"
+
+        digests: dict[str, str] = {}
+        for i in range(big_n):
+            payload = os.urandom(big_bytes)
+            digests[fid(i)] = hashlib.sha256(payload).hexdigest()
+            assert _post(fid(i), payload) < 300, "sendfile-leg write failed"
+
+        identity_ok = True
+
+        def _read_phase(read_c: int = 8) -> float:
+            nonlocal identity_ok
+            lat_bytes = [0]
+            lock = threading.Lock()
+
+            def read_one(j: int) -> None:
+                nonlocal identity_ok
+                f = fid(j % big_n)
+                c = conn()
+                try:
+                    c.request("GET", f"/{f}")
+                    resp = c.getresponse()
+                    body = resp.read()
+                    if resp.status != 200:
+                        identity_ok = False
+                        return
+                except (http.client.HTTPException, OSError):
+                    c.close()
+                    local.c = None
+                    identity_ok = False
+                    return
+                if hashlib.sha256(body).hexdigest() != digests[f]:
+                    identity_ok = False
+                with lock:
+                    lat_bytes[0] += len(body)
+
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(read_c) as pool:
+                list(pool.map(read_one, range(big_n * rounds)))
+            dt = time.perf_counter() - t0
+            return lat_bytes[0] / dt / 1e9
+
+        undo_sf = _with_env("SEAWEEDFS_TPU_SENDFILE", "0")
+        _read_phase()  # warm the page cache so both phases read warm
+        off_gbps = _read_phase()
+        undo_sf()
+        undo_sf = _with_env("SEAWEEDFS_TPU_SENDFILE", "1")
+        sf0 = SENDFILE_BYTES.labels().value
+        on_gbps = _read_phase()
+        sf_bytes = SENDFILE_BYTES.labels().value - sf0
+        undo_sf()
+        out.update({
+            "serving_sendfile_off_GBps": round(off_gbps, 3),
+            "serving_sendfile_on_GBps": round(on_gbps, 3),
+            "serving_sendfile_read_speedup": round(on_gbps / off_gbps, 2)
+            if off_gbps else None,
+            "serving_sendfile_bytes": int(sf_bytes),
+            "serving_byte_identity": identity_ok,
+            "serving_sendfile_payload_kb": big_bytes // 1024,
+        })
+        emit(serving_sendfile_read_speedup=out[
+            "serving_sendfile_read_speedup"],
+            serving_byte_identity=identity_ok)
+
+        # ---- leg 3: thousands-of-sockets keep-alive ----------------------
+        idle_target = int(os.environ.get(
+            "SEAWEEDFS_TPU_BENCH_IDLE_SOCKETS", "2000"))
+        active_c = int(os.environ.get(
+            "SEAWEEDFS_TPU_BENCH_ACTIVE_CLIENTS", "16"))
+        active_n = int(os.environ.get(
+            "SEAWEEDFS_TPU_BENCH_ACTIVE_REQS", "4000"))
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        need = idle_target * 2 + 1024
+        if soft < need:
+            lifted = min(need, hard)
+            resource.setrlimit(resource.RLIMIT_NOFILE, (lifted, hard))
+            if lifted < need:  # hard cap too low: shrink, don't fail
+                idle_target = max(64, (lifted - 1024) // 2)
+
+        # a small-file population for the active clients (1KB GETs)
+        small_n = 256
+        for i in range(small_n):
+            p = os.urandom(1024)
+            digests[fid(big_n + i)] = hashlib.sha256(p).hexdigest()
+            assert _post(fid(big_n + i), p) < 300
+
+        def _rss_kb() -> int:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        return int(line.split()[1])
+            return 0
+
+        rss_before = _rss_kb()
+        idles: list[http.client.HTTPConnection] = []
+        idles_lock = threading.Lock()
+
+        def _park_one(_i: int) -> None:
+            c = http.client.HTTPConnection("127.0.0.1", vs_.port,
+                                           timeout=30)
+            c.request("GET", f"/{fid(big_n)}")
+            c.getresponse().read()  # keep-alive: socket parks on the loop
+            with idles_lock:
+                idles.append(c)
+
+        with ThreadPoolExecutor(64) as pool:
+            list(pool.map(_park_one, range(idle_target)))
+        time.sleep(0.5)  # let the loop account every parked socket
+        gauge_sockets = HTTPD_OPEN_SOCKETS.labels("volume").value
+        rss_after_park = _rss_kb()
+
+        lat: list[float] = []
+        lat_lock = threading.Lock()
+        failures = [0]
+
+        def _active_one(j: int) -> None:
+            f = fid(big_n + (j * 2654435761) % small_n)
+            t0 = time.perf_counter()
+            c = conn()
+            try:
+                c.request("GET", f"/{f}")
+                resp = c.getresponse()
+                resp.read()
+                if resp.status != 200:
+                    with lat_lock:
+                        failures[0] += 1
+                    return
+            except (http.client.HTTPException, OSError):
+                c.close()
+                local.c = None
+                with lat_lock:
+                    failures[0] += 1
+                return
+            with lat_lock:
+                lat.append(time.perf_counter() - t0)
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(active_c) as pool:
+            list(pool.map(_active_one, range(active_n)))
+        active_dt = time.perf_counter() - t0
+        lat.sort()
+
+        # every idle socket must still be usable: one GET each, any
+        # reset/close counts against the zero-resets gate
+        resets = [0]
+
+        def _probe_idle(c: http.client.HTTPConnection) -> None:
+            try:
+                c.request("GET", f"/{fid(big_n)}")
+                resp = c.getresponse()
+                resp.read()
+                if resp.status != 200:
+                    raise OSError("bad status")
+            except (http.client.HTTPException, OSError):
+                with idles_lock:
+                    resets[0] += 1
+
+        with ThreadPoolExecutor(64) as pool:
+            list(pool.map(_probe_idle, idles))
+        for c in idles:
+            c.close()
+
+        out.update({
+            "keepalive_idle_sockets": len(idles),
+            "keepalive_open_sockets_gauge": int(gauge_sockets),
+            "keepalive_active_reqs_per_s": round(len(lat) / active_dt, 1)
+            if active_dt else None,
+            "keepalive_active_p99_ms": round(
+                lat[int(len(lat) * 0.99) - 1] * 1000, 2) if lat else None,
+            "keepalive_active_failed": failures[0],
+            "keepalive_resets": resets[0],
+            "keepalive_rss_per_socket_kb": round(
+                max(0, rss_after_park - rss_before) / max(len(idles), 1), 2),
+            "keepalive_active_clients": active_c,
+        })
+        return out
+    finally:
+        undo_cache()
+        vs_.stop()
+        master.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _serving_smoke(concurrency: int = 64, n: int = 1500) -> dict:
+    """ISSUE 18e CI smoke: the smallfile path at c>=64 keep-alive with
+    the event-loop front end OFF then ON — every byte read back must
+    match what was written and not one response may be a 5xx (or fail
+    outright).  Bounded: two in-process clusters, ~2*n tiny requests
+    each."""
+    import os
+
+    out: dict = {"serving_smoke_concurrency": concurrency}
+    ok = True
+    for mode in ("off", "volume"):
+        old = os.environ.get("SEAWEEDFS_TPU_EVENTLOOP")
+        os.environ["SEAWEEDFS_TPU_EVENTLOOP"] = mode
+        try:
+            res = _smallfile_rates(n=n, concurrency=concurrency,
+                                   verify_bytes=True)
+        finally:
+            if old is None:
+                os.environ.pop("SEAWEEDFS_TPU_EVENTLOOP", None)
+            else:
+                os.environ["SEAWEEDFS_TPU_EVENTLOOP"] = old
+        tag = "eventloop_on" if mode == "volume" else "eventloop_off"
+        # _smallfile_rates counts any >=300 status or socket error as
+        # failed, so failed==0 across both phases IS the zero-5xx gate;
+        # verify_bytes makes every read compare against the written
+        # payload, so mismatches==0 is the byte-identity gate
+        failed = res["smallfile_failed"] + res["smallfile_read_failed"]
+        out[f"{tag}_write_reqs_per_s"] = res["smallfile_write_reqs_per_s"]
+        out[f"{tag}_read_reqs_per_s"] = res["smallfile_read_reqs_per_s"]
+        out[f"{tag}_failed"] = failed
+        out[f"{tag}_byte_mismatches"] = res["smallfile_byte_mismatches"]
+        ok = ok and failed == 0 and res["smallfile_byte_mismatches"] == 0
+    out["serving_smoke_ok"] = ok
+    return out
 
 
 def _service_rates() -> dict:
@@ -2005,6 +2432,20 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
         return
+    if "--serving-only" in sys.argv:
+        try:
+            print(json.dumps(_serving_rates()))
+        except Exception as exc:  # noqa: BLE001
+            print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
+        return
+    if "--serving-smoke-only" in sys.argv:
+        try:
+            print(json.dumps(_serving_smoke()))
+        except Exception as exc:  # noqa: BLE001
+            print(json.dumps(
+                {"serving_smoke_ok": False,
+                 "error": f"{type(exc).__name__}: {exc}"[:500]}))
+        return
     if "--smallfile-only" in sys.argv:
         try:
             print(json.dumps(_smallfile_rates(
@@ -2146,6 +2587,14 @@ def main() -> None:
             metrics_snapshot="--metrics-snapshot" in _sys.argv))
     except Exception as exc:  # noqa: BLE001
         out["smallfile_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    # ISSUE 18: serving-plane legs (fsync batching A/B, sendfile A/B,
+    # thousands-of-sockets keep-alive) — subprocess-guarded: the
+    # keep-alive leg lifts RLIMIT_NOFILE and parks ~2000 sockets
+    srv_res = _stage_in_subprocess("--serving-only",
+                                   timeout_s=stage_timeout, attempts=1)
+    if "error" in srv_res:
+        out["serving_error"] = srv_res.pop("error")[:300]
+    out.update(srv_res)
     # ISSUE 6: codec-service batching vs per-volume dispatch (host SIMD,
     # in-process, deterministic — no subprocess guard needed)
     try:

@@ -13,8 +13,12 @@ import json
 import threading
 import time
 import urllib.parse
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from ..util.httpd import FrameworkHTTPServer, shield_handler
+from http.server import BaseHTTPRequestHandler
+from ..util.httpd import (
+    BufferedResponseMixin,
+    make_http_server,
+    shield_handler,
+)
 
 from ..pb import filer_pb2
 from ..telemetry import http_request, serve_debug_http, trace
@@ -27,7 +31,7 @@ from .fleet.tenant import (
 )
 
 
-class FilerHttpHandler(BaseHTTPRequestHandler):
+class FilerHttpHandler(BufferedResponseMixin, BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "seaweedfs-tpu-filer"
 
@@ -403,11 +407,12 @@ def _entry_json(dir_path: str, e: filer_pb2.Entry) -> dict:
 shield_handler(FilerHttpHandler, "_json")
 
 
-def serve_http(filer_server, host: str, port: int) -> ThreadingHTTPServer:
+def serve_http(filer_server, host: str, port: int):
     handler = type(
         "BoundFilerHttpHandler", (FilerHttpHandler,),
         {"filer_server": filer_server},
     )
-    httpd = FrameworkHTTPServer((host, port), handler)
+    # opts into the event loop only under SEAWEEDFS_TPU_EVENTLOOP=all
+    httpd = make_http_server((host, port), handler, surface="filer")
     threading.Thread(target=httpd.serve_forever, daemon=True).start()
     return httpd

@@ -88,6 +88,14 @@ def mask(crc: int) -> int:
     return (rot + 0xA282EAD8) & 0xFFFFFFFF
 
 
+def unmask(masked: int) -> int:
+    """Inverse of mask(): recover the raw crc from the stored value —
+    the zero-copy serving path reads only the on-disk (masked) checksum
+    and must still answer the same Etag as the parse path."""
+    rot = (masked - 0xA282EAD8) & 0xFFFFFFFF
+    return ((rot << 15) | (rot >> 17)) & 0xFFFFFFFF
+
+
 def value(data: bytes | np.ndarray) -> int:
     """Masked checksum as written into needle records."""
     return mask(checksum(data))

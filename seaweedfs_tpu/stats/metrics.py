@@ -964,6 +964,47 @@ CANARY_STALENESS = REGISTRY.gauge(
     labels=("probe",),
 )
 
+# serving plane (ISSUE 18): group-commit fsync barrier + zero-copy reads
+# + the selectors event-loop front end.  One fsync acks a whole batch of
+# appends, so commits_total << writes_total is the win being measured.
+FSYNC_BATCH_COMMITS = REGISTRY.counter(
+    "seaweedfs_fsync_batch_commits_total",
+    "group-commit flush barriers executed (one fsync pair per commit)",
+)
+FSYNC_BATCH_WRITES = REGISTRY.counter(
+    "seaweedfs_fsync_batch_writes_total",
+    "volume mutations acked through a group-commit flush barrier",
+)
+_FSYNC_BATCH_BUCKETS = tuple(float(2 ** k) for k in range(0, 9))  # 1..256
+FSYNC_BATCH_SIZE = REGISTRY.histogram(
+    "seaweedfs_fsync_batch_size",
+    "mutations committed per flush barrier",
+    buckets=_FSYNC_BATCH_BUCKETS,
+)
+SENDFILE_BYTES = REGISTRY.counter(
+    "seaweedfs_sendfile_bytes_total",
+    "needle payload bytes served zero-copy via os.sendfile",
+)
+SENDFILE_FALLBACK = REGISTRY.counter(
+    "seaweedfs_sendfile_fallback_total",
+    "whole-needle GETs that fell back to the userspace read path",
+    labels=("reason",),  # disabled|cache|range|transform|ec|remote|error
+)
+HTTPD_OPEN_SOCKETS = REGISTRY.gauge(
+    "seaweedfs_httpd_open_sockets",
+    "connections currently parked on an event-loop HTTP front end",
+    labels=("server",),
+)
+HTTPD_INFLIGHT = REGISTRY.gauge(
+    "seaweedfs_httpd_inflight_requests",
+    "requests currently executing on an event-loop worker pool",
+    labels=("server",),
+)
+EC_PREADV_BATCHES = REGISTRY.counter(
+    "seaweedfs_ec_preadv_batches_total",
+    "contiguous EC shard interval runs gathered with one preadv",
+)
+
 
 def serve_metrics(port: int, registry: Registry = REGISTRY,
                   host: str = "0.0.0.0") -> ThreadingHTTPServer:

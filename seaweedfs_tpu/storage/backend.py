@@ -81,6 +81,16 @@ class DiskFile(BackendStorageFile):
             raise ValueError(f"{self.name}: file closed")
         return os.pread(f.fileno(), size, offset)
 
+    def fileno(self) -> int:
+        """Raw fd for zero-copy serving (os.sendfile).  Callers that
+        outlive the volume lock must os.dup() it so a racing handle swap
+        (vacuum commit) can neither close it mid-send nor let the kernel
+        recycle the number onto another file."""
+        f = self._f
+        if f.closed:
+            raise ValueError(f"{self.name}: file closed")
+        return f.fileno()
+
     def write_at(self, offset: int, data: bytes) -> int:
         """-> bytes actually written.  The `disk.write` faultpoint family
         fires here (storage/disk_health.py): error/enospc/partial raise a

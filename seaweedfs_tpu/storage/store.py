@@ -344,6 +344,28 @@ class Store:
             return n
         raise KeyError(f"volume {vid} not found")
 
+    def needle_extent(self, vid: int, needle_id: int):
+        """-> (NeedleExtent | None, fallback_reason | None) for the
+        zero-copy GET path.  A needle-cache hit declines the extent —
+        bytes already in memory beat a disk→socket sendfile; EC and
+        remote-tier volumes decline too (their bytes aren't a contiguous
+        local .dat range).  Raises KeyError like read_needle when
+        neither a volume nor the needle exists."""
+        cache = self.needle_cache
+        if cache is not None and cache.get(vid, needle_id) is not None:
+            return None, "cache"
+        v = self.find_volume(vid)
+        if v is None:
+            if self.find_ec_volume(vid) is not None:
+                return None, "ec"
+            raise KeyError(f"volume {vid} not found")
+        if v.is_remote:
+            return None, "remote"
+        ext = v.needle_extent(needle_id)
+        if ext is None:
+            return None, "error"
+        return ext, None
+
     def delete_needle(self, vid: int, needle_id: int) -> int:
         v = self.find_volume(vid)
         if v is None:

@@ -22,9 +22,11 @@ import threading
 import time
 
 from . import types as t
+from ..ops import crc32c
 from ..util import faultpoint, glog
 from .backend import DiskFile, get_backend
 from .disk_health import DiskFullError, classify_write_error
+from .group_commit import GroupCommitter, Pending
 from .idx import IndexWriter, append_index_tombstone, walk_index_file
 from .needle import Needle, actual_size, body_length
 from .needle_map import NeedleMap
@@ -49,8 +51,47 @@ def set_needle_map_kind(kind: str) -> None:
     if kind not in ("memory", "disk"):
         raise ValueError("index kind must be memory or disk")
     DEFAULT_NEEDLE_MAP_KIND = kind
+
+
+def durability_mode() -> str:
+    """Per-mutation durability (group_commit.py): "none" (page cache
+    only, today's default), "sync" (one fsync pair per mutation), or
+    "batch" (group-commit barrier — one fsync acks many mutations)."""
+    mode = os.environ.get("SEAWEEDFS_TPU_DURABILITY", "none").strip().lower()
+    return mode if mode in ("none", "sync", "batch") else "none"
 from .super_block import CURRENT_VERSION, SUPER_BLOCK_SIZE, SuperBlock
 from .vif import load_volume_info, save_volume_info
+
+
+class NeedleExtent:
+    """A needle's payload located on disk for zero-copy serving: a
+    dup'd .dat fd the caller OWNS (close() exactly once) plus the byte
+    range os.sendfile should ship, and the metadata-only Needle (no
+    data) for headers/cookie checks."""
+
+    __slots__ = ("fd", "data_offset", "data_len", "needle", "_closed")
+
+    def __init__(self, fd: int, data_offset: int, data_len: int,
+                 needle: Needle):
+        self.fd = fd
+        self.data_offset = data_offset
+        self.data_len = data_len
+        self.needle = needle
+        self._closed = False
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            try:
+                os.close(self.fd)
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class Volume:
@@ -119,6 +160,17 @@ class Volume:
             )
         self.check_and_fix_integrity()
         self._idx = IndexWriter(base + ".idx")
+        self.durability = durability_mode()
+        self._group = (GroupCommitter(self)
+                       if self.durability == "batch" and not self.is_remote
+                       else None)
+        # (needle_id, offset) pairs whose payload CRC has been verified
+        # for zero-copy serving: sendfile ships bytes the CPU never
+        # sees, so the first extent serve of a needle pays one userspace
+        # read + crc32c and later serves skip it.  Keyed by offset so an
+        # overwrite (new offset) re-verifies; bounded, cleared on
+        # overflow (worst case = re-verify, never serve rotten bytes).
+        self._extent_verified: set[tuple[int, int]] = set()
 
     def _remote_dat_file(self):
         """RemoteBackendFile when the .vif maps the .dat to a configured
@@ -196,6 +248,34 @@ class Volume:
             self.read_only_reason = "full"
         return typed
 
+    def _publish_append(self, needle_id: int, offset: int,
+                        size: int) -> None:
+        """Make an append visible: needle-map entry + write_seq bump +
+        health credit.  Callers hold the volume lock.  In batch mode the
+        flush barrier calls this AFTER its fsync — no reader can observe
+        a needle whose bytes aren't durable yet."""
+        old = self.needle_map.get(needle_id)
+        if old is None or old.offset < offset:
+            self.needle_map.put(needle_id, offset, size)
+        if self.health is not None:
+            self.health.record_write_ok()
+        self.write_seq = next(_MUTATION_SEQ)
+
+    def _publish_delete(self, needle_id: int) -> None:
+        self.needle_map.delete(needle_id)
+        if self.health is not None:
+            self.health.record_write_ok()
+        self.write_seq = next(_MUTATION_SEQ)
+
+    def _sync_now(self, start: int, idx_pos: int | None) -> None:
+        """Strict per-mutation durability ("sync" mode): one fsync pair
+        before the publish/ack, rolled back like any failed write."""
+        try:
+            self._dat.sync()
+            self._idx.flush()
+        except OSError as e:
+            raise self._fail_write(e, start, idx_pos) from e
+
     def append_needle(self, n: Needle) -> tuple[int, int]:
         """Append; returns (actual_offset, stored_size).
 
@@ -203,7 +283,13 @@ class Volume:
         after the .dat blob landed in full; any OSError rolls the .dat
         back to its pre-append size and surfaces as a typed
         DiskFullError/DiskFailingError — a mid-blob ENOSPC can never
-        leave a published index entry pointing at a torn tail."""
+        leave a published index entry pointing at a torn tail.
+
+        Durability modes (group_commit.py): "none" acks from the page
+        cache; "sync" fsyncs per append; "batch" parks on the volume's
+        flush barrier OUTSIDE the lock — concurrent writers keep
+        appending while this one waits, and one fsync acks them all."""
+        group = self._group
         with self._lock:
             self._check_writable()
             start = self._dat.file_size()
@@ -225,6 +311,7 @@ class Volume:
                         5, f"short write: {wrote}/{len(blob)} bytes")
             except OSError as e:
                 raise self._fail_write(e, start) from e
+            idx_pos = None
             old = self.needle_map.get(n.id)
             if old is None or old.offset < offset:
                 idx_pos = self._idx.tell()
@@ -234,11 +321,16 @@ class Volume:
                     # the blob is durable but unindexed: roll BOTH back —
                     # an acked write must be remount-provable via the .idx
                     raise self._fail_write(e, start, idx_pos) from e
-                self.needle_map.put(n.id, offset, n.size)
-            if self.health is not None:
-                self.health.record_write_ok()
-            self.write_seq = next(_MUTATION_SEQ)
-            return offset, n.size
+            if group is None:
+                if self.durability == "sync":
+                    self._sync_now(start, idx_pos)
+                self._publish_append(n.id, offset, n.size)
+                return offset, n.size
+            pending = Pending(
+                lambda: self._publish_append(n.id, offset, n.size),
+                start, idx_pos)
+        group.park(pending)  # outside the lock: the barrier batches
+        return offset, n.size
 
     def delete_needle(self, needle_id: int,
                       at_ns: int | None = None) -> int:
@@ -248,6 +340,7 @@ class Volume:
         delete is replayed from another server (tail receivers, backup
         mirrors) — a locally-stamped tombstone would poison tail
         watermarks under clock skew."""
+        group = self._group
         with self._lock:
             self._check_writable(for_delete=True)
             existing = self.needle_map.get(needle_id)
@@ -276,12 +369,19 @@ class Volume:
                 self._idx.delete(needle_id, offset)
             except OSError as e:
                 raise self._fail_write(e, start, idx_pos) from e
-            self.needle_map.delete(needle_id)
-            if self.health is not None:
-                self.health.record_write_ok()
             self.last_modified_second = int(time.time())
-            self.write_seq = next(_MUTATION_SEQ)
-            return max(existing.size, 0)
+            freed = max(existing.size, 0)
+            if group is None:
+                if self.durability == "sync":
+                    self._sync_now(start, idx_pos)
+                self._publish_delete(needle_id)
+                return freed
+            pending = Pending(
+                lambda: self._publish_delete(needle_id), start, idx_pos)
+        group.park(pending)  # tombstones ride the same barrier: the
+        # batch rollback may truncate anything above its start, so every
+        # mutation on a batch-mode volume must be IN the batch
+        return freed
 
     # -- read path --------------------------------------------------------
 
@@ -332,6 +432,83 @@ class Volume:
         if expected_cookie is not None and n.cookie != expected_cookie:
             raise PermissionError("cookie mismatch")
         return n
+
+    def needle_extent(self, needle_id: int) -> "NeedleExtent | None":
+        """Zero-copy serving descriptor: the needle's METADATA (header,
+        flags, name/mime, stored checksum) parsed from two small preads,
+        plus a dup'd fd + (offset, length) naming the payload bytes in
+        the .dat — os.sendfile streams them disk→socket without ever
+        entering userspace.  The dup (taken under the lock) pins the
+        open file description, so a racing vacuum handle swap can
+        neither close it mid-send nor recycle the fd number onto another
+        file; the dup'd fd reads the OLD append-only .dat, whose bytes
+        for this needle are immutable.
+
+        Returns None when the volume can't serve an extent (remote tier,
+        v1 layout, empty payload, parse anomaly) — callers fall back to
+        the ordinary read path.  Raises KeyError like read_needle when
+        the needle doesn't exist."""
+        with self._lock:
+            nv = self.needle_map.get(needle_id)
+            if nv is None or t.size_is_deleted(nv.size):
+                raise KeyError(f"needle {needle_id:x} not found")
+            dat = self._dat
+            version = self.version
+            if dat.is_remote or version not in (2, 3) or nv.size <= 0:
+                return None
+            try:
+                fd = os.dup(dat.fileno())
+            except (OSError, ValueError, AttributeError):
+                return None
+        try:
+            head = os.pread(fd, t.NEEDLE_HEADER_SIZE + 4, nv.offset)
+            if len(head) != t.NEEDLE_HEADER_SIZE + 4:
+                raise ValueError("short header read")
+            n = Needle.parse_header(head)
+            if n.id != needle_id or n.size != nv.size:
+                raise ValueError("stale extent header")
+            data_size = struct.unpack(
+                ">I", head[t.NEEDLE_HEADER_SIZE:])[0]
+            meta_len = nv.size - 4 - data_size
+            if meta_len < 1:  # at least the flags byte
+                raise ValueError("needle data out of range")
+            tail_len = meta_len + t.NEEDLE_CHECKSUM_SIZE
+            if version == 3:
+                tail_len += t.TIMESTAMP_SIZE
+            tail = os.pread(
+                fd, tail_len,
+                nv.offset + t.NEEDLE_HEADER_SIZE + 4 + data_size)
+            if len(tail) != tail_len:
+                raise ValueError("short meta read")
+            # a zero-length fake data field turns the tail into a valid
+            # v2 body, so the standard field walk parses flags/name/mime
+            n.parse_body_v2(struct.pack(">I", 0) + tail[:meta_len])
+            stored = struct.unpack(
+                ">I", tail[meta_len:meta_len + 4])[0]
+            n.checksum = crc32c.unmask(stored)
+            if version == 3:
+                n.append_at_ns = struct.unpack(
+                    ">Q", tail[meta_len + 4:meta_len + 12])[0]
+            # first serve of this (needle, offset) pays one userspace
+            # read to verify the payload CRC — sendfile would otherwise
+            # ship rotten bytes as a 200 that the ordinary read path
+            # turns into CorruptNeedleError + quarantine.  The read also
+            # warms the page cache for the sendfile that follows.
+            vkey = (needle_id, nv.offset)
+            if vkey not in self._extent_verified:
+                data = os.pread(
+                    fd, data_size, nv.offset + t.NEEDLE_HEADER_SIZE + 4)
+                if (len(data) != data_size
+                        or crc32c.checksum(data) != n.checksum):
+                    raise ValueError("extent payload CRC mismatch")
+                if len(self._extent_verified) >= 65536:
+                    self._extent_verified.clear()
+                self._extent_verified.add(vkey)
+            return NeedleExtent(
+                fd, nv.offset + t.NEEDLE_HEADER_SIZE + 4, data_size, n)
+        except (OSError, ValueError, struct.error):
+            os.close(fd)
+            return None
 
     # -- remote tier ------------------------------------------------------
 

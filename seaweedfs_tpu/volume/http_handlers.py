@@ -9,12 +9,18 @@ EC volumes transparently; replicated writes fan out to peers with
 from __future__ import annotations
 
 import json
+import os
+import select
 import threading
 import time
 import urllib.parse
 import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from ..util.httpd import FrameworkHTTPServer, shield_handler
+from http.server import BaseHTTPRequestHandler
+from ..util.httpd import (
+    BufferedResponseMixin,
+    make_http_server,
+    shield_handler,
+)
 
 from .. import images
 from ..security.jwt import token_from_header, verify_write_jwt
@@ -27,8 +33,18 @@ from ..storage.needle import (
     CorruptNeedleError,
     Needle,
 )
-from ..stats.metrics import VOLUME_FULL_REJECT
+from ..stats.metrics import (
+    SENDFILE_BYTES,
+    SENDFILE_FALLBACK,
+    VOLUME_FULL_REJECT,
+)
 from ..util import faultpoint
+
+
+def _sendfile_enabled() -> bool:
+    return os.environ.get(
+        "SEAWEEDFS_TPU_SENDFILE", "1").strip().lower() not in (
+        "0", "off", "false", "none")
 
 # chaos points on the public data path; ctx is this server's host:port so
 # one server out of several in-process can be targeted via &match=
@@ -36,7 +52,7 @@ FP_GET = faultpoint.register("volume.http.get")
 FP_POST = faultpoint.register("volume.http.post")
 
 
-class VolumeHttpHandler(BaseHTTPRequestHandler):
+class VolumeHttpHandler(BufferedResponseMixin, BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "seaweedfs-tpu-volume"
 
@@ -164,6 +180,8 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
         try:
             me = f"{self.volume_server.ip}:{self.volume_server.port}"
             faultpoint.inject(FP_GET, ctx=me)
+            if self._maybe_sendfile(fid, path):
+                return
             n = self.store.read_needle(fid.volume_id, fid.key)
         except KeyError:
             return self._send_json(404, {"error": "not found"})
@@ -220,6 +238,100 @@ class VolumeHttpHandler(BaseHTTPRequestHandler):
             except ValueError:
                 return self._send_json(416, {"error": "bad range"})
         self._send(200, data, mime, extra)
+
+    # -- zero-copy read path ----------------------------------------------
+
+    def _maybe_sendfile(self, fid, path) -> bool:
+        """Whole-needle GETs serve disk→socket via os.sendfile: the
+        payload bytes never enter userspace.  Anything that must touch
+        the bytes (Range math, image transforms) or that has them in
+        memory already (needle cache) declines and falls back to the
+        ordinary read path.  -> True when the response was fully
+        handled here."""
+        if not _sendfile_enabled():
+            SENDFILE_FALLBACK.labels("disabled").inc()
+            return False
+        if self.headers.get("Range"):
+            SENDFILE_FALLBACK.labels("range").inc()
+            return False
+        ext, reason = self.store.needle_extent(fid.volume_id, fid.key)
+        if ext is None:
+            SENDFILE_FALLBACK.labels(reason or "error").inc()
+            return False
+        with ext:
+            n = ext.needle
+            if n.cookie != fid.cookie:
+                self._send_json(404, {"error": "cookie mismatch"})
+                return True
+            mime = (n.mime.decode() if n.has(FLAG_HAS_MIME) and n.mime
+                    else "application/octet-stream")
+            name = n.name.decode(errors="replace") if n.name else path.path
+            file_ext = ("." + name.rsplit(".", 1)[1].lower()
+                        if "." in name else "")
+            if images.is_image(file_ext, mime):
+                # the GET pipeline re-orients/resizes images in
+                # userspace; zero-copy would skip it
+                SENDFILE_FALLBACK.labels("transform").inc()
+                return False
+            self.send_response(200)
+            self.send_header("Content-Type", mime)
+            self.send_header("Content-Length", str(ext.data_len))
+            self.send_header("Etag", f'"{n.checksum:x}"')
+            self.send_header("Accept-Ranges", "bytes")
+            self.end_headers()
+            self._stream_extent(ext)
+        return True
+
+    def _stream_extent(self, ext) -> None:
+        """Ship ext's byte range after the headers: sendfile first, a
+        pread→write loop if the very first sendfile call is refused
+        (odd socket type); a failure after any payload byte went out
+        can only close the connection — the stream is torn."""
+        try:
+            self.wfile.flush()  # headers must precede the payload
+        except OSError:
+            self.close_connection = True
+            return
+        sock = self.connection
+        offset, remaining = ext.data_offset, ext.data_len
+        sent_any = False
+        try:
+            while remaining > 0:
+                try:
+                    sent = os.sendfile(
+                        sock.fileno(), ext.fd, offset, remaining)
+                except BlockingIOError:
+                    # the socket send buffer is full (the fd is
+                    # non-blocking under a socket timeout): wait until
+                    # writable, bounded by the same timeout
+                    r = select.select(
+                        [], [sock], [], sock.gettimeout() or 60.0)
+                    if not r[1]:
+                        raise OSError(110, "sendfile stalled") from None
+                    continue
+                if sent == 0:
+                    raise OSError(5, "sendfile returned 0")
+                sent_any = True
+                offset += sent
+                remaining -= sent
+            SENDFILE_BYTES.inc(ext.data_len)
+        except (OSError, AttributeError):
+            if sent_any:
+                self.close_connection = True
+                return
+            SENDFILE_FALLBACK.labels("error").inc()
+            try:
+                while remaining > 0:
+                    chunk = os.pread(
+                        ext.fd, min(remaining, 1 << 18), offset)
+                    if not chunk:
+                        raise OSError(5, "short extent read")
+                    self.wfile.write(chunk)
+                    offset += len(chunk)
+                    remaining -= len(chunk)
+                self.wfile.flush()
+            except OSError:
+                self.close_connection = True
 
     def do_HEAD(self):
         """HEAD answers from needle metadata alone: no EXIF re-orientation,
@@ -456,13 +568,15 @@ def _parse_multipart(body: bytes, ctype: str) -> tuple[bytes, bytes, bytes]:
 shield_handler(VolumeHttpHandler, "_send_json")
 
 
-def serve_http(volume_server, host: str, port: int) -> ThreadingHTTPServer:
+def serve_http(volume_server, host: str, port: int):
     handler = type(
         "BoundVolumeHttpHandler",
         (VolumeHttpHandler,),
         {"volume_server": volume_server},
     )
-    httpd = FrameworkHTTPServer((host, port), handler)
+    # the volume data port is the event-loop front end's first surface
+    # (SEAWEEDFS_TPU_EVENTLOOP=off falls back to thread-per-connection)
+    httpd = make_http_server((host, port), handler, surface="volume")
     thread = threading.Thread(target=httpd.serve_forever, daemon=True)
     thread.start()
     return httpd

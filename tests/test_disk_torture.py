@@ -104,6 +104,66 @@ print("FINISHED")
 """
 
 
+# the group-commit torture writer: N concurrent threads append through
+# the batch-fsync barrier and ack ONLY after append_needle returns (no
+# explicit sync — the barrier fsync IS the durability edge), then the
+# process SIGKILLs itself mid-stream.  The kill is ack-count-triggered
+# (a wall-clock timer races host speed: an idle box drains every write
+# before the timer fires, a loaded one starves it), plus a 0-2ms jitter
+# so the kill also lands INSIDE a barrier flush, not only between them.
+# argv = repo, dir, base_id, n_threads, per_thread, kill_at, jitter_us
+BATCH_CHILD = r"""
+import os, random, signal, sys, threading, time
+
+repo, dirpath, base_id, n_threads, per_thread, kill_at, jitter_us = sys.argv[1:8]
+sys.path.insert(0, repo)
+base_id, n_threads = int(base_id), int(n_threads)
+per_thread, kill_at = int(per_thread), int(kill_at)
+jitter_us = int(jitter_us)
+
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+def payload(i):
+    import hashlib
+    seedb = hashlib.sha256(b"needle-%d" % i).digest()
+    return (seedb * (1 + (i * 37) % 40))[: 32 + (i * 131) % 1200]
+
+v = Volume(dirpath, "", 1)
+assert v.durability == "batch", v.durability
+ack = open(os.path.join(dirpath, "acks.log"), "a")
+ack_lock = threading.Lock()
+acked = [0]
+
+def writer(tid):
+    for k in range(per_thread):
+        i = base_id + tid * per_thread + k
+        n = Needle(cookie=1234, id=i, data=payload(i))
+        try:
+            v.append_needle(n)  # parks on the flush barrier
+        except Exception:
+            return
+        with ack_lock:
+            ack.write("put %d\n" % i)
+            ack.flush(); os.fsync(ack.fileno())
+            acked[0] += 1
+
+def killer():
+    while acked[0] < kill_at:
+        time.sleep(0.0002)
+    time.sleep(jitter_us / 1e6)
+    os.kill(os.getpid(), signal.SIGKILL)
+
+threading.Thread(target=killer, daemon=True).start()
+threads = [threading.Thread(target=writer, args=(t,), daemon=True)
+           for t in range(n_threads)]
+for th in threads:
+    th.start()
+time.sleep(10.0)  # fallback: the killer thread should always win
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+
+
 def _payload(i: int) -> bytes:
     seedb = hashlib.sha256(b"needle-%d" % i).digest()
     return (seedb * (1 + (i * 37) % 40))[: 32 + (i * 131) % 1200]
@@ -208,10 +268,66 @@ def _run_torture(tmp_path, cycles: int, seed: int = 0) -> int:
     return kills
 
 
+def _run_batch_torture(tmp_path, cycles: int, seed: int = 0) -> tuple[int, int]:
+    """SIGKILL mid-group-commit: concurrent writers ack only after the
+    flush-barrier fsync, the process dies at a random instant, and the
+    remount must serve every acked write byte-identical with the torn
+    unacked tail rolled back by the load-time healer.
+
+    -> (cycles killed mid-flight, total acked writes)."""
+    import random
+
+    rng = random.Random(seed)
+    dirpath = str(tmp_path)
+    base_id = 1
+    mid_flight = 0
+    total_acked = 0
+    for cycle in range(cycles):
+        n_threads = rng.randrange(3, 7)
+        per_thread = rng.randrange(8, 20)
+        total = n_threads * per_thread
+        # die after a random prefix of the acks (never the whole run),
+        # with up to 2ms extra so some kills land inside a barrier
+        kill_at = rng.randrange(1, max(2, total * 2 // 3))
+        jitter_us = rng.randrange(0, 2000)
+        proc = subprocess.run(
+            [sys.executable, "-c", BATCH_CHILD, REPO, dirpath,
+             str(base_id), str(n_threads), str(per_thread),
+             str(kill_at), str(jitter_us)],
+            capture_output=True, text=True, timeout=60,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "SEAWEEDFS_TPU_NEEDLE_CACHE_MB": "0",
+                 "SEAWEEDFS_TPU_DURABILITY": "batch",
+                 # small batches + a real delay window so the kill lands
+                 # between barrier flushes, not only inside one
+                 "SEAWEEDFS_TPU_FSYNC_MAX_BATCH": "8",
+                 "SEAWEEDFS_TPU_FSYNC_MAX_DELAY_MS": "2"},
+        )
+        assert proc.returncode == -signal.SIGKILL, (
+            f"cycle {cycle}: batch child exited {proc.returncode}\n"
+            f"{proc.stderr[-2000:]}")
+        live, _, _ = _parse_acks(os.path.join(dirpath, "acks.log"))
+        acked_now = len(live)
+        if acked_now - total_acked < n_threads * per_thread:
+            mid_flight += 1
+        total_acked = acked_now
+        _verify_cycle(dirpath, cycle)
+        base_id += n_threads * per_thread
+    return mid_flight, total_acked
+
+
 def test_torture_smoke(tmp_path):
     """Tier-1: a handful of randomized kill-point cycles."""
     kills = _run_torture(tmp_path, cycles=6, seed=1)
     assert kills >= 1  # the harness must actually be killing writers
+
+
+def test_torture_batch_commit_smoke(tmp_path):
+    """Tier-1: SIGKILL mid-group-commit — acked batch writes survive
+    remount byte-identical, unacked writes roll back (ISSUE 18)."""
+    mid_flight, acked = _run_batch_torture(tmp_path, cycles=5, seed=3)
+    assert mid_flight >= 1  # the kill must interrupt in-flight batches
+    assert acked >= 1       # and some writes must have been acked first
 
 
 @pytest.mark.chaos
@@ -222,3 +338,13 @@ def test_torture_hundred_cycles(tmp_path):
     kills = _run_torture(tmp_path, cycles=cycles, seed=2)
     # the vast majority of cycles must die mid-write, not run to finish
     assert kills >= cycles // 2
+
+
+@pytest.mark.chaos
+def test_torture_batch_commit_cycles(tmp_path):
+    """Chaos run of the group-commit kill leg: many randomized
+    SIGKILL-mid-batch cycles, durability invariant checked per cycle."""
+    cycles = int(os.environ.get("SEAWEEDFS_TPU_TORTURE_BATCH_CYCLES", "30"))
+    mid_flight, acked = _run_batch_torture(tmp_path, cycles=cycles, seed=4)
+    assert mid_flight >= cycles // 3
+    assert acked >= cycles  # every cycle must land some durable writes

@@ -1,0 +1,396 @@
+"""Event-loop front end unit suite (ISSUE 18): keep-alive parking,
+pipelining, chunked-body drain, idle-socket scale, oversized headers,
+single-syscall response writes, and the backlog/front-end knobs."""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler
+
+import pytest
+
+from seaweedfs_tpu.util.httpd import (
+    _BufferedSocketWriter,
+    EventLoopHTTPServer,
+    drain_request_body,
+    eventloop_enabled,
+    listen_backlog,
+    make_http_server,
+)
+
+
+class EchoHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _reply(self, code: int, body: bytes):
+        self.send_response(code)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if self.command != "HEAD":
+            self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/slow":
+            time.sleep(0.2)
+        self._reply(200, b"path=%s" % self.path.encode())
+
+    def do_HEAD(self):
+        self._reply(200, b"path=%s" % self.path.encode())
+
+    def do_POST(self):
+        if self.path == "/drain":
+            # early reply without reading the body: hygiene helper must
+            # keep the connection usable for small chunked bodies
+            drain_request_body(self, cap=1 << 16)
+            self._reply(200, b"drained")
+            return
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length)
+        self._reply(200, b"len=%d" % len(body))
+
+
+@pytest.fixture
+def loop_server():
+    srv = EventLoopHTTPServer(("127.0.0.1", 0), EchoHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def _connect(srv) -> socket.socket:
+    s = socket.create_connection(srv.server_address, timeout=10)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+def _read_response(sock) -> tuple[int, bytes]:
+    """One HTTP/1.1 response off the socket (Content-Length framing)."""
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        chunk = sock.recv(65536)
+        assert chunk, f"connection closed mid-headers: {buf!r}"
+        buf += chunk
+    head, rest = buf.split(b"\r\n\r\n", 1)
+    status = int(head.split(b" ", 2)[1])
+    length = 0
+    for line in head.split(b"\r\n")[1:]:
+        k, _, v = line.partition(b":")
+        if k.strip().lower() == b"content-length":
+            length = int(v.strip())
+    while len(rest) < length:
+        chunk = sock.recv(65536)
+        assert chunk, "connection closed mid-body"
+        rest += chunk
+    return status, rest[:length]
+
+
+def test_keepalive_sequential_requests(loop_server):
+    s = _connect(loop_server)
+    try:
+        for i in range(5):
+            s.sendall(b"GET /r%d HTTP/1.1\r\nHost: x\r\n\r\n" % i)
+            code, body = _read_response(s)
+            assert code == 200 and body == b"path=/r%d" % i
+    finally:
+        s.close()
+
+
+def test_pipelined_requests(loop_server):
+    s = _connect(loop_server)
+    try:
+        s.sendall(
+            b"GET /a HTTP/1.1\r\nHost: x\r\n\r\n"
+            b"GET /b HTTP/1.1\r\nHost: x\r\n\r\n"
+            b"GET /c HTTP/1.1\r\nHost: x\r\n\r\n")
+        for path in (b"/a", b"/b", b"/c"):
+            code, body = _read_response(s)
+            assert code == 200 and body == b"path=" + path
+    finally:
+        s.close()
+
+
+def test_post_body_and_keepalive(loop_server):
+    s = _connect(loop_server)
+    try:
+        payload = b"z" * 5000
+        s.sendall(
+            b"POST /p HTTP/1.1\r\nHost: x\r\n"
+            b"Content-Length: %d\r\n\r\n" % len(payload) + payload)
+        code, body = _read_response(s)
+        assert code == 200 and body == b"len=5000"
+        s.sendall(b"GET /after HTTP/1.1\r\nHost: x\r\n\r\n")
+        code, body = _read_response(s)
+        assert code == 200 and body == b"path=/after"
+    finally:
+        s.close()
+
+
+def test_chunked_drain_keeps_connection(loop_server):
+    s = _connect(loop_server)
+    try:
+        chunked = (b"POST /drain HTTP/1.1\r\nHost: x\r\n"
+                   b"Transfer-Encoding: chunked\r\n\r\n"
+                   b"5\r\nhello\r\n3\r\nxyz\r\n0\r\n\r\n")
+        s.sendall(chunked)
+        code, body = _read_response(s)
+        assert code == 200 and body == b"drained"
+        # the framing was fully consumed: the next request parses clean
+        s.sendall(b"GET /next HTTP/1.1\r\nHost: x\r\n\r\n")
+        code, body = _read_response(s)
+        assert code == 200 and body == b"path=/next"
+    finally:
+        s.close()
+
+
+def test_chunked_drain_with_trailers(loop_server):
+    s = _connect(loop_server)
+    try:
+        s.sendall(b"POST /drain HTTP/1.1\r\nHost: x\r\n"
+                  b"Transfer-Encoding: chunked\r\n\r\n"
+                  b"4\r\nabcd\r\n0\r\nX-Trailer: 1\r\n\r\n")
+        code, body = _read_response(s)
+        assert code == 200 and body == b"drained"
+        s.sendall(b"GET /t HTTP/1.1\r\nHost: x\r\n\r\n")
+        code, body = _read_response(s)
+        assert code == 200 and body == b"path=/t"
+    finally:
+        s.close()
+
+
+def test_oversized_header_431(loop_server):
+    s = _connect(loop_server)
+    try:
+        s.sendall(b"GET / HTTP/1.1\r\nHost: x\r\nX-Big: ")
+        s.sendall(b"a" * (70 << 10))  # past MAX_HEADER_BYTES, no blank line
+        code, _body = _read_response(s)
+        assert code == 431
+        # and the loop closed the connection
+        s.settimeout(5)
+        assert s.recv(1024) == b""
+    finally:
+        s.close()
+
+
+def test_many_idle_sockets_stay_off_threads(loop_server):
+    """Hundreds of idle keep-alive connections cost loop buffers, not
+    worker threads — an active request still answers immediately."""
+    idle = []
+    try:
+        for _ in range(200):
+            idle.append(_connect(loop_server))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if len(loop_server._conns) >= 200:
+                break
+            time.sleep(0.01)
+        assert len(loop_server._conns) >= 200
+        # pool is far smaller than the socket count, yet requests flow
+        assert loop_server._workers < 200
+        s = _connect(loop_server)
+        try:
+            s.sendall(b"GET /live HTTP/1.1\r\nHost: x\r\n\r\n")
+            code, body = _read_response(s)
+            assert code == 200 and body == b"path=/live"
+        finally:
+            s.close()
+        # the open-socket gauge tracks the parked population
+        assert loop_server._open_gauge.value >= 200
+    finally:
+        for s in idle:
+            s.close()
+
+
+def test_concurrent_clients(loop_server):
+    errs = []
+
+    def worker(i):
+        try:
+            s = _connect(loop_server)
+            try:
+                for k in range(3):
+                    s.sendall(b"GET /c%d-%d HTTP/1.1\r\nHost: x\r\n\r\n"
+                              % (i, k))
+                    code, body = _read_response(s)
+                    assert code == 200
+                    assert body == b"path=/c%d-%d" % (i, k)
+            finally:
+                s.close()
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs, errs
+
+
+def test_connection_close_honored(loop_server):
+    s = _connect(loop_server)
+    try:
+        s.sendall(b"GET /bye HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n")
+        code, body = _read_response(s)
+        assert code == 200 and body == b"path=/bye"
+        s.settimeout(5)
+        assert s.recv(1024) == b""  # server closed after the response
+    finally:
+        s.close()
+
+
+def test_idle_sweep_closes_stale_conns(monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_LOOP_IDLE_TIMEOUT_S", "1")
+    srv = EventLoopHTTPServer(("127.0.0.1", 0), EchoHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        s = _connect(srv)
+        deadline = time.monotonic() + 3
+        while time.monotonic() < deadline and not srv._conns:
+            time.sleep(0.01)
+        assert srv._conns
+        # force an immediate sweep rather than waiting the 5s cadence
+        srv._sweep_idle(time.monotonic() + 10)
+        s.settimeout(5)
+        assert s.recv(1024) == b""
+        s.close()
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_shutdown_unblocks_and_closes(loop_server):
+    s = _connect(loop_server)
+    s.sendall(b"GET /x HTTP/1.1\r\nHost: x\r\n\r\n")
+    code, _ = _read_response(s)
+    assert code == 200
+    loop_server.shutdown()
+    assert loop_server._stopped.is_set()
+    s.close()
+
+
+# -- knobs and seam ----------------------------------------------------------
+
+
+def test_listen_backlog_env_clamp(monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_LISTEN_BACKLOG", "64")
+    assert listen_backlog() == 64
+    monkeypatch.setenv("SEAWEEDFS_TPU_LISTEN_BACKLOG", "0")
+    assert listen_backlog() == 1  # floor
+    monkeypatch.setenv("SEAWEEDFS_TPU_LISTEN_BACKLOG", "10000000")
+    from seaweedfs_tpu.util.httpd import _somaxconn
+
+    assert listen_backlog() == _somaxconn()  # somaxconn ceiling
+    monkeypatch.setenv("SEAWEEDFS_TPU_LISTEN_BACKLOG", "garbage")
+    assert listen_backlog() == 128  # default on parse failure
+
+
+def test_eventloop_enabled_modes(monkeypatch):
+    monkeypatch.delenv("SEAWEEDFS_TPU_EVENTLOOP", raising=False)
+    assert eventloop_enabled("volume") is True  # default: volume only
+    assert eventloop_enabled("filer") is False
+    monkeypatch.setenv("SEAWEEDFS_TPU_EVENTLOOP", "all")
+    assert eventloop_enabled("filer") is True
+    monkeypatch.setenv("SEAWEEDFS_TPU_EVENTLOOP", "off")
+    assert eventloop_enabled("volume") is False
+
+
+def test_make_http_server_seam(monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_EVENTLOOP", "off")
+    srv = make_http_server(("127.0.0.1", 0), EchoHandler, surface="volume")
+    assert not isinstance(srv, EventLoopHTTPServer)
+    srv.server_close()
+    monkeypatch.setenv("SEAWEEDFS_TPU_EVENTLOOP", "volume")
+    srv = make_http_server(("127.0.0.1", 0), EchoHandler, surface="volume")
+    assert isinstance(srv, EventLoopHTTPServer)
+    srv.server_close()
+    srv = make_http_server(("127.0.0.1", 0), EchoHandler, surface="filer")
+    assert not isinstance(srv, EventLoopHTTPServer)
+    srv.server_close()
+
+
+class _CountingSock:
+    """sendmsg-counting socket stand-in for the coalescing writer."""
+
+    def __init__(self):
+        self.calls = 0
+        self.data = b""
+
+    def sendmsg(self, parts):
+        self.calls += 1
+        blob = b"".join(bytes(p) for p in parts)
+        self.data += blob
+        return len(blob)
+
+
+def test_buffered_writer_single_syscall():
+    sock = _CountingSock()
+    w = _BufferedSocketWriter(sock)
+    w.write(b"HTTP/1.1 200 OK\r\n")
+    w.write(b"Content-Length: 5\r\n")
+    w.write(b"\r\n")
+    w.write(b"hello")
+    assert sock.calls == 0  # nothing hits the kernel before flush
+    w.flush()
+    assert sock.calls == 1  # header block + body in ONE sendmsg
+    assert sock.data == (
+        b"HTTP/1.1 200 OK\r\nContent-Length: 5\r\n\r\nhello")
+
+
+def test_buffered_writer_interim_response_flushes_now():
+    sock = _CountingSock()
+    w = _BufferedSocketWriter(sock)
+    w.write(b"HTTP/1.1 100 Continue\r\n\r\n")
+    # a 100-continue cannot sit in the buffer: the client is waiting
+    assert sock.calls == 1 and b"100 Continue" in sock.data
+
+
+def test_buffered_writer_partial_sends():
+    class Dribble(_CountingSock):
+        def sendmsg(self, parts):
+            self.calls += 1
+            blob = b"".join(bytes(p) for p in parts)
+            take = min(3, len(blob))
+            self.data += blob[:take]
+            return take
+
+    sock = Dribble()
+    w = _BufferedSocketWriter(sock)
+    w.write(b"abcdefghij")
+    w.flush()
+    assert sock.data == b"abcdefghij"
+
+
+def test_volume_server_runs_on_event_loop(monkeypatch):
+    """The default wiring: serve_http on the volume surface hands back
+    an EventLoopHTTPServer, and /status answers over it."""
+    monkeypatch.delenv("SEAWEEDFS_TPU_EVENTLOOP", raising=False)
+
+    class StatusHandler(EchoHandler):
+        def do_GET(self):
+            body = json.dumps({"ok": True}).encode()
+            self._reply(200, body)
+
+    srv = make_http_server(("127.0.0.1", 0), StatusHandler, surface="volume")
+    assert isinstance(srv, EventLoopHTTPServer)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        import urllib.request
+
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/status" % srv.server_address[1],
+                timeout=10) as r:
+            assert r.status == 200 and json.loads(r.read())["ok"] is True
+    finally:
+        srv.shutdown()
+        srv.server_close()

@@ -1,0 +1,400 @@
+"""Serving-plane units (ISSUE 18): group-commit fsync barrier semantics
+and the zero-copy sendfile GET path (needle extents + HTTP byte
+identity)."""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from helpers import make_volume
+from seaweedfs_tpu.ops import crc32c
+from seaweedfs_tpu.storage.disk_health import DiskFullError
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.vacuum import vacuum_volume
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.stats.metrics import (
+    FSYNC_BATCH_COMMITS,
+    FSYNC_BATCH_WRITES,
+    SENDFILE_BYTES,
+    SENDFILE_FALLBACK,
+)
+
+
+def _payload(i: int) -> bytes:
+    seedb = hashlib.sha256(b"sp-%d" % i).digest()
+    return (seedb * (1 + i % 30))[: 64 + (i * 97) % 900]
+
+
+# -- group commit ------------------------------------------------------------
+
+
+def test_batch_mode_concurrent_appends_durable(tmp_path, monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_DURABILITY", "batch")
+    v = Volume(str(tmp_path), "", 1)
+    assert v.durability == "batch" and v._group is not None
+    commits0 = FSYNC_BATCH_COMMITS.labels().value
+    writes0 = FSYNC_BATCH_WRITES.labels().value
+    n_writers, per = 8, 6
+    errs: list[Exception] = []
+
+    def writer(tid):
+        for k in range(per):
+            i = 1 + tid * per + k
+            try:
+                v.append_needle(Needle(cookie=9, id=i, data=_payload(i)))
+            except Exception as e:  # noqa: BLE001 — surfaced in the assert
+                errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs, errs
+    total = n_writers * per
+    for i in range(1, total + 1):
+        assert bytes(v.read_needle(i).data) == _payload(i)
+    commits = FSYNC_BATCH_COMMITS.labels().value - commits0
+    writes = FSYNC_BATCH_WRITES.labels().value - writes0
+    assert writes >= total          # every append rode a barrier
+    assert 1 <= commits <= writes   # ... and barriers batched (or not)
+    v.close()
+
+
+def test_batch_ack_only_after_fsync(tmp_path, monkeypatch):
+    """No needle-map publish (and so no ack) may precede the barrier's
+    fsync — the PR 14 contract with N writers sharing one fsync."""
+    monkeypatch.setenv("SEAWEEDFS_TPU_DURABILITY", "batch")
+    v = Volume(str(tmp_path), "", 1)
+    events: list[str] = []
+    real_sync = v._dat.sync
+    real_publish = v._publish_append
+
+    def spy_sync():
+        events.append("fsync")
+        real_sync()
+
+    def spy_publish(nid, offset, size):
+        events.append("publish")
+        real_publish(nid, offset, size)
+
+    monkeypatch.setattr(v._dat, "sync", spy_sync)
+    monkeypatch.setattr(v, "_publish_append", spy_publish)
+    for i in range(1, 6):
+        v.append_needle(Needle(cookie=1, id=i, data=_payload(i)))
+    assert "fsync" in events and "publish" in events
+    assert events.index("fsync") < events.index("publish")
+    # every publish is preceded by at least one fsync
+    fsyncs = 0
+    for ev in events:
+        if ev == "fsync":
+            fsyncs += 1
+        else:
+            assert fsyncs > 0
+    v.close()
+
+
+def test_batch_mode_deletes_ride_barrier(tmp_path, monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_DURABILITY", "batch")
+    v = Volume(str(tmp_path), "", 1)
+    v.append_needle(Needle(cookie=3, id=7, data=_payload(7)))
+    assert v.delete_needle(7) > 0
+    with pytest.raises(KeyError):
+        v.read_needle(7)
+    v.close()
+    # remount: the tombstone was fsync-durable before the delete acked
+    v2 = Volume(str(tmp_path), "", 1)
+    with pytest.raises(KeyError):
+        v2.read_needle(7)
+    v2.close()
+
+
+def test_batch_fsync_failure_rolls_back_whole_batch(tmp_path, monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_DURABILITY", "batch")
+    v = Volume(str(tmp_path), "", 1)
+    v.append_needle(Needle(cookie=2, id=1, data=_payload(1)))
+    dat_size = v._dat.file_size()
+    idx_size = os.path.getsize(v.file_name() + ".idx")
+
+    def broken_sync():
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(v._dat, "sync", broken_sync)
+    with pytest.raises(DiskFullError):
+        v.append_needle(Needle(cookie=2, id=2, data=_payload(2)))
+    # nothing published, bytes rolled back, volume flipped read-only-full
+    with pytest.raises(KeyError):
+        v.read_needle(2)
+    assert v._dat.file_size() == dat_size
+    assert os.path.getsize(v.file_name() + ".idx") == idx_size
+    assert v.read_only and v.read_only_reason == "full"
+    # the previously-acked needle is untouched
+    assert bytes(v.read_needle(1).data) == _payload(1)
+    # space recovers: volume taken writable again serves new appends
+    monkeypatch.undo()
+    monkeypatch.setenv("SEAWEEDFS_TPU_DURABILITY", "batch")
+    v.read_only = False
+    v.read_only_reason = ""
+    v.append_needle(Needle(cookie=2, id=3, data=_payload(3)))
+    assert bytes(v.read_needle(3).data) == _payload(3)
+    v.close()
+
+
+def test_sync_mode_fsyncs_every_append(tmp_path, monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_DURABILITY", "sync")
+    v = Volume(str(tmp_path), "", 1)
+    assert v.durability == "sync" and v._group is None
+    calls = [0]
+    real_sync = v._dat.sync
+
+    def spy():
+        calls[0] += 1
+        real_sync()
+
+    monkeypatch.setattr(v._dat, "sync", spy)
+    for i in range(1, 5):
+        v.append_needle(Needle(cookie=1, id=i, data=_payload(i)))
+    assert calls[0] == 4  # one fsync pair per mutation: the A/B baseline
+    v.close()
+
+
+def test_default_mode_unchanged(tmp_path, monkeypatch):
+    monkeypatch.delenv("SEAWEEDFS_TPU_DURABILITY", raising=False)
+    v = Volume(str(tmp_path), "", 1)
+    assert v.durability == "none" and v._group is None
+    v.append_needle(Needle(cookie=1, id=1, data=b"x"))
+    assert bytes(v.read_needle(1).data) == b"x"
+    v.close()
+
+
+# -- needle extents (zero-copy read descriptors) -----------------------------
+
+
+def test_needle_extent_byte_identity(tmp_path):
+    v = make_volume(str(tmp_path), n_needles=30, seed=11)
+    try:
+        for i in range(1, 31):
+            ref = v.read_needle(i)
+            ext = v.needle_extent(i)
+            assert ext is not None
+            with ext:
+                got = os.pread(ext.fd, ext.data_len, ext.data_offset)
+                assert got == bytes(ref.data), f"needle {i} bytes differ"
+                assert ext.data_len == len(bytes(ref.data))
+                n = ext.needle
+                # metadata parsed WITHOUT reading the payload matches the
+                # full parse: checksum (Etag), cookie, name, mime
+                assert n.checksum == ref.checksum
+                assert crc32c.checksum(got) == n.checksum
+                assert n.cookie == ref.cookie
+                assert bytes(n.name or b"") == bytes(ref.name or b"")
+                assert bytes(n.mime or b"") == bytes(ref.mime or b"")
+    finally:
+        v.close()
+
+
+def test_needle_extent_declines_and_misses(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    try:
+        with pytest.raises(KeyError):
+            v.needle_extent(99)
+        v.append_needle(Needle(cookie=1, id=1, data=b""))  # empty payload
+        assert v.needle_extent(1) is None  # nothing to sendfile
+        v.append_needle(Needle(cookie=1, id=2, data=b"live"))
+        v.delete_needle(2)
+        with pytest.raises(KeyError):
+            v.needle_extent(2)
+    finally:
+        v.close()
+
+
+def test_needle_extent_refuses_corrupt_payload(tmp_path):
+    """Zero-copy must not out-race the CRC check: a needle whose on-disk
+    payload rotted is DECLINED by the extent path (first serve verifies
+    the payload crc32c), so the GET falls back to the ordinary read path
+    and raises CorruptNeedleError into quarantine/rotation exactly as it
+    did before sendfile existed — never a 200 of rotten bytes."""
+    from seaweedfs_tpu.storage import types as t
+    from seaweedfs_tpu.storage.needle import CorruptNeedleError
+
+    v = make_volume(str(tmp_path), n_needles=6, seed=7)
+    try:
+        # a clean needle verifies once, then serves from the verified set
+        ext = v.needle_extent(2)
+        assert ext is not None
+        ext.close()
+        assert (2, v.needle_map.get(2).offset) in v._extent_verified
+        ext = v.needle_extent(2)
+        assert ext is not None
+        ext.close()
+
+        # flip one payload byte of needle 3 on disk
+        nv = v.needle_map.get(3)
+        data_off = nv.offset + t.NEEDLE_HEADER_SIZE + 4
+        path = v.file_name() + ".dat"
+        with open(path, "r+b") as f:
+            f.seek(data_off + 1)
+            b = f.read(1)
+            f.seek(data_off + 1)
+            f.write(bytes([b[0] ^ 0xFF]))
+        assert v.needle_extent(3) is None  # declined, not served
+        with pytest.raises(CorruptNeedleError):
+            v.read_needle(3)
+        # the healthy neighbours keep serving extents
+        ext = v.needle_extent(4)
+        assert ext is not None
+        ext.close()
+    finally:
+        v.close()
+
+
+def test_needle_extent_survives_vacuum_handle_swap(tmp_path):
+    """The dup'd fd pins the OLD .dat's open file description: a vacuum
+    committed mid-send cannot close it or recycle the fd number, and the
+    old append-only bytes stay readable to the end of the stream."""
+    v = make_volume(str(tmp_path), n_needles=10, seed=5)
+    try:
+        ref = bytes(v.read_needle(4).data)
+        ext = v.needle_extent(4)
+        assert ext is not None
+        v.delete_needle(9)  # give the vacuum something to drop
+        vacuum_volume(v)
+        got = os.pread(ext.fd, ext.data_len, ext.data_offset)
+        assert got == ref
+        ext.close()
+        # the vacuumed volume still serves (fresh handle, fresh extents)
+        assert bytes(v.read_needle(4).data) == ref
+        ext2 = v.needle_extent(4)
+        assert ext2 is not None
+        with ext2:
+            assert os.pread(
+                ext2.fd, ext2.data_len, ext2.data_offset) == ref
+    finally:
+        v.close()
+
+
+# -- HTTP sendfile path ------------------------------------------------------
+
+
+def _free_port() -> int:
+    from helpers import free_port
+
+    return free_port()
+
+
+def _http(method, url, data=None, headers=None):
+    req = urllib.request.Request(
+        url, data=data, method=method, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+@pytest.fixture(scope="module")
+def mini_cluster(tmp_path_factory):
+    from seaweedfs_tpu.master.server import MasterServer
+    from seaweedfs_tpu.volume.server import VolumeServer
+
+    master = MasterServer(ip="127.0.0.1", port=_free_port(),
+                          volume_size_limit_mb=64)
+    master.start()
+    vs = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("vol"))],
+        master_addresses=[f"127.0.0.1:{master.grpc_port}"],
+        ip="127.0.0.1", port=_free_port(), pulse_seconds=0.5)
+    vs.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.1)
+    assert master.topo.nodes, "volume server did not register"
+    yield master, vs
+    vs.stop()
+    master.stop()
+
+
+def _assign(master) -> dict:
+    code, body, _ = _http(
+        "GET", f"http://127.0.0.1:{master.port}/dir/assign")
+    assert code == 200, body
+    return json.loads(body)
+
+
+def _await(cond, timeout: float = 5.0) -> bool:
+    """Counters tick on the server thread AFTER the last payload byte is
+    on the wire — the client can observe the response first."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+def test_http_get_is_sendfile_and_byte_identical(mini_cluster, monkeypatch):
+    master, _vs = mini_cluster
+    a = _assign(master)
+    payload = hashlib.sha256(b"sendfile").digest() * 3000  # ~96KB, not image
+    code, _, _ = _http("POST", f"http://{a['url']}/{a['fid']}", payload)
+    assert code == 201
+    sf0 = SENDFILE_BYTES.labels().value
+    code, got, hdrs = _http("GET", f"http://{a['url']}/{a['fid']}")
+    assert code == 200 and got == payload
+    assert _await(
+        lambda: SENDFILE_BYTES.labels().value - sf0 >= len(payload))
+    etag_sendfile = hdrs.get("Etag")
+    assert etag_sendfile
+    # A/B: the userspace path serves the same bytes and the same Etag
+    monkeypatch.setenv("SEAWEEDFS_TPU_SENDFILE", "0")
+    code, got2, hdrs2 = _http("GET", f"http://{a['url']}/{a['fid']}")
+    assert code == 200 and got2 == payload
+    assert hdrs2.get("Etag") == etag_sendfile
+
+
+def test_http_range_falls_back_from_sendfile(mini_cluster, monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_SENDFILE", "1")
+    master, _vs = mini_cluster
+    a = _assign(master)
+    payload = bytes(range(256)) * 64
+    code, _, _ = _http("POST", f"http://{a['url']}/{a['fid']}", payload)
+    assert code == 201
+    fb0 = SENDFILE_FALLBACK.labels("range").value
+    code, got, hdrs = _http(
+        "GET", f"http://{a['url']}/{a['fid']}",
+        headers={"Range": "bytes=100-299"})
+    assert code == 206 and got == payload[100:300]
+    assert SENDFILE_FALLBACK.labels("range").value == fb0 + 1
+    # the fallback read cached the needle, so the next whole-object GET
+    # declines the extent by DESIGN: bytes already in RAM beat sendfile
+    cache0 = SENDFILE_FALLBACK.labels("cache").value
+    code, got, _ = _http("GET", f"http://{a['url']}/{a['fid']}")
+    assert code == 200 and got == payload
+    assert _await(
+        lambda: SENDFILE_FALLBACK.labels("cache").value == cache0 + 1)
+
+
+def test_http_sendfile_cookie_and_404_paths(mini_cluster):
+    master, _vs = mini_cluster
+    a = _assign(master)
+    payload = b"guarded" * 100
+    code, _, _ = _http("POST", f"http://{a['url']}/{a['fid']}", payload)
+    assert code == 201
+    vid, rest = a["fid"].split(",", 1)
+    # wrong cookie: same volume/needle, mangled cookie digits
+    bad = rest[:-4] + ("0000" if rest[-4:] != "0000" else "1111")
+    code, _, _ = _http("GET", f"http://{a['url']}/{vid},{bad}")
+    assert code == 404
+    code, _, _ = _http("DELETE", f"http://{a['url']}/{a['fid']}")
+    assert code == 202
+    code, _, _ = _http("GET", f"http://{a['url']}/{a['fid']}")
+    assert code == 404
