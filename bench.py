@@ -1184,6 +1184,73 @@ def _slo_verdict(master) -> dict:
     return out
 
 
+def _flight_overhead(n: int = 8000, concurrency: int = 16) -> dict:
+    """ISSUE 20 acceptance gate: the always-on flight-recorder planes
+    (continuous profiler + hot-key sketch) must cost under
+    SEAWEEDFS_TPU_BENCH_FLIGHT_MAX_PCT (default 3%) of smallfile req/s.
+
+    Same-host A/B: one smallfile leg with both planes disabled, one
+    with production defaults.  A throwaway warmup leg runs first so the
+    OFF leg does not pocket the process's import/allocator warmup and
+    overstate the ON leg's cost."""
+    import os
+
+    from seaweedfs_tpu.telemetry import hotkeys
+    from seaweedfs_tpu.util import profiler
+
+    def leg(on: bool, leg_n: int) -> dict:
+        override = ({} if on else
+                    {profiler.DISABLE_VAR: "1", hotkeys.DISABLE_VAR: "0"})
+        saved = {k: os.environ.get(k)
+                 for k in (profiler.DISABLE_VAR, hotkeys.DISABLE_VAR)}
+        for k in saved:
+            os.environ.pop(k, None)
+        os.environ.update(override)
+        profiler.stop_continuous()
+        hotkeys.reset()
+        try:
+            return _smallfile_rates(n=leg_n, concurrency=concurrency)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            profiler.stop_continuous()
+            hotkeys.reset()
+
+    leg(True, max(n // 8, 500))  # warmup, discarded
+    # interleaved off/on pairs, judged by the MEDIAN per-pair ratio:
+    # adjacent legs share the host's load drift, so their ratio cancels
+    # it — a global off-vs-on comparison on a shared box confuses
+    # minutes-scale drift (observed at 20%+) with the planes' real cost
+    offs, ons = [], []
+    for _ in range(3):
+        offs.append(leg(False, n))
+        ons.append(leg(True, n))
+
+    def med(vals: list[float]) -> float:
+        vals = sorted(vals)
+        return vals[len(vals) // 2]
+
+    out: dict = {"flight_overhead_n": n}
+    worst = 0.0
+    for op in ("write", "read"):
+        key = f"smallfile_{op}_reqs_per_s"
+        out[f"flight_off_{op}_reqs_per_s"] = med([r[key] for r in offs])
+        out[f"flight_on_{op}_reqs_per_s"] = med([r[key] for r in ons])
+        ratios = [on[key] / off[key]
+                  for off, on in zip(offs, ons) if off[key]]
+        if ratios:
+            worst = max(worst, (1.0 - med(ratios)) * 100.0)
+    out["flight_overhead_pct"] = round(worst, 2)
+    max_pct = float(os.environ.get(
+        "SEAWEEDFS_TPU_BENCH_FLIGHT_MAX_PCT", "3.0"))
+    out["flight_overhead_max_pct"] = max_pct
+    out["flight_overhead_ok"] = worst <= max_pct
+    return out
+
+
 def _hist_child_snapshot(hist, *labels):
     """(counts[], count, total) for one histogram child — bench-side
     delta arithmetic over the in-process registry."""
@@ -2453,6 +2520,14 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001
             print(json.dumps({"error": f"{type(exc).__name__}: {exc}"[:500]}))
         return
+    if "--flight-overhead-only" in sys.argv:
+        try:
+            print(json.dumps(_flight_overhead()))
+        except Exception as exc:  # noqa: BLE001
+            print(json.dumps(
+                {"flight_overhead_ok": False,
+                 "error": f"{type(exc).__name__}: {exc}"[:500]}))
+        return
     if "--kernel-only" in sys.argv:
         try:
             print(json.dumps(_tpu_pallas_rate()))
@@ -2587,6 +2662,15 @@ def main() -> None:
             metrics_snapshot="--metrics-snapshot" in _sys.argv))
     except Exception as exc:  # noqa: BLE001
         out["smallfile_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    # ISSUE 20: flight-recorder overhead A/B (continuous profiler +
+    # hot-key sketch on vs off) — subprocess-guarded because the legs
+    # flip process-global kill switches
+    if "--metrics-snapshot" in _sys.argv:
+        fo_res = _stage_in_subprocess("--flight-overhead-only",
+                                      timeout_s=stage_timeout, attempts=1)
+        if "error" in fo_res:
+            out["flight_overhead_error"] = fo_res.pop("error")[:300]
+        out.update(fo_res)
     # ISSUE 18: serving-plane legs (fsync batching A/B, sendfile A/B,
     # thousands-of-sockets keep-alive) — subprocess-guarded: the
     # keep-alive leg lifts RLIMIT_NOFILE and parks ~2000 sockets

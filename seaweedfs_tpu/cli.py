@@ -67,6 +67,7 @@ def cmd_master(args) -> None:
         canary_interval=args.canaryInterval,
         canary_s3=args.canaryS3,
         alert_webhook=args.alertWebhook,
+        debug_dir=args.debugDir,
     )
     m.start()
     print(f"master listening http={args.port} grpc={m.grpc_port}")
@@ -746,6 +747,11 @@ def main(argv=None) -> None:
     m.add_argument("-alertWebhook", default="",
                    help="POST every alert state transition to this URL "
                         "as JSON (the log sink always runs)")
+    m.add_argument("-debugDir", default="",
+                   help="flight-recorder bundle directory: alerts "
+                        "transitioning to firing (and cluster.debug "
+                        "-capture) snapshot cluster debug bundles here "
+                        "with bounded retention (empty = in-memory ring)")
     m.set_defaults(fn=cmd_master)
 
     v = sub.add_parser("volume")

@@ -21,7 +21,7 @@ from ..util.httpd import (
 )
 
 from ..pb import filer_pb2
-from ..telemetry import http_request, serve_debug_http, trace
+from ..telemetry import hotkeys, http_request, serve_debug_http, trace
 from . import filechunks
 from .filer import join_path, split_path
 from .fleet.tenant import (
@@ -70,6 +70,7 @@ class FilerHttpHandler(BufferedResponseMixin, BaseHTTPRequestHandler):
         XML; untenanted paths (config, /debug) pass uncounted."""
         tenant = tenant_for_path(
             urllib.parse.unquote(urllib.parse.urlparse(self.path).path))
+        hotkeys.record("tenant", tenant)
         try:
             with self.filer_server.admission.admit(tenant):
                 fn()

@@ -232,6 +232,11 @@ class FilerServer:
         from ..util import glog
 
         self.master_client.start()
+        # flight-recorder plane: always-on low-hz stack sampler feeding
+        # /debug/profile/history (kill-switch + hz env knobs respected)
+        from ..util import profiler as _profiler
+
+        _profiler.ensure_continuous()
         self._grpc_server = rpclib.serve(
             [(rpclib.FILER, FilerGrpcService(self))], self.grpc_port
         )

@@ -183,6 +183,66 @@ def cluster_traces(master, trace_id: str, limit: int) -> dict:
     return stitch_trace(trace_id, results)
 
 
+def cluster_hot(master, n: int = 32) -> dict:
+    """Fan /debug/hot out to every node and merge the per-dimension
+    sketch tables into cluster-wide ones.
+
+    Space-saving sketches merge by summing per-key counts (and error
+    bounds), so the cluster table keeps the sketch's guarantee: a key
+    hot anywhere is present, with its worst-case overestimate stated."""
+    from ..telemetry import hotkeys as _hotkeys
+
+    per_node: dict[str, dict] = {
+        f"{master.ip}:{master.port}": _hotkeys.snapshot(n)}
+
+    def fetch_one(t: dict):
+        try:
+            return t["instance"], json.loads(_scrape(
+                f"http://{t['http_address']}/debug/hot?n={n}",
+                FEDERATION_TIMEOUT_S))
+        except Exception as e:  # noqa: BLE001 — a dead node still lists
+            return t["instance"], {"error": str(e)}
+
+    targets = federation_targets(master)
+    futures = [master.federation_pool.submit(fetch_one, t) for t in targets]
+    for fut in futures:
+        instance, doc = fut.result()
+        per_node.setdefault(instance, doc)
+
+    def merge(which: str) -> dict:
+        tables: dict[str, dict[str, dict]] = {}
+        for instance, doc in per_node.items():
+            for dim, windows in (doc.get("dims") or {}).items():
+                table = tables.setdefault(dim, {})
+                for e in windows.get(which) or ():
+                    slot = table.setdefault(e["key"], {
+                        "key": e["key"], "count": 0, "error": 0,
+                        "nodes": []})
+                    slot["count"] += e.get("count", 0)
+                    slot["error"] += e.get("error", 0)
+                    slot["nodes"].append(instance)
+        return {
+            dim: sorted(t.values(),
+                        key=lambda s: (-s["count"], s["key"]))[:n]
+            for dim, t in tables.items()
+        }
+
+    current, previous = merge("current"), merge("previous")
+    return {
+        "nodes": {
+            instance: ({"error": doc["error"]} if "error" in doc
+                       else {"windowAgeS": doc.get("windowAgeS"),
+                             "enabled": doc.get("enabled", True)})
+            for instance, doc in sorted(per_node.items())
+        },
+        "dims": {
+            dim: {"current": current.get(dim, []),
+                  "previous": previous.get(dim, [])}
+            for dim in sorted(set(current) | set(previous))
+        },
+    }
+
+
 def _own_spans(trace_id: str, limit: int) -> list[dict]:
     for tr in trace.TRACER.recent_traces(limit, trace_id=trace_id):
         if tr["traceId"] == trace_id:

@@ -71,6 +71,7 @@ class MasterServer:
         canary_interval: float = 0.0,  # black-box probe tick; 0 disables
         canary_s3: str = "",           # S3 gateway addr for metadata probes
         alert_webhook: str = "",       # POST alert transitions here
+        debug_dir: str = "",           # flight-recorder bundle directory
     ):
         self.ip = ip
         self.port = port
@@ -177,7 +178,14 @@ class MasterServer:
 
         from . import observability as _obs
 
-        sinks = [log_sink]
+        # flight recorder (ISSUE 20): alert-triggered cluster debug
+        # bundles.  Constructed before the SLO engine so a transition to
+        # firing captures a bundle through its sink; manual captures run
+        # via /cluster/debug/capture and the cluster.debug shell command
+        from .flight import FlightRecorder
+
+        self.flight = FlightRecorder(self, debug_dir=debug_dir)
+        sinks = [log_sink, self.flight.sink]
         if alert_webhook:
             sinks.append(WebhookSink(alert_webhook))
         self.slo = SloEngine(
@@ -237,6 +245,11 @@ class MasterServer:
         self._httpd = _serve_http(self, "0.0.0.0", self.port)
         if self.metrics_port:
             self._metricsd = serve_metrics(self.metrics_port)
+        # flight-recorder plane: always-on low-hz stack sampler feeding
+        # /debug/profile/history (kill-switch + hz env knobs respected)
+        from ..util import profiler as _profiler
+
+        _profiler.ensure_continuous()
         threading.Thread(target=self._liveness_loop, daemon=True).start()
         if self.maintenance_interval > 0:
             threading.Thread(target=self._maintenance_loop, daemon=True).start()
@@ -1154,6 +1167,11 @@ _MASTER_OPS = {
     "/cluster/alerts": "cluster.alerts",
     "/cluster/lifecycle": "cluster.lifecycle",
     "/cluster/geo": "cluster.geo",
+    "/cluster/hot": "cluster.hot",
+    "/cluster/debug": "cluster.debug",
+    "/cluster/debug/capture": "cluster.debug",
+    "/debug/hot": "debug.hot",
+    "/debug/profile/history": "debug.profile",
     "/vol/vacuum": "vol.vacuum", "/vol/grow": "vol.grow",
     "/vol/repair": "vol.repair",
     "/vol/status": "vol.status", "/col/delete": "col.delete",
@@ -1340,10 +1358,49 @@ class _MasterHttpHandler(BaseHTTPRequestHandler):
         if u.path == "/cluster/alerts":
             # the judgment plane's operator surface: SLO states, active
             # alerts (exemplar trace ids included), bounded transition
-            # history, and the canary's last probe round
+            # history, the canary's last probe round, and the flight
+            # recorder's captured bundles (the page's evidence locker)
             doc = self.master.slo.status()
             doc["canary"] = self.master.canary.status()
+            doc["debugBundles"] = self.master.flight.list_bundles()
             return self._json(200, doc)
+        if u.path == "/cluster/hot":
+            # federated heavy-hitter tables: which needle/bucket/tenant/
+            # peer is hot right now, cluster-wide, in one request
+            from . import observability
+
+            try:
+                n = int(qget("n", "32") or 32)
+                if not 1 <= n <= 1024:
+                    raise ValueError
+            except ValueError:
+                return self._json(400, {"error": "n must be in [1, 1024]"})
+            return self._json(200, observability.cluster_hot(
+                self.master, n))
+        if u.path == "/cluster/debug":
+            name = qget("bundle")
+            if name:
+                doc = self.master.flight.bundle(name)
+                if doc is None:
+                    return self._json(404, {
+                        "error": f"no bundle named {name!r}"})
+                return self._json(200, doc)
+            return self._json(200, {
+                "debugDir": self.master.flight.debug_dir,
+                "retain": self.master.flight.retain,
+                "bundles": self.master.flight.list_bundles(),
+            })
+        if u.path == "/cluster/debug/capture":
+            # on-demand flight-recorder capture (the shell's
+            # cluster.debug -capture); alert-triggered captures run
+            # through the SLO sink without this endpoint
+            try:
+                return self._json(200, self.master.flight.capture(
+                    trigger="manual"))
+            except RuntimeError as e:  # capture already in flight
+                return self._json(409, {"error": str(e)})
+            except Exception as e:
+                return self._json(500, {"error": str(e)})
         if u.path == "/cluster/lifecycle":
             # lifecycle controller status: policies, journal, job states
             return self._json(200, self.master.lifecycle.status())

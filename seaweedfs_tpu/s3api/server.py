@@ -118,7 +118,11 @@ class S3ApiServer:
 
     def start(self) -> None:
         from ..util import glog
+        from ..util import profiler as _profiler
 
+        # flight-recorder plane: always-on low-hz stack sampler feeding
+        # /debug/profile/history (kill-switch + hz env knobs respected)
+        _profiler.ensure_continuous()
         handler = type("BoundS3Handler", (S3Handler,), {"s3": self})
         self._httpd = FrameworkHTTPServer(("0.0.0.0", self.port), handler)
         threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
@@ -398,6 +402,12 @@ class S3Handler(BaseHTTPRequestHandler):
         self.s3.iam.authorize(self.identity, action, bucket)
 
     def _dispatch(self, bucket: str, key: str) -> None:
+        # heavy-hitter attribution: runs after the debug-surface check,
+        # so "/metrics" etc. never pollute the bucket sketch
+        if bucket:
+            from ..telemetry import hotkeys
+
+            hotkeys.record("bucket", bucket)
         m, q = self.command, self.query
         if not bucket:
             if m in ("GET", "HEAD"):

@@ -246,6 +246,90 @@ def cluster_alerts(env: CommandEnv, args: list[str]) -> str:
     return "\n".join(lines)
 
 
+@register("cluster.hot")
+def cluster_hot(env: CommandEnv, args: list[str]) -> str:
+    """cluster.hot [-json] [-n N]  — federated heavy-hitter tables:
+    the hottest needles, buckets, tenants and peer IPs cluster-wide,
+    from the master's /cluster/hot."""
+    addr = _master_http(env)
+    n = 32
+    if "-n" in args:
+        try:
+            n = int(args[args.index("-n") + 1])
+        except (IndexError, ValueError):
+            return "usage: cluster.hot [-json] [-n N]"
+    with connpool.request(
+            "GET", f"http://{addr}/cluster/hot?n={n}", timeout=10) as r:
+        doc = json.loads(r.read())
+    if "-json" in args:
+        return json.dumps(doc, indent=2, sort_keys=True)
+    lines = []
+    nodes = doc.get("nodes", {})
+    down = sorted(i for i, s in nodes.items() if "error" in s)
+    lines.append(f"hot keys across {len(nodes)} node(s)"
+                 + (f" ({len(down)} unreachable)" if down else ""))
+    for dim, windows in sorted(doc.get("dims", {}).items()):
+        rows = windows.get("current") or windows.get("previous") or []
+        which = "current" if windows.get("current") else "previous"
+        if not rows:
+            lines.append(f"  {dim}: (no traffic this window)")
+            continue
+        lines.append(f"  {dim} ({which} window):")
+        for e in rows[:10]:
+            lines.append(
+                f"    {e['key']}  ~{e['count']} hits"
+                + (f" (+/-{e['error']})" if e.get("error") else "")
+                + f" on {len(set(e.get('nodes', ())))} node(s)")
+    for inst in down:
+        lines.append(f"  {inst} UNREACHABLE ({nodes[inst]['error']})")
+    return "\n".join(lines)
+
+
+@register("cluster.debug")
+def cluster_debug(env: CommandEnv, args: list[str]) -> str:
+    """cluster.debug [-json] [-capture] [-bundle NAME]  — list flight-
+    recorder debug bundles; -capture snapshots a new one across every
+    live node; -bundle prints one bundle's JSON."""
+    addr = _master_http(env)
+    if "-bundle" in args:
+        try:
+            name = args[args.index("-bundle") + 1]
+        except IndexError:
+            return "usage: cluster.debug -bundle NAME"
+        with connpool.request(
+                "GET", f"http://{addr}/cluster/debug?bundle="
+                f"{name}", timeout=30) as r:
+            return json.dumps(json.loads(r.read()), indent=2,
+                              sort_keys=True)
+    if "-capture" in args:
+        with connpool.request(
+                "GET", f"http://{addr}/cluster/debug/capture",
+                timeout=60) as r:
+            meta = json.loads(r.read())
+        if "-json" in args:
+            return json.dumps(meta, indent=2, sort_keys=True)
+        if "error" in meta:
+            return f"capture failed: {meta['error']}"
+        return (f"captured {meta['name']}: {len(meta.get('nodes', ()))} "
+                f"node(s), {meta.get('sizeBytes', 0)} bytes")
+    with connpool.request(
+            "GET", f"http://{addr}/cluster/debug", timeout=10) as r:
+        doc = json.loads(r.read())
+    if "-json" in args:
+        return json.dumps(doc, indent=2, sort_keys=True)
+    bundles = doc.get("bundles", [])
+    lines = [f"debug bundles ({len(bundles)}), "
+             f"dir={doc.get('debugDir') or '(in-memory)'} "
+             f"retain={doc.get('retain')}"]
+    for b in bundles:
+        lines.append(f"  {b['name']}  {b['sizeBytes']}B  "
+                     f"{b['ageS']:.0f}s ago")
+    if not bundles:
+        lines.append("  (none captured yet; cluster.debug -capture, or "
+                     "wait for an alert to fire)")
+    return "\n".join(lines)
+
+
 @register("cluster.geo")
 def cluster_geo(env: CommandEnv, args: list[str]) -> str:
     """cluster.geo [-json]  — peer-cluster reachability + per-link

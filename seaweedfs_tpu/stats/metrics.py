@@ -66,6 +66,20 @@ def format_le(bound: float) -> str:
     return repr(float(bound))
 
 
+# families whose label cardinality scales with the environment (one child
+# per peer / data dir / hot key) — emitted LAST from snapshot_samples so
+# they can never crowd the fixed-cardinality families SLO rules read out
+# of the 512-sample heartbeat snapshot fallback
+SNAPSHOT_DENY_PREFIXES = (
+    "seaweedfs_connpool_in_use",
+    "seaweedfs_connpool_idle",
+    "seaweedfs_disk_free_bytes",
+    "seaweedfs_disk_total_bytes",
+    "seaweedfs_disk_state",
+    "seaweedfs_hotkey_",
+)
+
+
 class Metric:
     def __init__(self, name: str, help_: str, label_names: tuple[str, ...]):
         self.name = name
@@ -367,13 +381,22 @@ class Registry:
         live scrape serves better."""
         with self._lock:
             metrics = list(self._metrics.values())
-        # geo-link + listener health ride ONLY this snapshot to
-        # /cluster/geo (a dead cluster cannot be scraped live); the
-        # families are tiny (per-link) but registered late, so on a
-        # high-cardinality node they would be the first past the cap —
-        # emit them first
-        metrics.sort(key=lambda m: not m.name.startswith(
-            ("seaweedfs_geo_", "seaweedfs_meta_listener_")))
+        # three emission tiers under the cap:
+        #   0: geo-link + listener health — they ride ONLY this snapshot
+        #      to /cluster/geo (a dead cluster cannot be scraped live);
+        #      tiny families, but registered late, so without the boost a
+        #      high-cardinality node would push them past the cap
+        #   1: everything else, including the families SLO rules read
+        #      from the snapshot fallback
+        #   2: deny-listed high-cardinality families (per-peer connpool,
+        #      per-dir disk, per-key hot-key tables) — one busy node can
+        #      mint hundreds of children here, and before the deny-list
+        #      they could evict the tier-1 families alerts depend on
+        metrics.sort(key=lambda m: (
+            0 if m.name.startswith(("seaweedfs_geo_",
+                                    "seaweedfs_meta_listener_"))
+            else 2 if m.name.startswith(SNAPSHOT_DENY_PREFIXES)
+            else 1))
         out = []
         for m in metrics:
             if m.kind not in ("counter", "gauge"):
@@ -1003,6 +1026,37 @@ HTTPD_INFLIGHT = REGISTRY.gauge(
 EC_PREADV_BATCHES = REGISTRY.counter(
     "seaweedfs_ec_preadv_batches_total",
     "contiguous EC shard interval runs gathered with one preadv",
+)
+
+# flight-recorder plane (ISSUE 20): heavy-hitter attribution sketches
+# (telemetry/hotkeys.py) + alert-triggered debug-bundle capture
+# (master/flight.py).  hotkey_top_count is deliberately per-key and
+# therefore deny-listed from the heartbeat snapshot (see
+# SNAPSHOT_DENY_PREFIXES); its cardinality is bounded by the recorder,
+# which replaces the child set wholesale on every window rotation.
+HOTKEY_EVENTS = REGISTRY.counter(
+    "seaweedfs_hotkey_events_total",
+    "keys fed to the heavy-hitter sketches, by dimension",
+    labels=("dim",),  # needle | bucket | tenant | peer
+)
+HOTKEY_TRACKED = REGISTRY.gauge(
+    "seaweedfs_hotkey_tracked_keys",
+    "keys currently tracked by a dimension's space-saving sketch",
+    labels=("dim",),
+)
+HOTKEY_TOP = REGISTRY.gauge(
+    "seaweedfs_hotkey_top_count",
+    "estimated hits of the hottest keys in the last closed window",
+    labels=("dim", "key"),
+)
+DEBUG_BUNDLES = REGISTRY.counter(
+    "seaweedfs_debug_bundles_total",
+    "cluster debug bundles captured, by trigger and outcome",
+    labels=("trigger", "result"),  # alert|manual ; ok|error
+)
+DEBUG_BUNDLE_SECONDS = REGISTRY.histogram(
+    "seaweedfs_debug_bundle_capture_seconds",
+    "wall time to fan out and persist one cluster debug bundle",
 )
 
 

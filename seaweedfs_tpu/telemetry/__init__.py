@@ -7,6 +7,8 @@ HTTP request instrumentation used by master/volume/filer/S3).
 from . import trace  # noqa: F401
 from .middleware import (  # noqa: F401
     DEBUG_FAULTS_PATH,
+    DEBUG_HOT_PATH,
+    DEBUG_PROFILE_HISTORY_PATH,
     DEBUG_PROFILE_PATH,
     DEBUG_TRACES_PATH,
     METRICS_PATH,
@@ -35,6 +37,7 @@ __all__ = [
     "parse_traceparent", "remote_context", "start_span",
     "traceparent_header", "wrap_context", "http_request", "record_op",
     "debug_traces_body", "serve_debug_http", "parse_trace_query",
-    "DEBUG_FAULTS_PATH", "DEBUG_PROFILE_PATH", "DEBUG_TRACES_PATH",
+    "DEBUG_FAULTS_PATH", "DEBUG_HOT_PATH", "DEBUG_PROFILE_HISTORY_PATH",
+    "DEBUG_PROFILE_PATH", "DEBUG_TRACES_PATH",
     "METRICS_PATH", "SLOW_REQUEST_SECONDS",
 ]

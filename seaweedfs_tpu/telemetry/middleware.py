@@ -31,6 +31,8 @@ SLOW_REQUEST_SECONDS = trace.SLOW_SPAN_SECONDS
 DEBUG_TRACES_PATH = "/debug/traces"
 DEBUG_FAULTS_PATH = "/debug/faults"
 DEBUG_PROFILE_PATH = "/debug/profile"
+DEBUG_PROFILE_HISTORY_PATH = "/debug/profile/history"
+DEBUG_HOT_PATH = "/debug/hot"
 METRICS_PATH = "/metrics"
 
 TRACE_LIMIT_MAX = 1000
@@ -63,6 +65,14 @@ def http_request(handler, server_type: str, op: str):
     """`record_op` for a BaseHTTPRequestHandler request: adopts the
     caller's `traceparent` (if any) so the span joins their trace."""
     incoming = handler.headers.get(trace.TRACEPARENT)
+    # heavy-hitter attribution: every HTTP request feeds the peer-IP
+    # sketch, so "which client is hammering us" is answerable on any
+    # server type without per-handler wiring
+    addr = getattr(handler, "client_address", None)
+    if addr:
+        from . import hotkeys
+
+        hotkeys.record("peer", addr[0])
     with trace.remote_context(incoming):
         with record_op(
             server_type, op,
@@ -147,6 +157,29 @@ def serve_debug_http(handler, path: str) -> bool:
             return True
         body, ctype = (REGISTRY.render(prefixes).encode(),
                        "text/plain; version=0.0.4")
+    elif path == DEBUG_PROFILE_HISTORY_PATH:
+        from ..util import profiler
+
+        if not profiler.enabled():
+            _send_error(handler, 403,
+                        f"profiler disabled ({profiler.DISABLE_VAR}=1)")
+            return True
+        body, ctype = (json.dumps(profiler.continuous_history()).encode(),
+                       "application/json")
+    elif path == DEBUG_HOT_PATH:
+        from . import hotkeys
+
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlparse(handler.path).query)
+        try:
+            n = int(query.get("n", [""])[0] or 32)
+            if not 1 <= n <= 1024:
+                raise ValueError("n must be in [1, 1024]")
+        except ValueError as e:
+            _send_error(handler, 400, str(e))
+            return True
+        body, ctype = (json.dumps(hotkeys.snapshot(n)).encode(),
+                       "application/json")
     elif path == DEBUG_PROFILE_PATH:
         from ..util import profiler
         from ..util.grace import profile_status

@@ -24,7 +24,7 @@ from ..util.httpd import (
 
 from .. import images
 from ..security.jwt import token_from_header, verify_write_jwt
-from ..telemetry import http_request, serve_debug_http
+from ..telemetry import hotkeys, http_request, serve_debug_http
 from ..storage.file_id import FileId
 from ..storage.disk_health import DiskFailingError, DiskFullError
 from ..storage.needle import (
@@ -165,6 +165,7 @@ class VolumeHttpHandler(BufferedResponseMixin, BaseHTTPRequestHandler):
             fid = FileId.parse(path.path.lstrip("/"))
         except ValueError:
             return self._send_json(404, {"error": "invalid file id"})
+        hotkeys.record("needle", str(fid))
         if (
             self.store.find_volume(fid.volume_id) is None
             and self.store.find_ec_volume(fid.volume_id) is None
@@ -412,6 +413,7 @@ class VolumeHttpHandler(BufferedResponseMixin, BaseHTTPRequestHandler):
             fid = FileId.parse(path.path.lstrip("/"))
         except ValueError:
             return self._send_json(400, {"error": "invalid file id"})
+        hotkeys.record("needle", str(fid))
         if not self._check_write_jwt(path.path.lstrip("/")):
             return self._send_json(401, {"error": "missing or invalid jwt"})
         length = int(self.headers.get("Content-Length", 0))
@@ -484,6 +486,7 @@ class VolumeHttpHandler(BufferedResponseMixin, BaseHTTPRequestHandler):
             fid = FileId.parse(path.path.lstrip("/"))
         except ValueError:
             return self._send_json(400, {"error": "invalid file id"})
+        hotkeys.record("needle", str(fid))
         if not self._check_write_jwt(path.path.lstrip("/")):
             return self._send_json(401, {"error": "missing or invalid jwt"})
         # EC volumes: tombstone + distributed fan-out to all shard holders

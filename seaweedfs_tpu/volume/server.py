@@ -156,6 +156,11 @@ class VolumeServer:
                 ev.partial_client = self._make_partial_client(vid)
                 ev.corruption_hook = self.scrubber.suspect_shard
         self.scrubber.start()
+        # flight-recorder plane: always-on low-hz stack sampler feeding
+        # /debug/profile/history (kill-switch + hz env knobs respected)
+        from ..util import profiler as _profiler
+
+        _profiler.ensure_continuous()
         self._httpd = serve_http(self, "0.0.0.0", self.port)
         self._grpc_server = rpclib.serve(
             [(rpclib.VOLUME_SERVER, VolumeGrpcService(self))], self.grpc_port

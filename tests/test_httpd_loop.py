@@ -8,6 +8,7 @@ import json
 import socket
 import threading
 import time
+import weakref
 from http.server import BaseHTTPRequestHandler
 
 import pytest
@@ -72,9 +73,20 @@ def _connect(srv) -> socket.socket:
     return s
 
 
+# bytes received past a response body (the start of the next pipelined
+# response) stashed between _read_response calls, keyed per socket
+_RESP_LEFTOVER: "weakref.WeakKeyDictionary[socket.socket, bytes]" = (
+    weakref.WeakKeyDictionary())
+
+
 def _read_response(sock) -> tuple[int, bytes]:
-    """One HTTP/1.1 response off the socket (Content-Length framing)."""
-    buf = b""
+    """One HTTP/1.1 response off the socket (Content-Length framing).
+
+    Pipelined responses can arrive coalesced in a single recv; bytes
+    past this response's body belong to the NEXT one, so they are
+    stashed per-socket and consumed by the next call instead of being
+    dropped."""
+    buf = _RESP_LEFTOVER.pop(sock, b"")
     while b"\r\n\r\n" not in buf:
         chunk = sock.recv(65536)
         assert chunk, f"connection closed mid-headers: {buf!r}"
@@ -90,6 +102,8 @@ def _read_response(sock) -> tuple[int, bytes]:
         chunk = sock.recv(65536)
         assert chunk, "connection closed mid-body"
         rest += chunk
+    if len(rest) > length:
+        _RESP_LEFTOVER[sock] = rest[length:]
     return status, rest[:length]
 
 
@@ -394,3 +408,114 @@ def test_volume_server_runs_on_event_loop(monkeypatch):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+# -- /debug/profile behind the event-loop front end --------------------------
+#
+# The sampler blocks its worker thread for the whole run, so the front
+# end's job is to keep the *other* lanes honest while one is sampling:
+# single-flight 409, kill-switch 403, bad-params 400 must all answer
+# from fresh connections without queueing behind the in-flight run.
+
+
+class DebugSurfaceHandler(EchoHandler):
+    """The real debug surface mounted the way every server mounts it."""
+
+    def do_GET(self):
+        from seaweedfs_tpu.telemetry import serve_debug_http
+
+        if serve_debug_http(self, self.path.partition("?")[0]):
+            return
+        self._reply(200, b"path=%s" % self.path.encode())
+
+
+@pytest.fixture
+def debug_loop_server():
+    srv = EventLoopHTTPServer(("127.0.0.1", 0), DebugSurfaceHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def _get(srv, path: str) -> tuple[int, bytes]:
+    s = _connect(srv)
+    try:
+        s.sendall(b"GET %s HTTP/1.1\r\nHost: x\r\n\r\n" % path.encode())
+        return _read_response(s)
+    finally:
+        s.close()
+
+
+def test_debug_profile_single_flight_409_on_event_loop(debug_loop_server):
+    results = {}
+
+    def long_run():
+        results["first"] = _get(
+            debug_loop_server, "/debug/profile?seconds=1.5&hz=20")
+
+    t = threading.Thread(target=long_run)
+    t.start()
+    # wait until the run actually holds the single-flight lock
+    from seaweedfs_tpu.util import profiler
+
+    deadline = time.time() + 5
+    while not profiler._RUN_LOCK.locked():
+        assert time.time() < deadline, "profile run never started"
+        time.sleep(0.01)
+    code, body = _get(debug_loop_server, "/debug/profile?seconds=1&hz=20")
+    assert code == 409 and b"already in progress" in body
+    t.join(timeout=10)
+    code, body = results["first"]
+    assert code == 200  # the in-flight run is unharmed by the rejection
+
+
+def test_debug_profile_bad_params_400_on_event_loop(debug_loop_server):
+    for q in ("seconds=0", "seconds=999", "hz=0", "hz=100000",
+              "seconds=nan&hz=banana"):
+        code, _ = _get(debug_loop_server, "/debug/profile?" + q)
+        assert code == 400, q
+
+
+def test_debug_profile_kill_switch_403_on_event_loop(
+        debug_loop_server, monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_PROFILER_DISABLED", "1")
+    code, body = _get(debug_loop_server, "/debug/profile?seconds=1")
+    assert code == 403 and b"disabled" in body
+    code, _ = _get(debug_loop_server, "/debug/profile/history")
+    assert code == 403
+    # the cheap status stub stays open even with the sampler closed
+    code, body = _get(debug_loop_server, "/debug/profile?status=1")
+    assert code == 200 and "max_rss_kb" in json.loads(body)
+
+
+def test_debug_profile_history_ring_rotation(debug_loop_server, monkeypatch):
+    """The continuous sampler's ring rotates windows and the history
+    endpoint serves them — oldest evicted once `retain` is exceeded."""
+    from seaweedfs_tpu.util import profiler
+
+    monkeypatch.setenv(profiler.CONTINUOUS_HZ_VAR, "40")
+    monkeypatch.setenv(profiler.CONTINUOUS_WINDOW_VAR, "0.1")
+    monkeypatch.setenv(profiler.CONTINUOUS_RETAIN_VAR, "3")
+    cp = profiler.ContinuousProfiler()
+    cp.start()
+    try:
+        deadline = time.time() + 10
+        while len(cp.history()["windows"]) < 3:
+            assert time.time() < deadline, "ring never filled"
+            time.sleep(0.05)
+        first_seen = cp.history()["windows"][0]["start"]
+        while cp.history()["windows"][0]["start"] == first_seen:
+            assert time.time() < deadline, "ring never rotated"
+            time.sleep(0.05)
+        doc = cp.history()
+        complete = [w for w in doc["windows"] if not w.get("partial")]
+        assert len(complete) <= 3  # bounded by retain
+        assert doc["running"] is True
+        # windows carry collapsed-stack text with sample counts
+        sampled = [w for w in complete if w["samples"]]
+        assert sampled and "collapsed" in sampled[0]
+    finally:
+        cp.stop()
+    assert cp.history()["running"] is False

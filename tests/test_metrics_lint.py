@@ -92,6 +92,41 @@ def test_runtime_registry_matches_source_families():
         declared.symmetric_difference(registered))
 
 
+def test_metrics_md_documents_every_registered_family():
+    """Cross-check stats/metrics.py against METRICS.md: every registered
+    family must be mentioned (table row or prose), and every family TABLE
+    ROW must name a family that still exists — stale doc rows mislead the
+    operator mid-incident, which is worse than no docs at all.
+
+    The seaweedfs_federation_* meta-families are synthesized by the
+    federation merger rather than registered, so the table-row check
+    allow-lists them from the merger's own source of truth."""
+    from seaweedfs_tpu.telemetry.federation import _META_FAMILIES
+
+    metrics_md = os.path.join(REPO, "METRICS.md")
+    text = open(metrics_md).read()
+
+    registered = {call.args[0].value
+                  for call, _ in _registration_calls(METRICS_PY)}
+
+    # "documented" = named in backticks anywhere (tables or prose);
+    # label-suffix mentions like `seaweedfs_x{le=...}` still match
+    documented = set(re.findall(r"`(seaweedfs_[a-z0-9_]+)", text))
+    undocumented = sorted(registered - documented)
+    assert not undocumented, (
+        f"families registered in stats/metrics.py but absent from "
+        f"METRICS.md: {undocumented}")
+
+    # table rows must reference live families only
+    rows = re.findall(r"^\|\s*`(seaweedfs_[a-z0-9_]+)`",
+                      text, flags=re.MULTILINE)
+    known = registered | set(_META_FAMILIES)
+    stale = sorted(set(rows) - known)
+    assert not stale, (
+        f"METRICS.md table rows for families that no longer exist: "
+        f"{stale}")
+
+
 def test_conflicting_reregistration_raises():
     from seaweedfs_tpu.stats.metrics import Registry
 

@@ -253,3 +253,40 @@ def test_snapshot_samples_counters_and_gauges_only():
     for i in range(600):
         c.labels(str(i)).inc()
     assert len(big.snapshot_samples(max_samples=512)) == 512
+
+
+def test_snapshot_samples_deny_list_cannot_evict_slo_families():
+    """High-cardinality families (hot-key gauges, per-peer connpool,
+    per-dir disk) sort last under the 512-sample heartbeat cap so they
+    can never crowd out the families the SLO engine reads from stale
+    snapshots."""
+    from seaweedfs_tpu.stats.metrics import Registry
+
+    r = Registry()
+    # a family the alerting plane depends on (plain tier)
+    reads = r.counter("seaweedfs_read_requests_total", "r", labels=("op",))
+    for i in range(8):
+        reads.labels(f"op{i}").inc()
+    # tier-0: must survive even the tightest cap
+    r.gauge("seaweedfs_geo_lag_seconds", "g").set(2.0)
+    # deny-listed flood: 600 hot-key children and 600 per-peer gauges
+    hot = r.gauge("seaweedfs_hotkey_top_count", "h", labels=("dim", "key"))
+    pool = r.gauge("seaweedfs_connpool_in_use", "p", labels=("peer",))
+    for i in range(600):
+        hot.labels("needle", f"k{i}").set(float(i))
+        pool.labels(f"10.0.0.{i}").set(1.0)
+
+    samples = dict(r.snapshot_samples(max_samples=64))
+    assert len(samples) == 64
+    # every non-deny-listed sample made it in...
+    assert sum(1 for k in samples
+               if k.startswith("seaweedfs_read_requests_total")) == 8
+    assert "seaweedfs_geo_lag_seconds" in samples
+    # ...and the flood only got the leftover slots
+    flood = [k for k in samples
+             if k.startswith(("seaweedfs_hotkey_", "seaweedfs_connpool_"))]
+    assert len(flood) == 64 - 8 - 1
+
+    # with no cap pressure the deny-listed families still appear
+    full = dict(r.snapshot_samples(max_samples=1 << 20))
+    assert sum(1 for k in full if k.startswith("seaweedfs_hotkey_")) == 600
